@@ -1,0 +1,52 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capabilities of
+Apache MXNet v1.0.1, re-designed on JAX/XLA/Pallas/pjit.
+
+Frontend layout mirrors python/mxnet/ for drop-in familiarity (mx.nd, mx.sym,
+mx.mod, mx.gluon, mx.autograd, mx.kv, mx.io, ...); the backend is a single
+XLA computation per graph instead of a per-op CUDA engine.
+"""
+from __future__ import annotations
+
+from .base import MXNetError, __version__
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+
+from . import base
+from . import context as context_mod
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from .symbol import AttrScope
+from .symbol.symbol import NameManager
+from . import autograd
+from . import random
+from .random import seed  # mx.random.seed is canonical; mx.seed kept too
+from . import executor
+from .executor import Executor
+
+# submodules populated as the build proceeds
+from . import optimizer
+from .optimizer import Optimizer
+from . import metric
+from . import initializer
+from .initializer import Initializer
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import monitor
+from .monitor import Monitor
+from . import kvstore as kv
+from . import kvstore
+from . import module
+from . import module as mod
+from . import model
+from .model import FeedForward
+from . import gluon
+from . import recordio
+from . import profiler
+from . import engine
+from . import test_utils
+from . import visualization
+from .visualization import plot_network
+from . import rnn
+from . import image

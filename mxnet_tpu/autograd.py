@@ -1,0 +1,334 @@
+"""Autograd: imperative automatic differentiation.
+
+TPU-native rebuild of src/imperative/imperative.cc (RecordOp :182, Backward
+:357) + python/mxnet/autograd.py.  The reference builds an nnvm tape and runs
+a Gradient pass through the engine; here the tape is a list of Python nodes
+whose backward is computed with per-node jax.vjp (XLA recompute-fused), and
+leaf gradients land in the `grad` buffers attached by mark_variables — the
+same observable API: record/pause/train_mode/predict_mode scopes, backward,
+grad buffers.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _st().training
+    _state.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+
+    def __exit__(self, ptype, value, trace):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Autograd recording scope (ref: python/mxnet/autograd.py:122)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class _Node:
+    """One recorded op application (ref: AGInfo, include/mxnet/imperative.h:59)."""
+
+    __slots__ = ("op", "attrs", "in_entries", "in_arrays", "n_outputs",
+                 "out_arrays", "rng_key", "_custom_backward")
+
+    def __init__(self, op, attrs, in_entries, in_arrays, out_arrays, rng_key):
+        self.op = op
+        self.attrs = attrs
+        self.in_entries = in_entries      # [(producer_node|None, out_idx, leaf_ndarray|None)]
+        self.in_arrays = in_arrays        # raw jax arrays at record time
+        self.out_arrays = out_arrays
+        self.n_outputs = len(out_arrays)
+        self.rng_key = rng_key
+
+
+def record_op(op, attrs, input_nds, in_arrays, output_nds, rng_key=None):
+    """Called by the imperative dispatch when recording is on."""
+    entries = []
+    for nd in input_nds:
+        e = getattr(nd, "_tape_entry", None)
+        if e is not None:
+            entries.append((e[0], e[1], None))
+        elif getattr(nd, "_grad", None) is not None:
+            entries.append((None, 0, nd))
+        else:
+            entries.append((None, 0, None))  # constant
+    node = _Node(op, attrs, entries, list(in_arrays),
+                 [o._h.array for o in output_nds], rng_key)
+    for i, o in enumerate(output_nds):
+        o._tape_entry = (node, i)
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables (ref: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad if req != "null" else None
+        var._grad_req = req
+        var._tape_entry = None
+
+
+def _node_fn(node):
+    impl = node.op.impl
+    attrs = node.attrs
+
+    def fn(*arrays):
+        if node.rng_key is not None:
+            out = impl(node.rng_key, *arrays, **attrs)
+        else:
+            out = impl(*arrays, **attrs)
+        return out if isinstance(out, tuple) else (out,)
+
+    return fn
+
+
+def _is_float(arr):
+    return jnp.issubdtype(arr.dtype, jnp.floating)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from head NDArrays, filling leaf .grad buffers
+    (ref: Imperative::Backward imperative.cc:357)."""
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # collect reachable nodes, topological order via DFS
+    topo, seen = [], set()
+
+    def visit(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for prod, _, _ in node.in_entries:
+            visit(prod)
+        topo.append(node)
+
+    head_nodes = []
+    for h in heads:
+        e = getattr(h, "_tape_entry", None)
+        if e is None:
+            raise MXNetError("cannot differentiate: output is not on the tape "
+                             "(was it computed inside autograd.record()?)")
+        head_nodes.append(e)
+        visit(e[0])
+
+    # cotangent accumulators: {id(node): [cts per output]}
+    cts = {id(n): [None] * n.n_outputs for n in topo}
+    leaf_grads = {}  # id(ndarray) -> (ndarray, ct)
+
+    for (node, idx), h, hg in zip(head_nodes, heads, head_grads):
+        g = hg._h.array if hg is not None else jnp.ones_like(h._h.array)
+        cur = cts[id(node)][idx]
+        cts[id(node)][idx] = g if cur is None else cur + g
+
+    for node in reversed(topo):
+        out_cts = cts[id(node)]
+        if all(c is None for c in out_cts):
+            continue
+        full_cts = tuple(
+            c if c is not None else jnp.zeros_like(o)
+            for c, o in zip(out_cts, node.out_arrays))
+        custom = getattr(node, "_custom_backward", None)
+        if custom is not None:
+            from .ndarray import NDArray, _wrap_array
+            with pause():
+                grads = custom.backward(*[_wrap_array(c) for c in full_cts])
+            if not isinstance(grads, (list, tuple)):
+                grads = [grads]
+            in_cts = [None if g is None else g._h.array for g in grads]
+        else:
+            if not any(_is_float(a) for a in node.in_arrays):
+                continue
+            # impl may produce state outputs beyond the recorded visible ones
+            n_impl_out = node.n_outputs
+            fn = _node_fn(node)
+
+            def fn_vis(*arrays, _fn=fn, _n=n_impl_out):
+                return _fn(*arrays)[:_n]
+
+            _, vjp_fn = jax.vjp(fn_vis, *node.in_arrays)
+            in_cts = vjp_fn(full_cts)
+        for i, ct in enumerate(in_cts):
+            if ct is None or not _is_float(node.in_arrays[i]):
+                continue
+            prod, oidx, leaf = node.in_entries[i]
+            if prod is not None:
+                cur = cts[id(prod)][oidx]
+                cts[id(prod)][oidx] = ct if cur is None else cur + ct
+            elif leaf is not None:
+                k = id(leaf)
+                if k in leaf_grads:
+                    leaf_grads[k] = (leaf, leaf_grads[k][1] + ct)
+                else:
+                    leaf_grads[k] = (leaf, ct)
+
+    for leaf, ct in leaf_grads.values():
+        grad_buf = leaf._grad
+        if grad_buf is None:
+            continue
+        if getattr(leaf, "_grad_req", "write") == "add":
+            grad_buf._h.array = grad_buf._h.array + ct.astype(grad_buf.dtype)
+        else:
+            grad_buf._h.array = ct.astype(grad_buf._h.array.dtype)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (ref: autograd.py:270)."""
+    from .ndarray import NDArray, array as nd_array
+
+    if create_graph:
+        raise MXNetError("create_graph=True is not supported yet")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    # temporarily attach grad buffers
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", None)) for v in variables]
+    from . import ndarray as ndmod
+    bufs = [ndmod.zeros(v.shape, dtype=v.dtype, ctx=v.context) for v in variables]
+    for v, b in zip(variables, bufs):
+        v._grad = b
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad = g
+            v._grad_req = r
+    return bufs[0] if single else bufs
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported in mxnet_tpu")
+
+
+class Function:
+    """Custom differentiable function (ref: autograd.py:381).
+
+    Subclass and implement forward/backward with NDArray math.  Recording is
+    paused inside both; backward receives head grads and must return input
+    grads.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            class _FnOpShim:
+                name = "_custom_function"
+                impl = None
+                num_state_outputs = 0
+
+            # custom node: backward delegates to func.backward
+            node = _Node.__new__(_Node)
+            node.op = _FnOpShim
+            node.attrs = {}
+            node.in_entries = []
+            for nd in inputs:
+                e = getattr(nd, "_tape_entry", None)
+                if e is not None:
+                    node.in_entries.append((e[0], e[1], None))
+                elif getattr(nd, "_grad", None) is not None:
+                    node.in_entries.append((None, 0, nd))
+                else:
+                    node.in_entries.append((None, 0, None))
+            node.in_arrays = [nd._h.array for nd in inputs]
+            node.out_arrays = [o._h.array for o in outs]
+            node.n_outputs = len(outs)
+            node.rng_key = None
+            node._custom_backward = func  # marker used by backward walk
+            for i, o in enumerate(outs):
+                o._tape_entry = (node, i)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

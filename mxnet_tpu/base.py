@@ -1,0 +1,128 @@
+"""Base utilities for mxnet_tpu.
+
+TPU-native re-design of the reference's base layer (dmlc-core slice:
+logging/CHECK, env config, parameter reflection — ref: include/mxnet/base.h,
+dmlc/parameter.h usage sites).  Here the "C ABI error handling" collapses to
+Python exceptions; the dmlc::Parameter string-reflection survives as the
+attr-string conventions used by the Symbol/JSON layer.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__version__ = "1.0.1"  # capability parity target: MXNet 1.0.1 (python/mxnet/libinfo.py:64)
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (ref: MXGetLastError, src/c_api/c_api_error.cc)."""
+
+
+def check_call(ok, msg=""):
+    if not ok:
+        raise MXNetError(msg)
+
+
+_logger = logging.getLogger("mxnet_tpu")
+
+
+def get_env(name, default=None, typ=str):
+    """dmlc::GetEnv equivalent: typed environment config (ref: docs/faq/env_var.md)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool:
+        return val not in ("0", "false", "False", "")
+    return typ(val)
+
+
+# ---------------------------------------------------------------------------
+# Attr-string reflection (dmlc::Parameter equivalent).
+#
+# Symbols carry attrs as strings (for JSON checkpoint-format parity with
+# nnvm::Graph JSON); ops declare typed params and these helpers convert both
+# ways, matching MXNet's string conventions: tuples print as "(1, 2)",
+# bools as "True"/"False".
+# ---------------------------------------------------------------------------
+
+def attr_to_str(value):
+    """Serialize a python attr value the way MXNet's frontends do."""
+    if isinstance(value, (list, tuple)):
+        return "(" + ", ".join(str(v) for v in value) + ")"
+    return str(value)
+
+
+def _parse_scalar(s):
+    s = s.strip()
+    if s in ("True", "true"):
+        return True
+    if s in ("False", "false"):
+        return False
+    if s in ("None", ""):
+        return None
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def str_to_attr(s):
+    """Parse an MXNet attr string back into a python value."""
+    if not isinstance(s, str):
+        return s
+    t = s.strip()
+    if t.startswith("(") and t.endswith(")") or t.startswith("[") and t.endswith("]"):
+        inner = t[1:-1].strip()
+        if not inner:
+            return ()
+        return tuple(_parse_scalar(p) for p in inner.split(",") if p.strip() != "")
+    return _parse_scalar(t)
+
+
+def shape_attr(value):
+    """Coerce an attr to a shape tuple of ints (accepts int, str, tuple)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = str_to_attr(value)
+    if isinstance(value, int):
+        return (value,)
+    return tuple(int(v) for v in value)
+
+
+string_types = (str,)
+
+# dtype name <-> numpy mapping used across frontends (ref: python/mxnet/base.py)
+_DTYPE_ALIASES = {
+    "float32": "float32", "float64": "float64", "float16": "float16",
+    "bfloat16": "bfloat16", "uint8": "uint8", "int8": "int8",
+    "int32": "int32", "int64": "int64", "bool": "bool_",
+}
+
+
+def np_dtype(dtype):
+    import numpy as _np
+    import jax.numpy as jnp
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.bfloat16
+        return _np.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+    if dtype is jnp.bfloat16:
+        return jnp.bfloat16
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    import numpy as _np
+    try:
+        name = _np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    return "bfloat16" if "bfloat16" in name else name

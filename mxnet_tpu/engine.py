@@ -1,0 +1,20 @@
+"""Engine frontend (ref: python/mxnet/engine.py — bulk context manager).
+
+The reference's threaded dependency engine scheduled every op push; with
+XLA's async dispatch owning scheduling, `bulk` is kept for API parity and
+maps to a no-op batching hint (XLA fuses whole jitted graphs anyway —
+SURVEY §7 stage 2 'keep it thin').
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Bulk execution scope (ref: MXEngineSetBulkSize)."""
+    yield
+
+
+def set_bulk_size(size):
+    return 0

@@ -1,0 +1,300 @@
+"""Executor: binds a Symbol to a device and runs it as ONE XLA computation.
+
+TPU-native rebuild of src/executor/graph_executor.{h,cc} (1.9k LoC) +
+python/mxnet/executor.py.  The reference's Init pipeline (InitFullGraph ->
+PlaceDevice -> PlanMemory -> AttachOpExecs -> InitCachedOps -> per-node
+engine pushes in RunOps) collapses to: build a pure python evaluator over
+the graph, `jax.jit` it whole, and let XLA do memory planning, fusion and
+scheduling — the north-star design from BASELINE.json.  Backward is the
+jitted vjp of the same computation (gradient pass == jax.vjp instead of
+nnvm::pass::Gradient), sharing the forward's RNG keys so dropout masks
+match between forward and backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import current_context
+from .ops.registry import get_op
+from .ndarray import NDArray, zeros as nd_zeros
+from .ndarray.ndarray import _Handle
+from . import random as _random
+
+
+class _Program:
+    """Compiled form of a symbol graph: closures + metadata."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.order = symbol._topo()
+        symbol._mark_aux(self.order)
+        self.arg_names = [n.name for n in self.order if n.is_var and not n._is_aux]
+        self.aux_names = [n.name for n in self.order if n.is_var and n._is_aux]
+        self.var_nodes = {n.name: n for n in self.order if n.is_var}
+        self.entries = list(symbol._entries)
+        # nodes needing RNG keys, in topo order
+        self.rng_nodes = [n for n in self.order
+                          if not n.is_var and get_op(n.op_name).needs_rng]
+
+    def evaluate(self, arg_map, aux_map, keys, train, tap=None):
+        """Evaluate the graph given {name: jax.Array} maps.  Returns
+        (outputs, new_aux_map).  Pure — safe to jit/vjp."""
+        env = {}
+        new_aux = dict(aux_map)
+        key_iter = iter(keys)
+        for node in self.order:
+            if node.is_var:
+                if node.name in arg_map:
+                    env[(node, 0)] = arg_map[node.name]
+                elif node.name in aux_map:
+                    env[(node, 0)] = aux_map[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                continue
+            op = get_op(node.op_name)
+            attrs = op.normalize_attrs(node.attrs)
+            if op.key_var_num_args and not attrs.get(op.key_var_num_args):
+                attrs[op.key_var_num_args] = len(node.inputs)
+            if op.takes_train_flag:
+                attrs["_train"] = train
+            ins = [env[e] for e in node.inputs]
+            if op.needs_rng:
+                ins = [next(key_iter)] + ins
+            out = op.impl(*ins, **attrs)
+            if not isinstance(out, tuple):
+                out = (out,)
+            n_vis = node.num_outputs()
+            for i in range(n_vis):
+                env[(node, i)] = out[i]
+            # state outputs fold back into aux values (BatchNorm moving stats)
+            for extra, in_idx in zip(out[n_vis:], op.mutate_map):
+                src_node, _ = node.inputs[in_idx]
+                if src_node.is_var and src_node.name in new_aux:
+                    new_aux[src_node.name] = extra
+            if tap is not None:
+                for i in range(n_vis):
+                    tap(node, i, out[i])
+        outputs = [env[e] for e in self.entries]
+        return outputs, new_aux
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._prog = _Program(symbol)
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        if isinstance(grad_req, str):
+            grad_req = {k: grad_req for k in self._prog.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._prog.arg_names, grad_req))
+        self._grad_req = {k: grad_req.get(k, "null") for k in self._prog.arg_names}
+        self._grad_names = [k for k in self._prog.arg_names
+                            if self._grad_req[k] != "null" and k in grad_dict
+                            and grad_dict[k] is not None]
+        self.outputs = []
+        self._last_keys = None
+        self._monitor_callback = None
+        self._monitor_all = False
+
+        prog = self._prog
+        n_keys = len(prog.rng_nodes)
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def _fwd(arg_vals, aux_vals, keys, train):
+            arg_map = dict(zip(prog.arg_names, arg_vals))
+            aux_map = dict(zip(prog.aux_names, aux_vals))
+            outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
+            return outs, [new_aux[n] for n in prog.aux_names]
+
+        grad_names = self._grad_names
+
+        @jax.jit
+        def _bwd(arg_vals, aux_vals, keys, head_grads):
+            arg_map = dict(zip(prog.arg_names, arg_vals))
+            aux_map = dict(zip(prog.aux_names, aux_vals))
+
+            def f(gvals):
+                amap = dict(arg_map)
+                amap.update(zip(grad_names, gvals))
+                outs, _ = prog.evaluate(amap, aux_map, keys, True)
+                return outs
+
+            gvals = [arg_map[n] for n in grad_names]
+            _, vjp_fn = jax.vjp(f, gvals)
+            (grads,) = vjp_fn(head_grads)
+            return grads
+
+        self._fwd_jit = _fwd
+        self._bwd_jit = _bwd
+        self._n_keys = n_keys
+
+    # -- parameter access ----------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._prog.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._prog.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._prog.aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    # -- execution -----------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % k)
+            dst = self.arg_dict[k]
+            src = v._h.array if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
+            dst._h.array = src.astype(dst._h.array.dtype) \
+                if src.dtype != dst._h.array.dtype else src
+        arg_vals = [self.arg_dict[n]._h.array for n in self._prog.arg_names]
+        aux_vals = [self.aux_dict[n]._h.array for n in self._prog.aux_names]
+        keys = tuple(_random.next_key() for _ in range(self._n_keys))
+        self._last_keys = keys
+
+        if self._monitor_callback is not None:
+            # monitor mode: run uncompiled so every op output can be tapped
+            def tap(node, i, val):
+                name = node.name + ("_output" if i == 0 else "_output%d" % i)
+                self._monitor_callback(name, NDArray(val))
+
+            arg_map = dict(zip(self._prog.arg_names, arg_vals))
+            aux_map = dict(zip(self._prog.aux_names, aux_vals))
+            outs, new_aux = self._prog.evaluate(arg_map, aux_map, keys,
+                                                bool(is_train), tap=tap)
+            new_aux = [new_aux[n] for n in self._prog.aux_names]
+        else:
+            outs, new_aux = self._fwd_jit(arg_vals, aux_vals, keys, bool(is_train))
+        if is_train:
+            for n, v in zip(self._prog.aux_names, new_aux):
+                self.aux_dict[n]._h.array = v
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self.outputs:
+            raise MXNetError("backward() called before forward()")
+        if out_grads is None:
+            head_grads = [jnp.ones_like(o._h.array) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = [g._h.array if g is not None else
+                          jnp.ones_like(o._h.array)
+                          for g, o in zip(out_grads, self.outputs)]
+        if not self._grad_names:
+            return
+        arg_vals = [self.arg_dict[n]._h.array for n in self._prog.arg_names]
+        aux_vals = [self.aux_dict[n]._h.array for n in self._prog.aux_names]
+        keys = self._last_keys or tuple(_random.next_key()
+                                        for _ in range(self._n_keys))
+        grads = self._bwd_jit(arg_vals, aux_vals, keys, head_grads)
+        for n, g in zip(self._grad_names, grads):
+            buf = self.grad_dict[n]
+            if self._grad_req[n] == "add":
+                buf._h.array = buf._h.array + g.astype(buf._h.array.dtype)
+            else:
+                buf._h.array = g.astype(buf._h.array.dtype)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                v.copyto(self.arg_dict[k])
+            elif not allow_extra_params:
+                raise MXNetError("invalid param %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    v.copyto(self.aux_dict[k])
+                elif not allow_extra_params:
+                    raise MXNetError("invalid aux %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with different input shapes (re-jit; XLA
+        caches per shape signature)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args, new_grads = {}, {}
+        for name, shape in zip(self._prog.arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                new_args[name] = cur
+                if name in self.grad_dict:
+                    new_grads[name] = self.grad_dict[name]
+            else:
+                new_args[name] = nd_zeros(shape, self._ctx, dtype=cur.dtype)
+                if name in self.grad_dict and self.grad_dict[name] is not None:
+                    new_grads[name] = nd_zeros(shape, self._ctx, dtype=cur.dtype)
+        new_aux = {}
+        for name, shape in zip(self._prog.aux_names, aux_shapes):
+            new_aux[name] = self.aux_dict[name]
+        return Executor(self._symbol, self._ctx, new_args, new_grads, new_aux,
+                        self._grad_req)
+
+    # -- binding classmethods -------------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        type_dict = dict(type_dict or {})
+        arg_types, _, aux_types = symbol.infer_type(**{
+            k: v for k, v in type_dict.items()})
+        arg_dict, grad_dict, aux_dict = {}, {}, {}
+        if isinstance(grad_req, str):
+            req_of = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req_of = dict(zip(arg_names, grad_req))
+        else:
+            req_of = {n: grad_req.get(n, "null") for n in arg_names}
+        for name, shape, dt in zip(arg_names, arg_shapes, arg_types):
+            dt = np_dtype(type_dict.get(name, dt or np.float32))
+            arg_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+            if req_of.get(name, "null") != "null":
+                grad_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+        for name, shape, dt in zip(aux_names, aux_shapes, aux_types):
+            dt = np_dtype(type_dict.get(name, dt or np.float32))
+            aux_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req_of)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args)
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = {n: g for n, g in zip(arg_names, args_grad)
+                         if g is not None}
+        else:
+            grad_dict = dict(args_grad)
+        if aux_states is None:
+            aux_dict = {}
+        elif isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        else:
+            aux_dict = dict(aux_states)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)

@@ -1,0 +1,1 @@
+"""Gluon: imperative/hybrid neural-network API (ref: python/mxnet/gluon/)."""

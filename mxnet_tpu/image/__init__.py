@@ -1,0 +1,1 @@
+"""Image iterators + augmenters (ref: python/mxnet/image/)."""

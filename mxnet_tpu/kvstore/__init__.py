@@ -1,0 +1,148 @@
+"""KVStore: gradient aggregation / parameter synchronization.
+
+TPU-native rebuild of src/kvstore/ (§2.5 of SURVEY.md).  Backends:
+- 'local' / 'device': single-process multi-device reduce (ref: KVStoreLocal
+  kvstore_local.h:159-210 + Comm comm.h) — here the reduce is a jnp sum over
+  per-device arrays; XLA handles the transfers.
+- 'tpu_ici': the north-star backend — push/pull map onto psum/all_gather
+  collectives over a jax.sharding.Mesh (see kvstore/tpu_ici.py); replaces
+  both KVStoreNCCL and the ps-lite parameter server for intra-slice DP.
+- 'dist*': multi-host über jax.distributed (DCN); dist_async documented as
+  sync-equivalent on ICI (SURVEY §7 hard-part 5).
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros as nd_zeros
+from .. import optimizer as opt
+
+
+class KVStore:
+    """Single-process key-value store base (ref: include/mxnet/kvstore.h)."""
+
+    def __init__(self, name="local"):
+        self._type = name
+        self._stored = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._stored:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._stored[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def _reduce(self, vals):
+        if isinstance(vals, NDArray):
+            return vals
+        if len(vals) == 1:
+            return vals[0]
+        # device-style reduce: accumulate on the first device's context
+        ctx0 = vals[0].context
+        acc = vals[0].copy()
+        for v in vals[1:]:
+            acc += v.as_in_context(ctx0)
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v)
+            stored = self._stored.get(k)
+            if stored is None:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, stored)
+            else:
+                merged.copyto(stored)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            stored = self._stored[k]
+            if isinstance(olist, NDArray):
+                olist = [olist]
+            for o in olist:
+                stored.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense-backed emulation: full pull (row_sparse lives in ndarray.sparse)
+        self.pull(key, out=out, priority=priority)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = compression_params
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def barrier(self):
+        pass
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _updater_key(k):
+    return k
+
+
+def _key_value(key, value):
+    """Normalize (key, value) into parallel lists; value may be a list of
+    per-device NDArrays per key."""
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    # list of keys
+    if isinstance(value, (list, tuple)) and len(key) == len(value):
+        return list(key), list(value)
+    # flat list of values grouped by key
+    n = len(value) // len(key)
+    return list(key), [value[i * n:(i + 1) * n] for i in range(len(key))]
+
+
+def create(name="local"):
+    """Factory (ref: kvstore.cc:38-71 parses dist/device/nccl substrings)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "device", "local_allreduce_device", "nccl"):
+        return KVStore(name)
+    if "tpu" in name or "ici" in name:
+        from .tpu_ici import TpuIciKVStore
+        return TpuIciKVStore(name)
+    if "dist" in name:
+        from .dist import DistKVStore
+        return DistKVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

@@ -1,0 +1,73 @@
+"""mx.nd namespace: NDArray + op functions generated from the registry.
+
+The reference code-generates Python op functions at import from the C
+registry (_init_op_module / _make_ndarray_function,
+python/mxnet/ndarray/register.py:156-168).  Here the registry is the Python
+Op table in ops/registry.py and the generated wrappers dispatch through the
+jax.jit cache in _invoke.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from ..ops.registry import get_op as _get_op
+from .ndarray import (  # noqa: F401
+    NDArray, array, empty, zeros, ones, full, arange, zeros_like, ones_like,
+    moveaxis, transpose, concatenate, stack, waitall, save, load,
+    from_dlpack, to_dlpack_for_read, to_dlpack_for_write, from_numpy,
+    invoke, _invoke, _wrap_array,
+)
+
+
+def _make_op_func(canonical, op):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        inputs = []
+        pos_attrs = {}
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                inputs.extend(a)
+        nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+        if nd_kwargs:
+            order = tuple(op.input_names or ()) + tuple(op.aux_names or ())
+            for n in order:
+                if n in nd_kwargs:
+                    inputs.append(nd_kwargs.pop(n))
+            inputs.extend(nd_kwargs.values())  # unknown names: positional order
+        # non-NDArray positional args map onto declared attr order (rare; e.g.
+        # nd.one_hot(indices, depth))
+        return _invoke(canonical, inputs, attrs, out=out)
+
+    fn.__name__ = canonical
+    fn.__doc__ = op.doc or ("%s (auto-generated from the op registry)" % canonical)
+    return fn
+
+
+_mod = _sys.modules[__name__]
+_GENERATED = {}
+for _name, _op in list(_registry.op_registry().items()):
+    if not _name.replace("_", "a").isidentifier():
+        continue
+    _f = _make_op_func(_name, _op)
+    _GENERATED[_name] = _f
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _f)
+
+# "nd.random_uniform"-style names already covered via aliases; also expose the
+# creation helpers over the generated init ops (python-side versions win).
+
+onehot_encode = _GENERATED.get("one_hot")
+
+
+def __getattr__(name):  # late registrations (nn/random modules import order)
+    _op_tbl = _registry.op_registry()
+    if name in _op_tbl:
+        f = _make_op_func(name, _op_tbl[name])
+        setattr(_mod, name, f)
+        return f
+    raise AttributeError("module 'mxnet_tpu.ndarray' has no attribute %r" % name)

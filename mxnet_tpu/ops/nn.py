@@ -1,0 +1,733 @@
+"""Neural-network layer operators.
+
+TPU-native rebuild of src/operator/nn/ + the root legacy layer ops
+(Convolution convolution-inl.h, FullyConnected fully_connected-inl.h,
+BatchNorm batch_norm-inl.h, Pooling pool.h, SoftmaxOutput
+softmax_output-inl.h, Activation, Dropout, LRN, Embedding ...).  Conv/FC
+lower to lax.conv_general_dilated / jnp.matmul so XLA tiles them onto the
+MXU; loss heads (SoftmaxOutput, *RegressionOutput, make_loss) reproduce the
+reference's custom backward semantics via jax.custom_vjp so that whole-graph
+vjp matches MXNet's Executor.backward exactly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import np_dtype, MXNetError
+from .registry import register, pShape, pInt, pFloat, pBool, pStr, pDtype, pAny
+
+# ---------------------------------------------------------------------------
+# Activation / LeakyReLU / softmax family
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+def _activation(x, act_type="relu"):
+    return _ACTS[act_type](x)
+
+
+register("Activation", _activation, num_inputs=1,
+         params={"act_type": (pStr, "relu")})
+
+
+def _leaky_relu(x, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334):
+    if act_type in ("leaky", "rrelu"):  # rrelu uses mean slope at inference
+        s = slope if act_type == "leaky" else (lower_bound + upper_bound) / 2.0
+        return jnp.where(x > 0, x, s * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    raise MXNetError("unknown LeakyReLU act_type %s" % act_type)
+
+
+register("LeakyReLU", _leaky_relu, num_inputs=1,
+         params={"act_type": (pStr, "leaky"), "slope": (pFloat, 0.25),
+                 "lower_bound": (pFloat, 0.125), "upper_bound": (pFloat, 0.334)})
+
+
+def _prelu(x, gamma):
+    g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if x.ndim > 1 else gamma
+    return jnp.where(x > 0, x, g * x)
+
+
+register("_PReLU", _prelu, num_inputs=2)
+
+
+def _softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+register("softmax", _softmax, num_inputs=1,
+         params={"axis": (pAny, -1), "temperature": (pAny, None)})
+register("log_softmax", lambda x, axis=-1, temperature=None:
+         jax.nn.log_softmax(x if not temperature else x / temperature, axis=int(axis)),
+         num_inputs=1, params={"axis": (pAny, -1), "temperature": (pAny, None)})
+
+
+def _softmax_activation(x, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+register("SoftmaxActivation", _softmax_activation, num_inputs=1,
+         params={"mode": (pStr, "instance")})
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: fully_connected-inl.h:114 linalg_gemm)
+# ---------------------------------------------------------------------------
+
+def _fully_connected(data, weight, *rest, num_hidden=1, no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten or data.ndim == 2 else data
+    pt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, weight.T, preferred_element_type=pt)
+    if pt:
+        out = out.astype(data.dtype)
+    if not no_bias:
+        out = out + rest[0]
+    return out
+
+
+def _fc_infer_shape(in_shapes, attrs):
+    num_hidden = int(attrs["num_hidden"])
+    no_bias = attrs.get("no_bias", False)
+    flatten = attrs.get("flatten", True)
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    in_dim = int(np.prod(dshape[1:])) if (flatten or len(dshape) == 2) else dshape[-1]
+    filled = list(in_shapes)
+    filled[1] = (num_hidden, in_dim)
+    if not no_bias:
+        filled[2] = (num_hidden,)
+    oshape = (dshape[0], num_hidden) if (flatten or len(dshape) == 2) \
+        else tuple(dshape[:-1]) + (num_hidden,)
+    return filled, [oshape]
+
+
+register("FullyConnected", _fully_connected,
+         input_names=("data", "weight", "bias"),
+         infer_shape=_fc_infer_shape,
+         params={"num_hidden": (pInt, 1), "no_bias": (pBool, False),
+                 "flatten": (pBool, True)})
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (ref: convolution-inl.h; NCHW + OIHW layout —
+# XLA re-lays-out for the MXU internally)
+# ---------------------------------------------------------------------------
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+def _conv_dn(nd):
+    if nd == 1:
+        return ("NCH", "OIH", "NCH")
+    if nd == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _convolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
+                 pad=None, num_filter=1, num_group=1, no_bias=False,
+                 workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
+    nd = _conv_dims(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    pt = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        feature_group_count=int(num_group),
+        preferred_element_type=pt,
+    )
+    if pt:
+        out = out.astype(data.dtype)
+    if not no_bias:
+        b = rest[0].reshape((1, -1) + (1,) * nd)
+        out = out + b
+    return out
+
+
+def _conv_out_dim(d, k, s, p, dil):
+    return (d + 2 * p - (dil * (k - 1) + 1)) // s + 1
+
+
+def _conv_infer_shape(in_shapes, attrs):
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = attrs.get("stride") or (1,) * nd
+    dilate = attrs.get("dilate") or (1,) * nd
+    pad = attrs.get("pad") or (0,) * nd
+    num_filter = int(attrs["num_filter"])
+    num_group = int(attrs.get("num_group", 1))
+    no_bias = attrs.get("no_bias", False)
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    filled[1] = (num_filter, dshape[1] // num_group) + tuple(kernel)
+    if not no_bias:
+        filled[2] = (num_filter,)
+    spatial = tuple(_conv_out_dim(dshape[2 + i], kernel[i], stride[i], pad[i], dilate[i])
+                    for i in range(nd))
+    return filled, [(dshape[0], num_filter) + spatial]
+
+
+_CONV_PARAMS = {
+    "kernel": (pShape, (1, 1)), "stride": (pShape, None), "dilate": (pShape, None),
+    "pad": (pShape, None), "num_filter": (pInt, 1), "num_group": (pInt, 1),
+    "no_bias": (pBool, False), "workspace": (pInt, 1024),
+    "cudnn_tune": (pStr, None), "cudnn_off": (pBool, False), "layout": (pStr, None),
+}
+
+register("Convolution", _convolution, input_names=("data", "weight", "bias"),
+         infer_shape=_conv_infer_shape, params=_CONV_PARAMS,
+         aliases=("Convolution_v1",))
+
+
+def _deconvolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
+                   pad=None, adj=None, target_shape=None, num_filter=1,
+                   num_group=1, no_bias=True, workspace=1024, cudnn_tune=None,
+                   cudnn_off=False, layout=None):
+    nd = _conv_dims(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    # Deconv == gradient of conv w.r.t. input: conv_transpose with IOHW kernel
+    out = lax.conv_transpose(
+        data, jnp.swapaxes(weight, 0, 1) if num_group == 1 else weight,
+        strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        transpose_kernel=True,
+    )
+    if not no_bias:
+        out = out + rest[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_infer_shape(in_shapes, attrs):
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = attrs.get("stride") or (1,) * nd
+    dilate = attrs.get("dilate") or (1,) * nd
+    pad = attrs.get("pad") or (0,) * nd
+    num_filter = int(attrs["num_filter"])
+    num_group = int(attrs.get("num_group", 1))
+    no_bias = attrs.get("no_bias", True)
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    filled[1] = (dshape[1], num_filter // num_group) + tuple(kernel)
+    if not no_bias:
+        filled[2] = (num_filter,)
+    spatial = tuple(stride[i] * (dshape[2 + i] - 1) + (dilate[i] * (kernel[i] - 1) + 1)
+                    - 2 * pad[i] for i in range(nd))
+    return filled, [(dshape[0], num_filter) + spatial]
+
+
+register("Deconvolution", _deconvolution, input_names=("data", "weight", "bias"),
+         infer_shape=_deconv_infer_shape,
+         params=dict(_CONV_PARAMS, adj=(pShape, None), target_shape=(pShape, None),
+                     no_bias=(pBool, True)))
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: pooling-inl.h, pool.h) — lax.reduce_window
+# ---------------------------------------------------------------------------
+
+def _pooling(data, pool_type="max", kernel=(1, 1), stride=None, pad=None,
+             global_pool=False, pooling_convention="valid", cudnn_off=False):
+    nd = len(kernel)
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+        nd = len(kernel)
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: widen right pad so ceil division is covered
+        extra = []
+        for i in range(nd):
+            d = data.shape[2 + i]
+            out_full = int(np.ceil((d + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            needed = (out_full - 1) * stride[i] + kernel[i] - d - pad[i]
+            extra.append(max(needed, pad[i]))
+        pads = ((0, 0), (0, 0)) + tuple((pad[i], extra[i]) for i in range(nd))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        out = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "avg":
+            out = out / float(np.prod(kernel))
+        return out.astype(data.dtype)
+    raise MXNetError("unknown pool_type %s" % pool_type)
+
+
+def _pool_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    if attrs.get("global_pool", False):
+        return in_shapes, [tuple(dshape[:2]) + (1,) * (len(dshape) - 2)]
+    stride = attrs.get("stride") or (1,) * nd
+    pad = attrs.get("pad") or (0,) * nd
+    conv = attrs.get("pooling_convention", "valid")
+    sp = []
+    for i in range(nd):
+        if conv == "full":
+            o = int(np.ceil((dshape[2 + i] + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+        else:
+            o = (dshape[2 + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
+        sp.append(o)
+    return in_shapes, [tuple(dshape[:2]) + tuple(sp)]
+
+
+register("Pooling", _pooling, num_inputs=1, infer_shape=_pool_infer_shape,
+         aliases=("Pooling_v1",),
+         params={"pool_type": (pStr, "max"), "kernel": (pShape, (1, 1)),
+                 "stride": (pShape, None), "pad": (pShape, None),
+                 "global_pool": (pBool, False),
+                 "pooling_convention": (pStr, "valid"),
+                 "cudnn_off": (pBool, False)})
+
+# ---------------------------------------------------------------------------
+# BatchNorm (ref: batch_norm-inl.h). inputs: data, gamma, beta; aux:
+# moving_mean, moving_var. Outputs: (out, mean, var, new_mm, new_mv) — the
+# last two are state outputs the executor folds back into the aux arrays.
+# ---------------------------------------------------------------------------
+
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    ax = int(axis) % data.ndim
+    reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _train and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out.astype(data.dtype) * g.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return (out, mean.astype(data.dtype), var.astype(data.dtype),
+                new_mm, new_mv)
+    return out, new_mm, new_mv
+
+
+def _bn_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    ax = int(attrs.get("axis", 1)) % len(dshape)
+    c = (dshape[ax],)
+    filled = [dshape] + [c, c, c, c]
+    if attrs.get("output_mean_var"):
+        return filled, [dshape, c, c, c, c]
+    return filled, [dshape, c, c]
+
+
+register("BatchNorm", _batch_norm,
+         input_names=("data", "gamma", "beta"),
+         aux_names=("moving_mean", "moving_var"),
+         num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+         mutate_map=(3, 4),
+         takes_train_flag=True,
+         infer_shape=_bn_infer_shape,
+         aliases=("BatchNorm_v1",),
+         params={"eps": (pFloat, 1e-3), "momentum": (pFloat, 0.9),
+                 "fix_gamma": (pBool, True), "use_global_stats": (pBool, False),
+                 "output_mean_var": (pBool, False), "axis": (pInt, 1),
+                 "cudnn_off": (pBool, False)})
+
+
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def _in_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    return [dshape, (dshape[1],), (dshape[1],)], [dshape]
+
+
+register("InstanceNorm", _instance_norm, input_names=("data", "gamma", "beta"),
+         infer_shape=_in_infer_shape, params={"eps": (pFloat, 1e-3)})
+
+
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        n = jnp.sqrt(jnp.sum(jnp.square(data.reshape(data.shape[0], -1)), axis=1) + eps)
+        return data / n.reshape((-1,) + (1,) * (data.ndim - 1))
+    if mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+        return data / n
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=(1,), keepdims=True) + eps)  # spatial
+    return data / n
+
+
+register("L2Normalization", _l2_normalization, num_inputs=1,
+         params={"eps": (pFloat, 1e-10), "mode": (pStr, "instance")})
+
+
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = int(nsize) // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = jnp.zeros_like(sq)
+    for i in range(int(nsize)):
+        window = window + lax.dynamic_slice_in_dim(padded, i, sq.shape[1], axis=1)
+    norm = jnp.power(knorm + alpha * window, beta)
+    return data / norm
+
+
+register("LRN", _lrn, num_inputs=1,
+         params={"alpha": (pFloat, 1e-4), "beta": (pFloat, 0.75),
+                 "knorm": (pFloat, 2.0), "nsize": (pInt, 5)})
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: dropout-inl.h) — functional RNG key threaded by dispatch
+# ---------------------------------------------------------------------------
+
+def _dropout(key, data, p=0.5, mode="training", axes=None, _train=False):
+    if not _train and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(data.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+register("Dropout", _dropout, num_inputs=1, needs_rng=True, takes_train_flag=True,
+         params={"p": (pFloat, 0.5), "mode": (pStr, "training"),
+                 "axes": (pShape, None)})
+
+# ---------------------------------------------------------------------------
+# Embedding (ref: indexing_op.h) — gather; grad is scatter-add (XLA native)
+# ---------------------------------------------------------------------------
+
+def _embedding(data, weight, input_dim=1, output_dim=1, dtype="float32",
+               sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+def _embedding_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    filled = list(in_shapes)
+    filled[1] = (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    if dshape is None:
+        return filled, [None]
+    return filled, [tuple(dshape) + (int(attrs["output_dim"]),)]
+
+
+register("Embedding", _embedding, input_names=("data", "weight"),
+         infer_shape=_embedding_infer_shape,
+         params={"input_dim": (pInt, 1), "output_dim": (pInt, 1),
+                 "dtype": (pDtype, "float32"), "sparse_grad": (pBool, False)})
+
+# ---------------------------------------------------------------------------
+# UpSampling (nearest / bilinear-ish via resize)
+# ---------------------------------------------------------------------------
+
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=512):
+    data = args[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+    return out
+
+
+register("UpSampling", _upsampling, num_inputs=None, key_var_num_args="num_args",
+         params={"scale": (pInt, 1), "sample_type": (pStr, "nearest"),
+                 "num_args": (pInt, 1), "num_filter": (pInt, 0),
+                 "multi_input_mode": (pStr, "concat"), "workspace": (pInt, 512)})
+
+# ---------------------------------------------------------------------------
+# Loss heads with reference-exact custom backward
+# (ref: softmax_output-inl.h:158-257, regression_output-inl.h:106-119)
+# ---------------------------------------------------------------------------
+
+def _softmax_fwd(data, label, multi_output, preserve_shape):
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    if preserve_shape:
+        return jax.nn.softmax(data, axis=-1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_grad(out, label, grad_scale, ignore_label, use_ignore,
+                         normalization, multi_output):
+    if multi_output:
+        # data: (n, k, x...); label: (n, x...)
+        k = out.shape[1]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, k, dtype=out.dtype, axis=1)
+        grad = out - onehot
+        valid = jnp.ones(lab.shape, out.dtype)
+        if use_ignore:
+            valid = (label != ignore_label).astype(out.dtype)
+            grad = grad * valid[:, None]
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            grad = grad / jnp.maximum(valid.sum(), 1.0)
+        return grad * grad_scale
+    k = out.shape[-1]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, k, dtype=out.dtype)
+    grad = out - onehot.reshape(out.shape)
+    valid = jnp.ones(lab.shape, out.dtype)
+    if use_ignore:
+        valid = (label != ignore_label).astype(out.dtype)
+        grad = grad * valid.reshape(valid.shape + (1,) * (grad.ndim - valid.ndim))
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid":
+        grad = grad / jnp.maximum(valid.sum(), 1.0)
+    return grad * grad_scale
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _softmax_output_core(grad_scale, ignore_label, use_ignore, normalization,
+                         multi_output, preserve_shape):
+    """custom_vjp core per static-attr combination; MXNet semantics: the head
+    gradient is ignored — SoftmaxOutput *is* the loss."""
+
+    @jax.custom_vjp
+    def core(data, label):
+        return _softmax_fwd(data, label, multi_output, preserve_shape)
+
+    def fwd(data, label):
+        out = _softmax_fwd(data, label, multi_output, preserve_shape)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        grad = _softmax_output_grad(out, label, grad_scale, ignore_label,
+                                    use_ignore, normalization, multi_output)
+        return (grad.astype(out.dtype), jnp.zeros_like(label))
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    core = _softmax_output_core(grad_scale, ignore_label, use_ignore,
+                                normalization, multi_output, preserve_shape)
+    return core(data, label)
+
+
+def _softmax_output_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    if attrs.get("multi_output", False):
+        filled[1] = (dshape[0],) + tuple(dshape[2:])
+    else:
+        filled[1] = (dshape[0],)
+    return filled, [dshape]
+
+
+register("SoftmaxOutput", _softmax_output, input_names=("data", "label"),
+         infer_shape=_softmax_output_infer_shape,
+         aliases=("Softmax",),
+         params={"grad_scale": (pFloat, 1.0), "ignore_label": (pFloat, -1.0),
+                 "multi_output": (pBool, False), "use_ignore": (pBool, False),
+                 "preserve_shape": (pBool, False),
+                 "normalization": (pStr, "null"), "out_grad": (pBool, False),
+                 "smooth_alpha": (pFloat, 0.0)})
+
+
+def _regression_core(link, grad_fn, name):
+    @_functools.lru_cache(maxsize=None)
+    def factory(grad_scale):
+        @jax.custom_vjp
+        def core(data, label):
+            return link(data)
+
+        def fwd(data, label):
+            out = link(data)
+            return out, (out, label)
+
+        def bwd(res, g):
+            out, label = res
+            # ref: regression_output-inl.h:119 — scale grad_scale/num_output
+            num_output = int(np.prod(out.shape[1:])) if out.ndim > 1 else 1
+            grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / num_output)
+            return (grad.astype(out.dtype), jnp.zeros_like(label))
+
+        core.defvjp(fwd, bwd)
+        return core
+
+    factory.__name__ = name
+    return factory
+
+
+_linear_reg = _regression_core(lambda x: x, lambda o, l: o - l, "linear_reg")
+_mae_reg = _regression_core(lambda x: x, lambda o, l: jnp.sign(o - l), "mae_reg")
+_logistic_reg = _regression_core(jax.nn.sigmoid, lambda o, l: o - l, "logistic_reg")
+
+
+def _reg_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    if filled[1] is None:
+        filled[1] = dshape if len(dshape) != 2 or dshape[1] != 1 else (dshape[0],)
+        filled[1] = dshape
+    return filled, [dshape]
+
+
+for _name, _core in (("LinearRegressionOutput", _linear_reg),
+                     ("MAERegressionOutput", _mae_reg),
+                     ("LogisticRegressionOutput", _logistic_reg)):
+    register(_name,
+             (lambda factory: lambda data, label, grad_scale=1.0:
+              factory(grad_scale)(data, label))(_core),
+             input_names=("data", "label"), infer_shape=_reg_infer_shape,
+             params={"grad_scale": (pFloat, 1.0)})
+
+
+@_functools.lru_cache(maxsize=None)
+def _make_loss_core(grad_scale):
+    @jax.custom_vjp
+    def core(data):
+        return data
+
+    def fwd(data):
+        return data, data  # residual only carries shape/dtype; XLA DCEs it
+
+    def bwd(res, g):
+        return (jnp.full_like(res, grad_scale),)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def _make_loss_op(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return _make_loss_core(grad_scale)(data)
+
+
+register("MakeLoss", _make_loss_op, num_inputs=1,
+         params={"grad_scale": (pFloat, 1.0), "valid_thresh": (pFloat, 0.0),
+                 "normalization": (pStr, "null")})
+
+
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    return data
+
+
+register("SVMOutput", _svm_output, input_names=("data", "label"),
+         infer_shape=_softmax_output_infer_shape,
+         params={"margin": (pFloat, 1.0),
+                 "regularization_coefficient": (pFloat, 1.0),
+                 "use_linear": (pBool, False)})
+
+# ---------------------------------------------------------------------------
+# Sequence ops (ref: sequence_last/mask/reverse-inl.h); data layout TNC
+# ---------------------------------------------------------------------------
+
+def _seq_last(data, *rest, use_sequence_length=False, axis=0):
+    if not use_sequence_length:
+        return jnp.take(data, data.shape[int(axis)] - 1, axis=int(axis))
+    seqlen = rest[0].astype(jnp.int32)
+    idx = seqlen - 1
+    if int(axis) == 0:
+        return data[idx, jnp.arange(data.shape[1])]
+    return data[jnp.arange(data.shape[0]), idx]
+
+
+register("SequenceLast", _seq_last, input_names=("data", "sequence_length"),
+         params={"use_sequence_length": (pBool, False), "axis": (pInt, 0)})
+
+
+def _seq_mask(data, *rest, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length:
+        return data
+    seqlen = rest[0].astype(jnp.int32)
+    T = data.shape[int(axis)]
+    t = jnp.arange(T)
+    if int(axis) == 0:
+        mask = t[:, None] < seqlen[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = t[None, :] < seqlen[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+register("SequenceMask", _seq_mask, input_names=("data", "sequence_length"),
+         params={"use_sequence_length": (pBool, False), "value": (pFloat, 0.0),
+                 "axis": (pInt, 0)})
+
+
+def _seq_reverse(data, *rest, use_sequence_length=False, axis=0):
+    if not use_sequence_length:
+        return jnp.flip(data, 0)
+    seqlen = rest[0].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(t < seqlen[None, :], seqlen[None, :] - 1 - t, t)
+    return jnp.take_along_axis(
+        data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+register("SequenceReverse", _seq_reverse, input_names=("data", "sequence_length"),
+         params={"use_sequence_length": (pBool, False), "axis": (pInt, 0)})

@@ -1,0 +1,162 @@
+"""Fused optimizer update operators.
+
+TPU-native rebuild of src/operator/optimizer_op*.{cc,cu} (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, ...).  In the reference these
+are CUDA kernels that mutate weight/state in place; here each is a pure XLA
+computation returning the new weight (and new state); the dispatch layer
+rebinds the mutated NDArray handles (Op.mutate_inputs), so the Python-level
+Optimizer API behaves identically.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, pFloat, pBool
+
+
+def _clip(g, clip_gradient):
+    if clip_gradient is not None and clip_gradient >= 0:
+        return jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+_COMMON = {"lr": (pFloat, 0.01), "wd": (pFloat, 0.0),
+           "rescale_grad": (pFloat, 1.0), "clip_gradient": (pFloat, -1.0)}
+
+
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+register("sgd_update", _sgd_update, num_inputs=2,
+         params=dict(_COMMON, lazy_update=(pBool, True)))
+
+
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+register("sgd_mom_update", _sgd_mom_update, num_inputs=3, mutate_map=(2,),
+         params=dict(_COMMON, momentum=(pFloat, 0.0), lazy_update=(pBool, True)))
+
+
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+register("mp_sgd_update", _mp_sgd_update, num_inputs=3, mutate_map=(2,),
+         params=dict(_COMMON, lazy_update=(pBool, True)))
+
+
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+register("mp_sgd_mom_update", _mp_sgd_mom_update, num_inputs=4, mutate_map=(2, 3),
+         params=dict(_COMMON, momentum=(pFloat, 0.0), lazy_update=(pBool, True)))
+
+
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+register("adam_update", _adam_update, num_inputs=4, mutate_map=(2, 3),
+         params=dict(_COMMON, lr=(pFloat, 0.001), beta1=(pFloat, 0.9),
+                     beta2=(pFloat, 0.999), epsilon=(pFloat, 1e-8),
+                     lazy_update=(pBool, True)))
+
+
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+register("rmsprop_update", _rmsprop_update, num_inputs=3, mutate_map=(2,),
+         params=dict(_COMMON, lr=(pFloat, 0.001), gamma1=(pFloat, 0.95),
+                     epsilon=(pFloat, 1e-8), clip_weights=(pFloat, -1.0)))
+
+
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    grd = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(grd) + gamma1 * n
+    new_g = (1 - gamma1) * grd + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * grd / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+register("rmspropalex_update", _rmspropalex_update, num_inputs=5, mutate_map=(2, 3, 4),
+         params=dict(_COMMON, lr=(pFloat, 0.001), gamma1=(pFloat, 0.95),
+                     gamma2=(pFloat, 0.9), epsilon=(pFloat, 1e-8),
+                     clip_weights=(pFloat, -1.0)))
+
+
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return new_w, new_z, new_n
+
+
+register("ftrl_update", _ftrl_update, num_inputs=4, mutate_map=(2, 3),
+         params=dict(_COMMON, lr=(pFloat, 0.1), lamda1=(pFloat, 0.01),
+                     beta=(pFloat, 1.0)))
+
+
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+register("signsgd_update", _signsgd_update, num_inputs=2,
+         params=_COMMON)
+
+
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+register("signum_update", _signum_update, num_inputs=3, mutate_map=(2,),
+         params=dict(_COMMON, momentum=(pFloat, 0.0), wd_lh=(pFloat, 0.0)))

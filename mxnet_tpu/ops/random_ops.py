@@ -1,0 +1,170 @@
+"""Random sampling operators.
+
+TPU-native rebuild of src/operator/random/ (sample_uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial,
+multinomial).  The reference draws from a per-context PRNG resource
+(ResourceRequest::kRandom); here every op takes a functional jax PRNG key
+threaded by the dispatch layer (ops/registry needs_rng), giving the same
+`mx.random.seed` observable semantics with reproducible, parallel-safe
+streams.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register, pShape, pFloat, pInt, pBool, pStr, pDtype
+
+_SAMPLE_PARAMS = {"shape": (pShape, None), "ctx": (pStr, None),
+                  "dtype": (pDtype, None)}
+
+
+def _shape_of(shape):
+    return shape if shape else (1,)
+
+
+def _uniform(key, low=0.0, high=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    return jax.random.uniform(key, _shape_of(shape), dt, low, high)
+
+
+register("_random_uniform", _uniform, num_inputs=0, needs_rng=True,
+         aliases=("uniform", "random_uniform", "_sample_uniform"),
+         params=dict(_SAMPLE_PARAMS, low=(pFloat, 0.0), high=(pFloat, 1.0)))
+
+
+def _normal(key, loc=0.0, scale=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    return jax.random.normal(key, _shape_of(shape), dt) * scale + loc
+
+
+register("_random_normal", _normal, num_inputs=0, needs_rng=True,
+         aliases=("normal", "random_normal", "_sample_normal"),
+         params=dict(_SAMPLE_PARAMS, loc=(pFloat, 0.0), scale=(pFloat, 1.0)))
+
+
+def _gamma(key, alpha=1.0, beta=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    return jax.random.gamma(key, alpha, _shape_of(shape), dt) * beta
+
+
+register("_random_gamma", _gamma, num_inputs=0, needs_rng=True,
+         aliases=("random_gamma",),
+         params=dict(_SAMPLE_PARAMS, alpha=(pFloat, 1.0), beta=(pFloat, 1.0)))
+
+
+def _exponential(key, lam=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    return jax.random.exponential(key, _shape_of(shape), dt) / lam
+
+
+register("_random_exponential", _exponential, num_inputs=0, needs_rng=True,
+         aliases=("random_exponential",),
+         params=dict(_SAMPLE_PARAMS, lam=(pFloat, 1.0)))
+
+
+def _poisson(key, lam=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    return jax.random.poisson(key, lam, _shape_of(shape)).astype(dt)
+
+
+register("_random_poisson", _poisson, num_inputs=0, needs_rng=True,
+         aliases=("random_poisson",),
+         params=dict(_SAMPLE_PARAMS, lam=(pFloat, 1.0)))
+
+
+def _negative_binomial(key, k=1, p=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), _shape_of(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape_of(shape)).astype(dt)
+
+
+register("_random_negative_binomial", _negative_binomial, num_inputs=0,
+         needs_rng=True, aliases=("random_negative_binomial",),
+         params=dict(_SAMPLE_PARAMS, k=(pInt, 1), p=(pFloat, 1.0)))
+
+
+def _gen_negative_binomial(key, mu=1.0, alpha=1.0, shape=None, ctx=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, _shape_of(shape)) * (mu * alpha)
+    return jax.random.poisson(k2, lam, _shape_of(shape)).astype(dt)
+
+
+register("_random_generalized_negative_binomial", _gen_negative_binomial,
+         num_inputs=0, needs_rng=True,
+         aliases=("random_generalized_negative_binomial",),
+         params=dict(_SAMPLE_PARAMS, mu=(pFloat, 1.0), alpha=(pFloat, 1.0)))
+
+
+def _randint(key, low=0, high=1, shape=None, ctx=None, dtype="int32"):
+    return jax.random.randint(key, _shape_of(shape), int(low), int(high),
+                              np_dtype(dtype or "int32"))
+
+
+register("_random_randint", _randint, num_inputs=0, needs_rng=True,
+         params=dict(_SAMPLE_PARAMS, low=(pInt, 0), high=(pInt, 1)))
+
+
+def _multinomial(key, data, shape=None, get_prob=False, dtype="int32"):
+    n = int(shape[0]) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+    out = out.astype(np_dtype(dtype))
+    if shape is None or shape == ():
+        out = out.reshape(data.shape[:-1] if data.ndim > 1 else ())
+    if get_prob:
+        prob = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            out.astype(jnp.int32).reshape(out.shape[-1:] if data.ndim == 1 else out.shape),
+            axis=-1)
+        return out, prob.astype(jnp.float32)
+    return out
+
+
+register("_sample_multinomial", _multinomial, num_inputs=1, needs_rng=True,
+         aliases=("sample_multinomial",),
+         num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1,
+         params={"shape": (pShape, None), "get_prob": (pBool, False),
+                 "dtype": (pDtype, "int32")})
+
+
+# Tensor-parameter sampling (sample_uniform w/ per-element params)
+def _sample_uniform_t(key, low, high, shape=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    s = tuple(shape) if shape else ()
+    out_shape = low.shape + s
+    u = jax.random.uniform(key, out_shape, dt)
+    bshape = low.shape + (1,) * len(s)
+    return u * (high.reshape(bshape) - low.reshape(bshape)) + low.reshape(bshape)
+
+
+register("_sample_uniform_tensor", _sample_uniform_t, num_inputs=2, needs_rng=True,
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_normal_t(key, mu, sigma, shape=None, dtype=None):
+    dt = np_dtype(dtype or "float32")
+    s = tuple(shape) if shape else ()
+    out_shape = mu.shape + s
+    bshape = mu.shape + (1,) * len(s)
+    return jax.random.normal(key, out_shape, dt) * sigma.reshape(bshape) + mu.reshape(bshape)
+
+
+register("_sample_normal_tensor", _sample_normal_t, num_inputs=2, needs_rng=True,
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+register("_shuffle", _shuffle, num_inputs=1, needs_rng=True,
+         aliases=("shuffle",))

@@ -1,0 +1,211 @@
+"""Operator registry + imperative jit-cache dispatch.
+
+TPU-native replacement of the NNVM op registry + imperative dispatch path
+(ref: include/mxnet/op_attr_types.h FCompute/FComputeEx registration;
+src/imperative/imperative_utils.h:338 PushFCompute).  Where the reference
+pushes each op into a threaded dependency engine that launches a CUDA kernel,
+here every op is a pure JAX function; imperative dispatch goes through a
+`jax.jit` cache keyed on (op, attrs) — XLA's async dispatch replaces the
+engine's worker threads, and `jax.Array` dependency tracking replaces
+read/write var queues.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..base import MXNetError, attr_to_str, shape_attr, str_to_attr, np_dtype
+
+# ---------------------------------------------------------------------------
+# Attr type converters (dmlc::Parameter reflection equivalent)
+# ---------------------------------------------------------------------------
+
+def pShape(v):
+    return shape_attr(v)
+
+
+def pInt(v):
+    if isinstance(v, str):
+        v = str_to_attr(v)
+    return int(v)
+
+
+def pFloat(v):
+    if isinstance(v, str):
+        v = str_to_attr(v)
+    return float(v)
+
+
+def pBool(v):
+    if isinstance(v, str):
+        v = str_to_attr(v)
+    return bool(v)
+
+
+def pStr(v):
+    return str(v)
+
+
+def pDtype(v):
+    from ..base import dtype_name
+    return dtype_name(np_dtype(v)) if v is not None else None
+
+
+def pAny(v):
+    return str_to_attr(v) if isinstance(v, str) else v
+
+
+class Op:
+    """A registered operator.
+
+    impl: pure function (*jax_arrays, **attrs) -> array | tuple of arrays.
+    params: {attr_name: (converter, default)}; attrs not listed are rejected.
+    infer_shape: optional fn(in_shapes, attrs) -> (in_shapes, out_shapes)
+        supporting *backward* inference (filling in None input shapes from
+        known ones — how MXNet infers weight shapes from data,
+        ref: src/executor/infer_graph_attr_pass.cc).
+    needs_rng: impl takes a jax PRNG key as first positional argument.
+    mutate_inputs: indices of inputs the op updates in place at the NDArray
+        level (optimizer ops; ref: FMutateInputs).  impl still returns the
+        new values functionally; the dispatch layer rebinds the handles.
+    """
+
+    def __init__(self, name, impl, params=None, num_inputs=None, num_outputs=1,
+                 infer_shape=None, infer_type=None, needs_rng=False,
+                 mutate_map=(), input_names=None, aux_names=(),
+                 takes_train_flag=False,
+                 key_var_num_args=None, aliases=(), doc=""):
+        self.name = name
+        self.impl = impl
+        self.params = params or {}
+        if num_inputs is None and input_names is not None:
+            num_inputs = len(input_names) + len(aux_names)
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.infer_shape = infer_shape
+        self.infer_type = infer_type
+        self.needs_rng = needs_rng
+        # trailing impl outputs (beyond the visible num_outputs) rebind these
+        # input indices — in-place state updates (optimizer mom, BatchNorm
+        # moving stats; ref: FMutateInputs op_attr_types.h)
+        self.mutate_map = tuple(mutate_map)
+        self.input_names = input_names
+        self.aux_names = tuple(aux_names)
+        # impl takes a `_train` kwarg distinguishing train/predict mode
+        self.takes_train_flag = takes_train_flag
+        self.key_var_num_args = key_var_num_args  # e.g. num_args for Concat
+        self.aliases = aliases
+        self.doc = doc
+
+    def normalize_attrs(self, attrs):
+        """Convert raw (possibly string) attrs into typed python values."""
+        out = {}
+        for k, v in attrs.items():
+            if k in ("name", "__ctx_group__", "ctx_group"):
+                continue
+            if k.startswith("__") and k.endswith("__"):
+                continue  # symbol-level attrs (e.g. __shape__, lr_mult)
+            if k not in self.params:
+                raise MXNetError("%s: unknown attr %r" % (self.name, k))
+            conv, _ = self.params[k]
+            out[k] = conv(v) if v is not None else None
+        for k, (conv, default) in self.params.items():
+            if k not in out:
+                out[k] = default
+        return out
+
+    def attrs_to_strs(self, attrs):
+        return {k: attr_to_str(v) for k, v in attrs.items() if v is not None}
+
+    def str_outputs(self, attrs):
+        n = self.num_outputs
+        return n(attrs) if callable(n) else n
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+_REGISTRY = {}
+
+
+def register(name, impl=None, **kwargs):
+    """Register an op.  Usable as a decorator or a direct call."""
+
+    def _do(impl_fn):
+        op = Op(name, impl_fn, **kwargs)
+        _REGISTRY[name] = op
+        for alias in op.aliases:
+            _REGISTRY[alias] = op
+        return impl_fn
+
+    if impl is not None:
+        return _do(impl)
+    return _do
+
+
+def get_op(name):
+    op = _REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("operator %r is not registered" % name)
+    return op
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+def op_registry():
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Imperative dispatch: the jax.jit cache
+# ---------------------------------------------------------------------------
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted(op_name, frozen_attrs):
+    """One compiled callable per (op, attrs); jax.jit caches per shape/dtype
+    underneath — this is the analog of the reference's cached Engine operators
+    (graph_executor.cc:1221 InitCachedOps) without the launch-overhead tax."""
+    op = _REGISTRY[op_name]
+    attrs = dict(frozen_attrs)
+    impl = op.impl
+
+    def call(*arrays):
+        return impl(*arrays, **attrs)
+
+    return jax.jit(call)
+
+
+def apply_op(op, inputs, attrs):
+    """Run an op's impl on raw jax arrays with normalized attrs. Returns tuple."""
+    fn = _jitted(op.name, _freeze(attrs))
+    out = fn(*inputs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return tuple(out)
+
+
+def eval_shape_op(op, in_shapes, in_dtypes, attrs):
+    """Forward shape/dtype inference via jax.eval_shape (all inputs known)."""
+    structs = [jax.ShapeDtypeStruct(s, np_dtype(d)) for s, d in zip(in_shapes, in_dtypes)]
+    if op.needs_rng:
+        structs = [jax.ShapeDtypeStruct((2,), np.uint32)] + structs
+
+    def call(*arrays):
+        return op.impl(*arrays, **attrs)
+
+    out = jax.eval_shape(call, *structs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return [tuple(o.shape) for o in out], [o.dtype for o in out]

@@ -1,0 +1,80 @@
+"""Profiler (ref: src/engine/profiler.{h,cc} + python/mxnet/profiler.py).
+
+Two layers, like the reference:
+- op-span layer: our own events (imperative invokes, executor forwards)
+  dumped as Chrome trace-event JSON (chrome://tracing), format-compatible
+  with the reference's DumpProfile (profiler.cc:147).
+- device layer: jax.profiler XPlane traces for kernel-level detail
+  (start_jax_trace/stop_jax_trace).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False}
+_events = []
+_lock = threading.Lock()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    if state == "run":
+        _state["running"] = True
+    else:
+        _state["running"] = False
+        dump_profile()
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_event(name, start_us, end_us, category="operator", dev="cpu/0",
+                 tid=0):
+    if not _state["running"]:
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "B",
+                        "ts": start_us, "pid": dev, "tid": tid})
+        _events.append({"name": name, "cat": category, "ph": "E",
+                        "ts": end_us, "pid": dev, "tid": tid})
+
+
+class record_span:
+    def __init__(self, name, category="operator", dev="cpu/0"):
+        self.name = name
+        self.category = category
+        self.dev = dev
+
+    def __enter__(self):
+        self.t0 = time.time() * 1e6
+        return self
+
+    def __exit__(self, *args):
+        record_event(self.name, self.t0, time.time() * 1e6, self.category,
+                     self.dev)
+
+
+def dump_profile():
+    """Write Chrome trace-event JSON (ref: DumpProfile profiler.cc:147)."""
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        with open(_state["filename"], "w") as f:
+            json.dump(payload, f)
+
+
+def start_jax_trace(logdir="/tmp/mxnet_tpu_trace"):
+    import jax
+    jax.profiler.start_trace(logdir)
+    return logdir
+
+
+def stop_jax_trace():
+    import jax
+    jax.profiler.stop_trace()

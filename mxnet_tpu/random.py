@@ -1,0 +1,42 @@
+"""Global random state.
+
+The reference gives every context a PRNG resource (ResourceRequest::kRandom,
+src/resource.cc:87) seeded by mx.random.seed (MXRandomSeed).  TPU-natively we
+keep one root jax PRNG key; every random op invocation consumes a fresh split
+(functional, reproducible, parallel-safe).  `mx.random.seed(n)` resets the
+root key — same observable semantics.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state
+
+
+def seed(seed_state):
+    """Seed the global random number generators (ref: mx.random.seed)."""
+    _get().key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) & 0x7FFFFFFF)
+
+
+def next_key():
+    st = _get()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def current_key():
+    return _get().key
+
+
+# op-level frontends (populated by ndarray namespace gen): uniform, normal, ...

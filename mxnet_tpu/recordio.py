@@ -1,0 +1,204 @@
+"""RecordIO: packed binary record files (ref: python/mxnet/recordio.py +
+dmlc/recordio.h).  Same on-disk format as the reference: records framed with
+the dmlc magic number + length, and the IRHeader image-record struct, so
+.rec/.idx files pack/unpack identically.  (The C++ fast path lives in
+mxnet_tpu/io_native.)"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+
+
+def _pack_record(data):
+    length = len(data)
+    header = struct.pack("<II", _MAGIC, length)
+    pad = (4 - length % 4) % 4
+    return header + data + b"\x00" * pad
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def write(self, buf):
+        assert self.writable
+        self.handle.write(_pack_record(buf))
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, length = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic in %s" % self.uri)
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed record IO supporting random read (ref: recordio.py:170)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            self.fidx = open(self.idx_path, "r")
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + data into a record payload (ref: recordio.py:291)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(flag=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        s = s[header.flag * 4:]
+        header = header._replace(label=label)
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), iscolor)
+    except ImportError:
+        from io import BytesIO
+        try:
+            from PIL import Image
+            img = np.asarray(Image.open(BytesIO(buf)))
+            if img.ndim == 3:
+                img = img[:, :, ::-1]  # RGB -> BGR, cv2 convention
+            return img
+        except ImportError:
+            raise MXNetError("no image decoder available (cv2/PIL)")
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    try:
+        import cv2
+        ret, buf = cv2.imencode(img_fmt, img,
+                                [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ret
+        return buf.tobytes()
+    except ImportError:
+        from io import BytesIO
+        from PIL import Image
+        bio = BytesIO()
+        arr = img[:, :, ::-1] if img.ndim == 3 else img
+        Image.fromarray(arr).save(bio, format="JPEG", quality=quality)
+        return bio.getvalue()

@@ -1,0 +1,1 @@
+"""RNN cells + BucketSentenceIter (ref: python/mxnet/rnn/)."""

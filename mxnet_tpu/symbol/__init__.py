@@ -1,0 +1,57 @@
+"""mx.sym namespace: Symbol + op functions generated from the registry
+(the analog of python/mxnet/symbol/register.py codegen)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .symbol import (  # noqa: F401
+    Symbol, var, Variable, Group, load, load_json, zeros, ones, arange,
+    NameManager, AttrScope, _create,
+)
+
+
+def _make_sym_func(canonical, op):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("out", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
+                inputs.extend(a)
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        attr_extra = attrs.pop("attr", None)
+        if sym_kwargs:
+            order = tuple(op.input_names or ()) + tuple(op.aux_names or ())
+            for n in order:
+                if n in sym_kwargs:
+                    inputs.append(sym_kwargs.pop(n))
+            inputs.extend(sym_kwargs.values())
+        out = _create(canonical, inputs, attrs, name=name)
+        if attr_extra:
+            out._set_attr(**attr_extra)
+        return out
+
+    fn.__name__ = canonical
+    fn.__doc__ = op.doc or ("%s (auto-generated symbol op)" % canonical)
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name, _op in list(_registry.op_registry().items()):
+    if not _name.replace("_", "a").isidentifier():
+        continue
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_func(_name, _op))
+
+
+def __getattr__(name):
+    _tbl = _registry.op_registry()
+    if name in _tbl:
+        f = _make_sym_func(name, _tbl[name])
+        setattr(_mod, name, f)
+        return f
+    raise AttributeError("module 'mxnet_tpu.symbol' has no attribute %r" % name)

@@ -1,0 +1,606 @@
+"""Symbol: the symbolic graph API.
+
+TPU-native rebuild of nnvm::Symbol + python/mxnet/symbol/symbol.py.  A Symbol
+is a list of output entries over a DAG of _Node records; composition
+auto-creates weight/aux variables exactly like nnvm does (missing op inputs
+become `{name}_{input_name}` variables).  Where the reference binds a graph
+through GraphExecutor -> engine pushes per node, here bind() lowers the whole
+graph to ONE jitted XLA computation (see executor.py) — the north-star
+design: memory planning, fusion and scheduling delegate to XLA.
+
+JSON layout mirrors nnvm::SaveJSON ({"nodes": [...], "arg_nodes": [...],
+"heads": [...]}) so checkpoint files keep the reference's two-artifact shape
+(ref: src/nnvm usage in legacy_json_util.cc, Symbol.save symbol.py:~).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from ..base import MXNetError, attr_to_str, np_dtype, dtype_name
+from ..context import current_context
+from ..ops.registry import get_op, op_registry, eval_shape_op
+
+
+class NameManager:
+    """Auto-naming for anonymous op nodes (ref: python/mxnet/name.py)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    @classmethod
+    def current(cls):
+        if not hasattr(cls._current, "value"):
+            cls._current.value = NameManager()
+        return cls._current.value
+
+    def __enter__(self):
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        self._old = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        NameManager._current.value = self._old
+
+
+class AttrScope:
+    """with mx.AttrScope(ctx_group='dev1'): ... (ref: python/mxnet/attribute.py)"""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = kwargs
+
+    def get(self, attr):
+        base = dict(getattr(AttrScope._current, "value", AttrScope())._attr) \
+            if hasattr(AttrScope._current, "value") else {}
+        if attr:
+            base.update(attr)
+        return base
+
+    @classmethod
+    def current(cls):
+        if not hasattr(cls._current, "value"):
+            cls._current.value = AttrScope()
+        return cls._current.value
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old = AttrScope._current.value
+        merged = dict(self._old._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        AttrScope._current.value = self._old
+
+
+class _Node:
+    """Graph node: op application or variable (op_name None)."""
+
+    __slots__ = ("op_name", "name", "attrs", "inputs", "_is_aux")
+
+    def __init__(self, op_name, name, attrs=None, inputs=None):
+        self.op_name = op_name
+        self.name = name
+        self.attrs = dict(attrs or {})   # string attrs (JSON-compatible)
+        self.inputs = list(inputs or []) # [(node, out_idx)]
+        self._is_aux = False
+
+    @property
+    def is_var(self):
+        return self.op_name is None
+
+    def num_outputs(self):
+        if self.is_var:
+            return 1
+        op = get_op(self.op_name)
+        n = op.num_outputs
+        if callable(n):
+            return n(op.normalize_attrs(self.attrs))
+        return n
+
+
+class Symbol:
+    def __init__(self, entries):
+        self._entries = list(entries)  # [(node, out_idx)]
+
+    # -- graph walks ---------------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for n, _ in node.inputs:
+                visit(n)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def _mark_aux(self, order=None):
+        """Determine which variables are auxiliary states: they feed an aux
+        input slot (ref: nnvm mutable inputs)."""
+        order = order or self._topo()
+        aux = set()
+        for node in order:
+            if node.is_var:
+                continue
+            op = get_op(node.op_name)
+            n_main = len(op.input_names) if op.input_names else None
+            if op.aux_names and n_main is not None:
+                for i, (inp, _) in enumerate(node.inputs):
+                    if i >= n_main and inp.is_var:
+                        inp._is_aux = True
+                        aux.add(inp.name)
+        return aux
+
+    def list_arguments(self):
+        order = self._topo()
+        self._mark_aux(order)
+        return [n.name for n in order if n.is_var and not n._is_aux]
+
+    def list_auxiliary_states(self):
+        order = self._topo()
+        self._mark_aux(order)
+        return [n.name for n in order if n.is_var and n._is_aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._entries:
+            if node.is_var:
+                out.append(node.name)
+                continue
+            op = get_op(node.op_name)
+            n = node.num_outputs()
+            if n == 1:
+                out.append(node.name + "_output")
+            else:
+                # multi-output suffixes follow the reference convention
+                suffix = {"BatchNorm": ["output", "mean", "var"],
+                          "topk": ["output", "indices"]}.get(node.op_name)
+                if suffix and idx < len(suffix):
+                    out.append("%s_%s" % (node.name, suffix[idx]))
+                else:
+                    out.append("%s_output%d" % (node.name, idx))
+        return out
+
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    # -- attrs ---------------------------------------------------------------
+    def attr(self, key):
+        if len(self._entries) == 1:
+            return self._entries[0][0].attrs.get(key)
+        return None
+
+    def list_attr(self, recursive=False):
+        if recursive:
+            return self.attr_dict()
+        if len(self._entries) == 1:
+            return dict(self._entries[0][0].attrs)
+        return {}
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            if node.attrs:
+                out[node.name] = dict(node.attrs)
+        return out
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._entries:
+            node.attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # -- composition ---------------------------------------------------------
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            outs = self.list_outputs()
+            if index not in outs:
+                raise MXNetError("cannot find output %r" % index)
+            index = outs.index(index)
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._entries)))
+
+    def get_internals(self):
+        order = self._topo()
+        entries = []
+        for node in order:
+            for i in range(node.num_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        if len(self._entries) == 1:
+            node = self._entries[0][0]
+            if node.inputs:
+                return Symbol(list(node.inputs))
+        return None
+
+    # -- arithmetic ----------------------------------------------------------
+    def _binary(self, other, op_nd, op_sc, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _create(op_nd, [lhs, rhs], {})
+        return _create(op_sc, [self], {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        return self._binary(o, "elemwise_div", "_rdiv_scalar", reverse=True)
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __eq__(self, o):
+        return self._binary(o, "_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binary(o, "_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    def __copy__(self):
+        return Symbol(list(self._entries))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # -- inference -----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, _ = self._infer(known, {})
+        order = self._topo()
+        self._mark_aux(order)
+        arg_shapes = [shapes.get((_find_var(order, n), 0)) for n in arg_names]
+        aux_shapes = [shapes.get((_find_var(order, n), 0))
+                      for n in self.list_auxiliary_states()]
+        out_shapes = [shapes.get((node, idx)) for node, idx in self._entries]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("infer_shape incomplete; unknown: %s" % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    known[name] = dt
+        known.update({k: v for k, v in kwargs.items() if v is not None})
+        _, dtypes = self._infer({}, {k: np_dtype(v) for k, v in known.items()})
+        order = self._topo()
+        self._mark_aux(order)
+        arg_types = [dtypes.get((_find_var(order, n), 0)) for n in arg_names]
+        aux_types = [dtypes.get((_find_var(order, n), 0))
+                     for n in self.list_auxiliary_states()]
+        out_types = [dtypes.get((node, idx)) for node, idx in self._entries]
+        return arg_types, out_types, aux_types
+
+    def _infer(self, known_shapes, known_dtypes):
+        """Joint fixed-point shape+dtype inference over the graph
+        (ref: infer_graph_attr_pass.cc — same single-generic-pass idea)."""
+        order = self._topo()
+        shapes = {}
+        dtypes = {}
+        for node in order:
+            if node.is_var:
+                s = known_shapes.get(node.name)
+                if s is None and "__shape__" in node.attrs:
+                    from ..base import str_to_attr
+                    s = tuple(str_to_attr(node.attrs["__shape__"]))
+                shapes[(node, 0)] = tuple(s) if s is not None else None
+                dt = known_dtypes.get(node.name)
+                if dt is None and "__dtype__" in node.attrs:
+                    dt = np_dtype(node.attrs["__dtype__"])
+                dtypes[(node, 0)] = dt
+
+        for _ in range(10):
+            changed = False
+            for node in order:
+                if node.is_var:
+                    continue
+                op = get_op(node.op_name)
+                attrs = op.normalize_attrs(node.attrs)
+                if op.key_var_num_args and not attrs.get(op.key_var_num_args):
+                    attrs[op.key_var_num_args] = len(node.inputs)
+                in_entries = node.inputs
+                in_shapes = [shapes.get((n, i)) for n, i in in_entries]
+                in_dtypes = [dtypes.get((n, i)) for n, i in in_entries]
+                n_out = node.num_outputs()
+                n_state = len(op.mutate_map)
+                # already fully inferred?
+                if all(shapes.get((node, i)) is not None for i in range(n_out)) \
+                        and all(s is not None for s in in_shapes):
+                    continue
+                filled, out_shapes = None, None
+                if op.infer_shape is not None:
+                    try:
+                        filled, out_shapes = op.infer_shape(in_shapes, attrs)
+                    except Exception:
+                        filled = None
+                elif all(s is not None for s in in_shapes):
+                    dts = [d if d is not None else np.float32 for d in in_dtypes]
+                    a2 = {k: v for k, v in attrs.items() if k != "_train"}
+                    if op.takes_train_flag:
+                        a2["_train"] = True
+                    try:
+                        out_shapes_all, out_dts = eval_shape_op(op, in_shapes, dts, a2)
+                    except Exception:
+                        continue
+                    out_shapes = out_shapes_all
+                    for i in range(min(n_out, len(out_dts))):
+                        if dtypes.get((node, i)) is None:
+                            dtypes[(node, i)] = out_dts[i]
+                            changed = True
+                    filled = in_shapes
+                if filled is not None:
+                    for (n, i), s in zip(in_entries, filled):
+                        if s is not None and shapes.get((n, i)) is None:
+                            shapes[(n, i)] = tuple(s)
+                            changed = True
+                if out_shapes is not None:
+                    for i, s in enumerate(out_shapes[:n_out + n_state]):
+                        if s is not None and shapes.get((node, i)) is None:
+                            shapes[(node, i)] = tuple(s)
+                            changed = True
+                # dtype propagation: default = first known input dtype
+                known_dt = next((d for d in in_dtypes if d is not None), None)
+                if known_dt is not None:
+                    for i in range(n_out):
+                        if dtypes.get((node, i)) is None:
+                            dtypes[(node, i)] = known_dt
+                            changed = True
+                    for (n, i), d in zip(in_entries, in_dtypes):
+                        if d is None and dtypes.get((n, i)) is None:
+                            dtypes[(n, i)] = known_dt
+                            changed = True
+            if not changed:
+                break
+        # default dtype float32 for anything still unknown
+        return shapes, dtypes
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self):
+        order = self._topo()
+        nid = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry = {
+                "op": "null" if n.is_var else n.op_name,
+                "name": n.name,
+                "inputs": [[nid[id(src)], idx, 0] for src, idx in n.inputs],
+            }
+            if n.attrs:
+                entry["attrs"] = {k: str(v) for k, v in n.attrs.items()}
+            nodes.append(entry)
+        heads = [[nid[id(n)], idx, 0] for n, idx in self._entries]
+        arg_nodes = [i for i, n in enumerate(order) if n.is_var]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": [], "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10001]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation ----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx or current_context(), grad_req,
+                                     type_dict, kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx or current_context(), kwargs)
+        return ex.forward()
+
+    def tocsr(self):
+        raise MXNetError("not supported")
+
+
+def _find_var(order, name):
+    for n in order:
+        if n.is_var and n.name == name:
+            return n
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Symbol construction
+# ---------------------------------------------------------------------------
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (ref: mx.sym.Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = AttrScope.current().get(attr)
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = dtype_name(np_dtype(dtype))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        attrs["__init__"] = init
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    node = _Node(None, name, attrs)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def _create(op_name, sym_inputs, attrs, name=None):
+    """Compose an op node over input symbols; auto-create missing weight/aux
+    variables like nnvm composition does."""
+    op = get_op(op_name)
+    name = NameManager.current().get(name, op_name.strip("_"))
+    entries = []
+    for s in sym_inputs:
+        if len(s._entries) != 1:
+            raise MXNetError("cannot compose multi-output symbol as one input")
+        entries.append(s._entries[0])
+    # auto-create variables for missing named inputs
+    if op.input_names:
+        full = list(op.input_names) + list(op.aux_names)
+        nattrs = op.normalize_attrs(attrs)
+        n_expected = len(full)
+        if op_name in ("FullyConnected", "Convolution", "Deconvolution") and \
+                nattrs.get("no_bias"):
+            n_expected -= 1
+        while len(entries) < n_expected:
+            vname = "%s_%s" % (name, full[len(entries)])
+            vnode = _Node(None, vname, AttrScope.current().get(None))
+            entries.append((vnode, 0))
+    str_attrs = {}
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        str_attrs[k] = v if isinstance(v, str) else attr_to_str(v)
+    scope_attrs = AttrScope.current().get(None)
+    for k, v in scope_attrs.items():
+        str_attrs.setdefault(k, v)
+    node = _Node(op_name, name, str_attrs, entries)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 \
+        else Symbol([(node, 0)])
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built = []
+    for meta in nodes_meta:
+        attrs = meta.get("attrs", meta.get("param", {})) or {}
+        if meta["op"] == "null":
+            node = _Node(None, meta["name"], attrs)
+        else:
+            op_name = meta["op"]
+            inputs = [(built[nid], idx) for nid, idx, *_ in meta["inputs"]]
+            node = _Node(op_name, meta["name"], attrs, inputs)
+        built.append(node)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    return Symbol([(built[nid], idx) for nid, idx, *_ in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _create("_zeros", [], {"shape": shape, "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _create("_ones", [], {"shape": shape, "dtype": dtype})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _create("_arange", [], {"start": start, "stop": stop, "step": step,
+                                   "repeat": repeat, "dtype": dtype}, name=name)
