@@ -11,6 +11,13 @@ from __future__ import annotations
 import logging
 import os
 
+import jax
+
+# float64 NDArrays are part of the reference API surface (test_utils
+# check_consistency, linalg ops); defaults stay 32-bit via weak typing, and
+# models opt into bf16/f32 explicitly, so TPU perf is unaffected.
+jax.config.update("jax_enable_x64", True)
+
 __version__ = "1.0.1"  # capability parity target: MXNet 1.0.1 (python/mxnet/libinfo.py:64)
 
 

@@ -1,0 +1,512 @@
+"""Gluon Block / HybridBlock / SymbolBlock (ref: python/mxnet/gluon/block.py).
+
+Block is eager (imperative NDArray ops, autograd tape).  HybridBlock's
+hybridize() is where the TPU design gets *simpler* than the reference
+(SURVEY §7 stage 4): instead of CachedOp re-planning an nnvm graph, the
+traced symbol lowers to ONE jitted XLA computation per input signature,
+with backward = its jitted vjp feeding the parameter grad buffers.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import cpu, current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd_mod
+from .. import symbol as sym_mod
+from .. import autograd
+from ..symbol import Symbol
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope:
+    """Name scoping for Blocks (ref: block.py:35)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..symbol.symbol import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        self._name_scope = sym_mod.NameManager()
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args):
+    if isinstance(args, NDArray) or isinstance(args, Symbol):
+        return [args], int(0)
+    if args is None:
+        return [None], None
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock input must be (nested) list of Symbol or NDArray, " \
+        "but got %s of type %s" % (str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    if fmt is None:
+        return None, args[1:]
+    assert isinstance(fmt, (list, tuple))
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base class for all neural network layers and models (ref: block.py:122)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+        self._reg_params = {}
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            "  ({key}): {block}".format(
+                key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    "Changing attribute type for {name} from {type1} to "
+                    "{type2} is not allowed.".format(
+                        name=name, type1=type(existing), type2=type(value)))
+            if isinstance(existing, Block):
+                for i, c in enumerate(self._children):
+                    if c is existing:
+                        self._children[i] = value
+            elif isinstance(value, Block):
+                self.register_child(value)
+        elif isinstance(value, Block):
+            self.register_child(value)
+        if isinstance(value, Parameter):
+            assert name not in self._reg_params or self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children:
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    save_parameters = save_params
+    load_parameters = load_params
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            from .. import initializer
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children:
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    lines = [(num_spaces * " ") + line for line in lines]
+    return "\n".join([first] + lines)
+
+
+class HybridBlock(Block):
+    """A Block that can be traced into a single XLA computation
+    (ref: block.py:375)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = ()
+        self._cached_programs = {}
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s has "
+                "type %s." % (str(block), str(type(block))))
+        super().register_child(block)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_programs = {}
+
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args)
+            inputs = [sym_mod.var("data%d" % i) if len(flat_args) > 1
+                      else sym_mod.var("data") for i in range(len(flat_args))]
+            grouped_inputs, _ = _regroup(inputs, self._in_format)
+            if not isinstance(grouped_inputs, (list, tuple)):
+                grouped_inputs = [grouped_inputs]
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(sym_mod, *grouped_inputs, **params)
+            out, self._out_format = _flatten(out)
+            self._cached_graph = inputs, sym_mod.Group(out)
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        """Infer (and set) parameter shapes from input shapes."""
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args)
+        shape_kwargs = {i.name: j.shape for i, j in zip(inputs, flat_args)}
+        arg_shapes, _, aux_shapes = out.infer_shape_partial(**shape_kwargs)
+        sdict = dict(zip(out.list_arguments(), arg_shapes))
+        sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
+        params = self.collect_params()
+        for name, param in params.items():
+            if name in sdict and sdict[name] is not None:
+                param.shape = sdict[name]
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred: " + str(e))
+
+    def _call_cached_op(self, *args):
+        """Run through the jitted whole-graph program (CachedOp analog)."""
+        inputs, out = self._get_graph(*args)
+        flat_args, fmt = _flatten(args)
+        ctx = flat_args[0].context
+        key = tuple((a.shape, str(a.dtype)) for a in flat_args)
+        prog = self._cached_programs.get(key)
+        params = self.collect_params()
+        if prog is None:
+            from ..executor import Executor
+            arg_names = out.list_arguments()
+            aux_names = out.list_auxiliary_states()
+            param_by_name = dict(params.items())
+            arg_dict, grad_dict, aux_dict = {}, {}, {}
+            req = {}
+            for name in arg_names:
+                if name in param_by_name:
+                    p = param_by_name[name]
+                    arg_dict[name] = p.data(ctx)
+                    req[name] = p.grad_req
+                    if p.grad_req != "null":
+                        grad_dict[name] = p.grad(ctx)
+                else:
+                    req[name] = "null"
+            for name in aux_names:
+                aux_dict[name] = param_by_name[name].data(ctx)
+            input_names = [i.name for i in inputs]
+            prog = (Executor(out, ctx, dict(arg_dict), grad_dict, aux_dict,
+                             req), input_names)
+            self._cached_programs[key] = prog
+        exe, input_names = prog
+        for name, arr in zip(input_names, flat_args):
+            exe.arg_dict[name]._h.array = arr._h.array
+        # refresh param handles (Trainer updates rebind them)
+        for name, p in params.items():
+            if name in exe.arg_dict and p._data is not None:
+                exe.arg_dict[name]._h.array = p.data(ctx)._h.array
+            if name in exe.aux_dict and p._data is not None:
+                exe.aux_dict[name]._h.array = p.data(ctx)._h.array
+        is_train = autograd.is_training()
+        outputs = exe.forward(is_train=is_train)
+        if autograd.is_recording():
+            func = _CachedOpFunction(exe, input_names, flat_args, params)
+            outputs = func._record(outputs)
+        ret, _ = _regroup(outputs, self._out_format)
+        return ret
+
+    def forward(self, x, *args):
+        """Defines the forward computation; dispatches hybrid_forward."""
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for _, param in self.params.items():
+                        param._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, param in self.params.items():
+                    param._finish_deferred_init()
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
+            return self.hybrid_forward(nd_mod, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export to symbol JSON + params (deploy format parity)."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save("%s-symbol.json" % path)
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                arg_dict["arg:%s" % name] = param._reduce()
+            elif name in aux_names:
+                arg_dict["aux:%s" % name] = param._reduce()
+        from ..ndarray import save as nd_save
+        nd_save("%s-%04d.params" % (path, epoch), arg_dict)
+
+
+class _CachedOpFunction:
+    """Tape node for a hybridized forward: backward = the executor's jitted
+    vjp, with param grads folded into the parameter grad buffers."""
+
+    def __init__(self, exe, input_names, flat_args, params):
+        self.exe = exe
+        self.input_names = input_names
+        self.flat_args = flat_args
+        self.params = params
+
+    def _record(self, outputs):
+        from ..autograd import _Node
+        node = _Node.__new__(_Node)
+        node.op = None
+        node.attrs = {}
+        node.in_entries = []
+        for a in self.flat_args:
+            e = getattr(a, "_tape_entry", None)
+            if e is not None:
+                node.in_entries.append((e[0], e[1], None))
+            elif getattr(a, "_grad", None) is not None:
+                node.in_entries.append((None, 0, a))
+            else:
+                node.in_entries.append((None, 0, None))
+        node.in_arrays = [a._h.array for a in self.flat_args]
+        node.out_arrays = [o._h.array for o in outputs]
+        node.n_outputs = len(outputs)
+        node.rng_key = None
+        node._custom_backward = self
+        for i, o in enumerate(outputs):
+            o._tape_entry = (node, i)
+        return outputs
+
+    def backward(self, *head_grads):
+        # run executor backward: fills param grad buffers (grad_dict holds
+        # the very same NDArrays as Parameter._grad); returns input grads
+        exe = self.exe
+        saved_req = dict(exe._grad_req)
+        exe.backward(out_grads=list(head_grads))
+        # input gradients: vjp w.r.t. data inputs
+        import jax
+        import jax.numpy as jnp
+        arg_vals = [exe.arg_dict[n]._h.array for n in exe._prog.arg_names]
+        # only compute input grads if any input is on the tape upstream
+        grads_for_inputs = []
+        need = [n for n in self.input_names]
+
+        def f(input_vals):
+            amap = dict(zip(exe._prog.arg_names, arg_vals))
+            amap.update(zip(need, input_vals))
+            aux_map = {n: exe.aux_dict[n]._h.array for n in exe._prog.aux_names}
+            outs, _ = exe._prog.evaluate(amap, aux_map,
+                                         exe._last_keys or (), True)
+            return outs
+
+        in_vals = [exe.arg_dict[n]._h.array for n in need]
+        _, vjp_fn = jax.vjp(f, in_vals)
+        (gin,) = vjp_fn([g._h.array for g in head_grads])
+        from ..ndarray import NDArray as _ND
+        return [_ND(g) for g in gin]
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a symbol (ref: block.py:598)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, Symbol) and len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        syms, self._in_format = _flatten(inputs)
+        _, self._out_format = _flatten(outputs)
+        input_names = {i.name for i in syms}
+        for i in outputs.list_arguments():
+            if i not in input_names:
+                self.params.get(i, allow_deferred_init=True)
+        for i in outputs.list_auxiliary_states():
+            if i not in input_names:
+                self.params.get(i, grad_req="null", allow_deferred_init=True)
+        self._cached_graph = syms, outputs
+        prefix = _common_prefix(list(self._params.keys()))
+        params = {k[len(prefix):]: v for k, v in self._params.items()}
+        self._reg_params = params
+        self._prefix = prefix
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol)
+        ret = copy.copy(self._cached_graph[1])
+        return ret
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _common_prefix(names):
+    if not names:
+        return ""
+    prefix = names[0]
+    for name in names:
+        i = 0
+        while i < len(prefix) and i < len(name) and prefix[i] == name[i]:
+            i += 1
+        prefix = prefix[:i]
+    return prefix

@@ -490,9 +490,11 @@ def array(source_array, ctx=None, dtype=None):
         if dtype is not None:
             src = src.astype(np_dtype(dtype))
         return NDArray(jax.device_put(src, ctx.jax_device()), ctx=ctx)
-    npa = np.asarray(source_array)
     if dtype is None:
-        dtype = npa.dtype if npa.dtype != np.float64 else np.float32
+        # MXNet semantics: keep numpy dtype; python lists default to float32
+        dtype = source_array.dtype if isinstance(source_array, np.ndarray) \
+            else np.float32
+    npa = np.asarray(source_array)
     npa = npa.astype(np_dtype(dtype), copy=False) if npa.dtype != np_dtype(dtype) else npa
     return NDArray(jax.device_put(npa, ctx.jax_device()), ctx=ctx)
 

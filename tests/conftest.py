@@ -1,0 +1,10 @@
+"""Test configuration: force a deterministic 8-virtual-device CPU platform
+(the reference's cpu<->gpu consistency strategy maps to cpu<->tpu here; the
+driver separately dry-runs the multi-chip path — see __graft_entry__.py)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,136 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(5)
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_and_broadcast():
+    x = mx.nd.array(rng.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()), rtol=1e-4)
+
+
+def test_grad_accumulate_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 3 * 2 * x.asnumpy())
+
+
+def test_head_grads():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad.asnumpy(), [30, 300])
+
+
+def test_pause_and_modes():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        assert ag.is_recording() and ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+        y = x * 2
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0])
+    assert not ag.is_recording()
+
+
+def test_detach_blocks_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # only d(z)/dx through the second factor: y.detach() = 4
+    assert_almost_equal(x.grad.asnumpy(), [4.0])
+
+
+def test_autograd_grad_fn():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x * x).sum()
+    (dx,) = [ag.grad(y, [x])[0]] if False else [ag.grad(y, [x])[0]]
+    assert_almost_equal(dx.asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_mark_variables_api():
+    x = mx.nd.array([3.0])
+    g = mx.nd.zeros((1,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = x * 5
+    ag.backward([y])
+    assert_almost_equal(g.asnumpy(), [5.0])
+
+
+def test_multi_output_and_shared_input():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        a = x * 2
+        b = x * 3
+        c = (a + b).sum()
+    c.backward()
+    assert_almost_equal(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(rng.rand(4).astype(np.float32))
+    x.attach_grad()
+    func = Sigmoid()
+    with ag.record():
+        y = func(x)
+        z = y.sum()
+    z.backward()
+    xs = x.asnumpy()
+    s = 1 / (1 + np.exp(-xs))
+    assert_almost_equal(x.grad.asnumpy(), s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+def test_training_flag_affects_dropout():
+    x = mx.nd.ones((100, 100))
+    with ag.record(train_mode=False):
+        out = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(out.asnumpy(), x.asnumpy())
+    with ag.record(train_mode=True):
+        out = mx.nd.Dropout(x, p=0.5)
+    assert (out.asnumpy() == 0).any()

@@ -1,0 +1,223 @@
+"""IO / metric / kvstore tests (ref: tests/python/unittest/test_io.py,
+test_metric.py, test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(9)
+
+
+# ---------------------------- io ------------------------------------------
+
+def test_ndarray_iter_basic():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard():
+    X = np.zeros((10, 2), np.float32)
+    it = mx.io.NDArrayIter(X, np.zeros(10), batch_size=3,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle_pairs_data_label():
+    X = np.arange(20, dtype=np.float32).reshape(20, 1)
+    y = np.arange(20, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=5, shuffle=True)
+    for batch in it:
+        assert_almost_equal(batch.data[0].asnumpy()[:, 0],
+                            batch.label[0].asnumpy())
+
+
+def test_resize_iter():
+    X = np.zeros((8, 2), np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(8), batch_size=4)
+    r = mx.io.ResizeIter(base, 5)
+    assert len(list(r)) == 5
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "d.csv")
+    label_path = str(tmp_path / "l.csv")
+    np.savetxt(data_path, rng.rand(10, 3), delimiter=",")
+    np.savetxt(label_path, np.arange(10), delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,),
+                       label_csv=label_path, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 3)
+
+
+def test_mnist_iter(tmp_path):
+    import gzip, struct
+    # write tiny idx files
+    img_path = str(tmp_path / "img")
+    lbl_path = str(tmp_path / "lbl")
+    n = 20
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(rng.randint(0, 255, n * 28 * 28).astype(np.uint8).tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(rng.randint(0, 10, n).astype(np.uint8).tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                         shuffle=True, seed=1)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (5, 1, 28, 28)
+    flat_it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                              flat=True)
+    assert next(iter(flat_it)).data[0].shape == (5, 784)
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    fname = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(fname, "w")
+    for i in range(5):
+        w.write(b"record%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(fname, "r")
+    for i in range(5):
+        assert r.read() == b"record%d" % i
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    fname = str(tmp_path / "t.rec")
+    idxname = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxname, fname, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+
+
+def test_irheader_pack_unpack():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    payload = b"imagedata"
+    packed = recordio.pack(header, payload)
+    h2, s2 = recordio.unpack(packed)
+    assert h2.label == 3.0 and h2.id == 7 and s2 == payload
+    header = recordio.IRHeader(0, np.array([1.0, 2.0], np.float32), 9, 0)
+    h3, s3 = recordio.unpack(recordio.pack(header, payload))
+    assert_almost_equal(h3.label, [1.0, 2.0])
+
+
+# ---------------------------- metric ---------------------------------------
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5
+
+
+def test_topk_ce_mse():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+    ce = mx.metric.CrossEntropy()
+    ce.update([mx.nd.array([0])], [mx.nd.array([[0.5, 0.5]])])
+    assert abs(ce.get()[1] - (-np.log(0.5))) < 1e-5
+
+    mse = mx.metric.MSE()
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.create("acc")
+    assert isinstance(m2, mx.metric.Accuracy)
+
+    def feval(label, pred):
+        return float(np.sum(label == pred.argmax(1)))
+
+    m3 = mx.metric.CustomMetric(feval)
+    m3.update([mx.nd.array([1])], [mx.nd.array([[0.2, 0.8]])])
+    assert m3.get()[1] == 1.0
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-4
+
+
+# ---------------------------- kvstore ---------------------------------------
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), [1, 1, 1])
+    kv.push("w", mx.nd.full((3,), 5.0))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), [5, 5, 5])
+
+
+def test_kvstore_aggregation():
+    kv = mx.kv.create("device")
+    kv.init(3, mx.nd.zeros((2,)))
+    grads = [mx.nd.ones((2,)), mx.nd.full((2,), 2.0)]
+    kv.push(3, grads)
+    out = mx.nd.zeros((2,))
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), [3, 3])  # summed across devices
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2,)))
+
+    def updater(key, grad, weight):
+        weight += grad * 0.5
+
+    kv.set_updater(updater)
+    kv.push("w", mx.nd.full((2,), 4.0))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), [3, 3])
+
+
+def test_kvstore_optimizer():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0))
+    kv.init("w", mx.nd.ones((2,)))
+    kv.push("w", mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), [0, 0])  # w - 1.0*grad
+
+
+def test_kvstore_list_keys():
+    kv = mx.kv.create("local")
+    kv.init([1, 2], [mx.nd.ones((2,)), mx.nd.zeros((2,))])
+    outs = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+    kv.pull([1, 2], out=outs)
+    assert_almost_equal(outs[0].asnumpy(), [1, 1])
+    assert_almost_equal(outs[1].asnumpy(), [0, 0])

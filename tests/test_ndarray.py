@@ -1,0 +1,185 @@
+"""NDArray tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32 or a.dtype == np.int64
+    b = mx.nd.zeros((3, 4))
+    assert b.asnumpy().sum() == 0
+    c = mx.nd.ones((2, 2), dtype="float64")
+    assert c.asnumpy().dtype == np.float64
+    d = mx.nd.full((2,), 7.0)
+    assert d.asnumpy().tolist() == [7.0, 7.0]
+    e = mx.nd.arange(0, 10, 2)
+    assert e.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+
+def test_elementwise():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal((a + b).asnumpy(), [5, 7, 9])
+    assert_almost_equal((a - b).asnumpy(), [-3, -3, -3])
+    assert_almost_equal((a * b).asnumpy(), [4, 10, 18])
+    assert_almost_equal((b / a).asnumpy(), [4, 2.5, 2])
+    assert_almost_equal((a + 1).asnumpy(), [2, 3, 4])
+    assert_almost_equal((1 - a).asnumpy(), [0, -1, -2])
+    assert_almost_equal((a ** 2).asnumpy(), [1, 4, 9])
+    assert_almost_equal((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace():
+    a = mx.nd.ones((3,))
+    a += 2
+    assert_almost_equal(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    assert_almost_equal(a.asnumpy(), [6, 6, 6])
+    a[:] = 1.5
+    assert_almost_equal(a.asnumpy(), [1.5, 1.5, 1.5])
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    assert a[1].shape == (4,)
+    assert_almost_equal(a[1].asnumpy(), [4, 5, 6, 7])
+    assert a[1:3].shape == (2, 4)
+    a[0] = 9
+    assert_almost_equal(a[0].asnumpy(), [9, 9, 9, 9])
+    a[1:3] = 0
+    assert a.asnumpy()[1:].sum() == 0
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal((a == b).asnumpy(), [0, 1, 0])
+    assert_almost_equal((a > b).asnumpy(), [0, 0, 1])
+    assert_almost_equal((a <= b).asnumpy(), [1, 1, 0])
+    assert_almost_equal((a > 1.5).asnumpy(), [0, 1, 1])
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(6).reshape(2, 3))
+    assert a.reshape((3, 2)).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.T.shape == (3, 2)
+    assert a.reshape((0, -1)).shape == (2, 3)
+    b = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert b.transpose((2, 0, 1)).shape == (4, 2, 3)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+    # special reshape codes (ref: matrix_op-inl.h)
+    assert b.reshape((-3, 4)).shape == (6, 4)
+    assert b.reshape((2, -4, 1, 3, 4)).shape == (2, 1, 3, 4)
+    assert b.reshape((0, -2)).shape == (2, 3, 4)
+
+
+def test_reduce():
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert_almost_equal(a.sum().asnumpy(), 66)
+    assert a.sum(axis=0).shape == (4,)
+    assert a.sum(axis=1, keepdims=True).shape == (3, 1)
+    assert_almost_equal(a.mean().asnumpy(), 5.5)
+    assert_almost_equal(a.max().asnumpy(), 11)
+    assert_almost_equal(a.min().asnumpy(), 0)
+    assert_almost_equal(mx.nd.sum(a, axis=0, exclude=True).asnumpy(),
+                        np.arange(12).reshape(3, 4).sum(axis=1))
+
+
+def test_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    c = mx.nd.dot(mx.nd.array(a), mx.nd.array(b))
+    assert_almost_equal(c.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    ct = mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True)
+    assert_almost_equal(ct.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    # batch_dot
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    z = mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y))
+    assert_almost_equal(z.asnumpy(), x @ y, rtol=1e-5, atol=1e-5)
+
+
+def test_concat_split():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = mx.nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_broadcast():
+    a = mx.nd.ones((2, 1, 3))
+    assert mx.nd.broadcast_to(a, shape=(2, 4, 3)).shape == (2, 4, 3)
+    assert mx.nd.broadcast_axis(a, axis=1, size=5).shape == (2, 5, 3)
+    x = mx.nd.array([[1], [2]])
+    y = mx.nd.array([[10, 20]])
+    assert_almost_equal(mx.nd.broadcast_add(x, y).asnumpy(),
+                        [[11, 21], [12, 22]])
+
+
+def test_take_onehot_pick():
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array([0, 2])
+    out = mx.nd.take(w, idx)
+    assert_almost_equal(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = mx.nd.one_hot(idx, depth=4)
+    assert_almost_equal(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+    data = mx.nd.array([[1., 2., 3.], [4., 5., 6.]])
+    picked = mx.nd.pick(data, mx.nd.array([1, 2]), axis=1)
+    assert_almost_equal(picked.asnumpy(), [2, 6])
+
+
+def test_ordering():
+    a = mx.nd.array([[3.0, 1.0, 2.0]])
+    assert_almost_equal(mx.nd.sort(a).asnumpy(), [[1, 2, 3]])
+    assert_almost_equal(mx.nd.argsort(a).asnumpy(), [[1, 2, 0]])
+    assert_almost_equal(mx.nd.topk(a, k=2, ret_typ="value").asnumpy(), [[3, 2]])
+    assert_almost_equal(mx.nd.argmax(a, axis=1).asnumpy(), [0])
+    assert_almost_equal(mx.nd.argmin(a, axis=1).asnumpy(), [1])
+
+
+def test_astype_copy():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.asnumpy().dtype == np.int32
+    c = a.copy()
+    c[:] = 0
+    assert a.asnumpy().sum() == 4.0
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "x.nd")
+    data = {"w": mx.nd.array(np.random.rand(3, 3)),
+            "b": mx.nd.array(np.random.rand(3))}
+    mx.nd.save(fname, data)
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), data["w"].asnumpy())
+    # list form
+    mx.nd.save(fname, [data["w"]])
+    (back,) = mx.nd.load(fname)
+    assert_almost_equal(back.asnumpy(), data["w"].asnumpy())
+
+
+def test_scalar_ops_dtype_preserved():
+    a = mx.nd.ones((2,), dtype="float16")
+    assert (a * 2).asnumpy().dtype == np.float16
+    b = mx.nd.ones((2,), dtype="int32")
+    assert (b + 1).asnumpy().dtype == np.int32
+
+
+def test_wait_and_context():
+    a = mx.nd.ones((4,))
+    a.wait_to_read()
+    mx.nd.waitall()
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu())
+    assert b.context.device_type == "cpu"

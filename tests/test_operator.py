@@ -1,0 +1,325 @@
+"""Operator tests with numeric-gradient checks
+(ref: tests/python/unittest/test_operator.py, 4,886 LoC — the same
+check_numeric_gradient / check_symbolic_forward harness)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+rng = np.random.RandomState(7)
+
+
+def test_elemwise_unary_forward():
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "tanh": np.tanh, "sin": np.sin, "cos": np.cos,
+    }
+    for name, ref in cases.items():
+        out = getattr(mx.nd, name)(mx.nd.array(x))
+        assert_almost_equal(out.asnumpy(), ref(x), rtol=1e-5, atol=1e-6)
+
+
+def test_unary_gradients():
+    data = mx.sym.Variable("data")
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    for name in ["exp", "log", "sqrt", "square", "tanh", "sigmoid", "relu"]:
+        sym = getattr(mx.sym, name)(data)
+        check_numeric_gradient(sym, {"data": x}, rtol=0.05, atol=1e-2)
+
+
+def test_binary_broadcast_grad():
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    a = rng.rand(3, 1).astype(np.float32) + 0.5
+    b = rng.rand(1, 4).astype(np.float32) + 0.5
+    for name in ["broadcast_add", "broadcast_sub", "broadcast_mul",
+                 "broadcast_div"]:
+        sym = getattr(mx.sym, name)(lhs, rhs)
+        check_numeric_gradient(sym, {"lhs": a, "rhs": b}, rtol=0.05, atol=1e-2)
+
+
+def test_dot_grad():
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    sym = mx.sym.dot(lhs, rhs)
+    check_numeric_gradient(sym, {"lhs": rng.rand(3, 4).astype(np.float32),
+                                 "rhs": rng.rand(4, 2).astype(np.float32)},
+                           rtol=0.05, atol=1e-2)
+
+
+def test_fully_connected():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    x = rng.rand(2, 3).astype(np.float32)
+    w = rng.rand(4, 3).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [x @ w.T + b], rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           rtol=0.05, atol=1e-2)
+
+
+def test_convolution_forward():
+    # conv vs explicit correlation
+    x = rng.rand(1, 1, 5, 5).astype(np.float32)
+    w = rng.rand(1, 1, 3, 3).astype(np.float32)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=1,
+                              no_bias=True, name="conv")
+    expected = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            expected[0, 0, i, j] = np.sum(x[0, 0, i:i + 3, j:j + 3] * w[0, 0])
+    check_symbolic_forward(conv, {"data": x, "conv_weight": w}, [expected],
+                           rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_grad():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=2,
+                              pad=(1, 1), stride=(2, 2), name="conv")
+    loc = {"data": rng.rand(2, 3, 7, 7).astype(np.float32),
+           "conv_weight": rng.rand(2, 3, 3, 3).astype(np.float32),
+           "conv_bias": rng.rand(2).astype(np.float32)}
+    check_numeric_gradient(conv, loc, rtol=0.05, atol=5e-2)
+
+
+def test_pooling():
+    x = np.array([[[[1, 2, 3, 4], [5, 6, 7, 8],
+                    [9, 10, 11, 12], [13, 14, 15, 16]]]], np.float32)
+    data = mx.sym.Variable("data")
+    mp = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    check_symbolic_forward(mp, {"data": x}, [[[[6, 8], [14, 16]]]])
+    ap = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg")
+    check_symbolic_forward(ap, {"data": x}, [[[[3.5, 5.5], [11.5, 13.5]]]])
+    gp = mx.sym.Pooling(data=data, kernel=(2, 2), global_pool=True,
+                        pool_type="max")
+    check_symbolic_forward(gp, {"data": x}, [[[[16]]]])
+
+
+def test_activation_leakyrelu():
+    x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+    data = mx.sym.Variable("data")
+    check_symbolic_forward(mx.sym.Activation(data, act_type="relu"),
+                           {"data": x}, [np.maximum(x, 0)])
+    check_symbolic_forward(mx.sym.LeakyReLU(data, act_type="leaky", slope=0.1),
+                           {"data": x}, [np.where(x > 0, x, 0.1 * x)])
+    elu = mx.sym.LeakyReLU(data, act_type="elu", slope=0.5)
+    check_symbolic_forward(elu, {"data": x},
+                           [np.where(x > 0, x, 0.5 * np.expm1(x))])
+
+
+def test_softmax_output_grad():
+    # SoftmaxOutput backward == softmax(x) - onehot(label)
+    x = rng.rand(4, 5).astype(np.float32)
+    label = np.array([0, 2, 1, 4], np.float32)
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("lab")
+    sym = mx.sym.SoftmaxOutput(data=data, label=lab, name="sm")
+    ex = sym.bind(mx.current_context(),
+                  args={"data": mx.nd.array(x), "lab": mx.nd.array(label)},
+                  args_grad={"data": mx.nd.zeros((4, 5))},
+                  grad_req={"data": "write", "lab": "null"})
+    ex.forward(is_train=True)
+    sm = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    assert_almost_equal(ex.outputs[0].asnumpy(), sm, rtol=1e-4, atol=1e-5)
+    ex.backward()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), sm - onehot,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_ignore_and_norm():
+    x = rng.rand(4, 5).astype(np.float32)
+    label = np.array([0, -1, 1, 4], np.float32)
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("lab")
+    sym = mx.sym.SoftmaxOutput(data=data, label=lab, use_ignore=True,
+                               ignore_label=-1, normalization="valid")
+    ex = sym.bind(mx.current_context(),
+                  args={"data": mx.nd.array(x), "lab": mx.nd.array(label)},
+                  args_grad={"data": mx.nd.zeros((4, 5))},
+                  grad_req={"data": "write", "lab": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert abs(g[1]).sum() == 0  # ignored row has zero grad
+    sm = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    onehot = np.zeros((4, 5), np.float32)
+    for i, l in enumerate(label):
+        if l >= 0:
+            onehot[i, int(l)] = 1
+    expected = (sm - onehot) / 3.0
+    expected[1] = 0
+    assert_almost_equal(g, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_regression_outputs():
+    x = rng.rand(4, 3).astype(np.float32)
+    y = rng.rand(4, 3).astype(np.float32)
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("lab")
+    lro = mx.sym.LinearRegressionOutput(data=data, label=lab)
+    ex = lro.bind(mx.current_context(),
+                  args={"data": mx.nd.array(x), "lab": mx.nd.array(y)},
+                  args_grad={"data": mx.nd.zeros((4, 3))},
+                  grad_req={"data": "write", "lab": "null"})
+    ex.forward(is_train=True)
+    assert_almost_equal(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    # ref: regression_output-inl.h:119 — grad_scale / num_output
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), (x - y) / 3.0,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_forward():
+    x = rng.rand(4, 3, 2, 2).astype(np.float32)
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=False, eps=1e-5, name="bn")
+    gamma = rng.rand(3).astype(np.float32)
+    beta = rng.rand(3).astype(np.float32)
+    ex = bn.simple_bind(ctx=mx.current_context(), data=(4, 3, 2, 2))
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = gamma
+    ex.arg_dict["bn_beta"][:] = beta
+    ex.forward(is_train=True)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-5)
+    expected = expected * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(ex.outputs[0].asnumpy(), expected, rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_reshape_ops():
+    data = mx.sym.Variable("data")
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(mx.sym.Reshape(data, shape=(-1, 4)), {"data": x},
+                           [x.reshape(-1, 4)])
+    check_symbolic_forward(mx.sym.Flatten(data), {"data": x},
+                           [x.reshape(2, 12)])
+    check_symbolic_forward(mx.sym.transpose(data, axes=(1, 0, 2)), {"data": x},
+                           [x.transpose(1, 0, 2)])
+    check_symbolic_forward(mx.sym.expand_dims(data, axis=1), {"data": x},
+                           [x[:, None]])
+    check_symbolic_forward(mx.sym.slice_axis(data, axis=2, begin=1, end=3),
+                           {"data": x}, [x[:, :, 1:3]])
+
+
+def test_embedding_grad():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    emb = mx.sym.Embedding(data=data, weight=w, input_dim=5, output_dim=3)
+    idx = np.array([1, 3, 1], np.float32)
+    weight = rng.rand(5, 3).astype(np.float32)
+    ex = emb.bind(mx.current_context(),
+                  args={"data": mx.nd.array(idx), "w": mx.nd.array(weight)},
+                  args_grad={"w": mx.nd.zeros((5, 3))},
+                  grad_req={"data": "null", "w": "write"})
+    ex.forward(is_train=True)
+    assert_almost_equal(ex.outputs[0].asnumpy(), weight[idx.astype(int)])
+    head = rng.rand(3, 3).astype(np.float32)
+    ex.backward(out_grads=mx.nd.array(head))
+    expected = np.zeros((5, 3), np.float32)
+    for i, ind in enumerate(idx.astype(int)):
+        expected[ind] += head[i]
+    assert_almost_equal(ex.grad_dict["w"].asnumpy(), expected, rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_dropout_semantics():
+    data = mx.sym.Variable("data")
+    do = mx.sym.Dropout(data, p=0.5)
+    x = np.ones((200, 200), np.float32)
+    ex = do.simple_bind(ctx=mx.current_context(), data=x.shape,
+                        grad_req="null")
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=False)
+    assert_almost_equal(ex.outputs[0].asnumpy(), x)  # identity at predict
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    kept = out != 0
+    assert 0.4 < kept.mean() < 0.6
+    assert_almost_equal(out[kept], np.full(kept.sum(), 2.0))  # scaled by 1/p
+
+
+def test_where_clip_etc():
+    cond = mx.nd.array([1.0, 0.0, 1.0])
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([-1.0, -2.0, -3.0])
+    assert_almost_equal(mx.nd.where(cond, a, b).asnumpy(), [1, -2, 3])
+    assert_almost_equal(mx.nd.clip(a, 1.5, 2.5).asnumpy(), [1.5, 2, 2.5])
+    assert_almost_equal(mx.nd._maximum_scalar(a, scalar=2.0).asnumpy(),
+                        [2, 2, 3])
+
+
+def test_blockgrad_makeloss():
+    data = mx.sym.Variable("data")
+    x = rng.rand(3, 3).astype(np.float32)
+    bg = mx.sym.BlockGrad(data)
+    ex = bg.bind(mx.current_context(), args={"data": mx.nd.array(x)},
+                 args_grad={"data": mx.nd.ones((3, 3))},
+                 grad_req={"data": "write"})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=mx.nd.ones((3, 3)))
+    assert ex.grad_dict["data"].asnumpy().sum() == 0  # grads blocked
+
+    ml = mx.sym.MakeLoss(data, grad_scale=2.0)
+    ex = ml.bind(mx.current_context(), args={"data": mx.nd.array(x)},
+                 args_grad={"data": mx.nd.zeros((3, 3))},
+                 grad_req={"data": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(),
+                        np.full((3, 3), 2.0))
+
+
+def test_sequence_ops():
+    # TNC layout
+    x = rng.rand(4, 2, 3).astype(np.float32)
+    seqlen = np.array([2, 4], np.float32)
+    data = mx.sym.Variable("data")
+    sl = mx.sym.Variable("sl")
+    last = mx.sym.SequenceLast(data=data, sequence_length=sl,
+                               use_sequence_length=True)
+    ex = last.bind(mx.current_context(),
+                   args={"data": mx.nd.array(x), "sl": mx.nd.array(seqlen)})
+    ex.forward()
+    expected = np.stack([x[1, 0], x[3, 1]])
+    assert_almost_equal(ex.outputs[0].asnumpy(), expected)
+    mask = mx.sym.SequenceMask(data=data, sequence_length=sl,
+                               use_sequence_length=True, value=-1.0)
+    ex = mask.bind(mx.current_context(),
+                   args={"data": mx.nd.array(x), "sl": mx.nd.array(seqlen)})
+    ex.forward()
+    out = ex.outputs[0].asnumpy()
+    assert (out[2:, 0] == -1).all() and (out[:2, 0] != -1).all()
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = mx.nd.random_uniform(low=0, high=1, shape=(1000,))
+    assert 0.4 < a.asnumpy().mean() < 0.6
+    mx.random.seed(42)
+    b = mx.nd.random_uniform(low=0, high=1, shape=(1000,))
+    assert_almost_equal(a.asnumpy(), b.asnumpy())  # reseeding reproduces
+    n = mx.nd.random_normal(loc=2.0, scale=0.5, shape=(2000,))
+    assert 1.8 < n.asnumpy().mean() < 2.2
+    assert 0.3 < n.asnumpy().std() < 0.7
+
+
+def test_norm_and_l2():
+    x = rng.rand(3, 4).astype(np.float32)
+    out = mx.nd.norm(mx.nd.array(x))
+    assert_almost_equal(out.asnumpy(), [np.sqrt((x ** 2).sum())], rtol=1e-4)
+    l2 = mx.nd.L2Normalization(mx.nd.array(x), mode="instance")
+    expected = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    assert_almost_equal(l2.asnumpy(), expected, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,118 @@
+"""Optimizer tests vs numpy references (ref: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(3)
+
+
+def _run_updates(opt, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = rng.rand(5).astype(np.float32)
+    grads = [rng.rand(5).astype(np.float32) for _ in range(4)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.01)
+    got = _run_updates(opt, w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = rng.rand(5).astype(np.float32)
+    grads = [rng.rand(5).astype(np.float32) for _ in range(4)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    got = _run_updates(opt, w0, grads)
+    w, mom = w0.copy(), np.zeros(5, np.float32)
+    for g in grads:
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = rng.rand(5).astype(np.float32)
+    grads = [rng.rand(5).astype(np.float32) for _ in range(4)]
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    got = _run_updates(opt, w0, grads)
+    w = w0.copy()
+    m = np.zeros(5)
+    v = np.zeros(5)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = rng.rand(5).astype(np.float32)
+    grads = [rng.rand(5).astype(np.float32) for _ in range(3)]
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.9,
+                               rescale_grad=1.0)
+    got = _run_updates(opt, w0, grads)
+    w = w0.copy()
+    n = np.zeros(5)
+    for g in grads:
+        n = 0.1 * g * g + 0.9 * n
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, np.float32)
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0,
+                           clip_gradient=0.5)
+    got = _run_updates(opt, w0, [np.array([10.0, -10.0, 0.1], np.float32)])
+    assert_almost_equal(got, [-0.5, 0.5, -0.1], rtol=1e-5, atol=1e-6)
+
+
+def test_lr_scheduler_integration():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched,
+                           rescale_grad=1.0)
+    w = mx.nd.array(np.zeros(1, np.float32))
+    state = opt.create_state(0, w)
+    for _ in range(6):
+        opt.update(0, w, mx.nd.array(np.ones(1, np.float32)), state)
+    assert opt._get_lr(0) < 1.0
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=0.1,
+                           param_idx2name={0: "w_weight", 1: "b_bias"})
+    opt.set_lr_mult({"w_weight": 0.0})
+    w = mx.nd.array(np.ones(2, np.float32))
+    opt.update(0, w, mx.nd.array(np.ones(2, np.float32)),
+               opt.create_state(0, w))
+    assert_almost_equal(w.asnumpy(), np.ones(2))  # lr_mult 0 froze it
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "signum", "ftml", "adamax", "nadam"]:
+        opt = mx.optimizer.create(name)
+        assert isinstance(opt, mx.optimizer.Optimizer)
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(rng.rand(3).astype(np.float32))
+    updater(0, mx.nd.array(rng.rand(3).astype(np.float32)), w)
+    blob = updater.get_states()
+    updater2 = mx.optimizer.get_updater(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    updater2.set_states(blob)
+    assert 0 in updater2.states

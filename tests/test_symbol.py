@@ -1,0 +1,127 @@
+"""Symbol tests (ref: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_infer_shape_backward():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (10, 100)
+    assert d["fc1_bias"] == (10,)
+    assert d["fc2_weight"] == (4, 10)
+    assert d["softmax_label"] == (8,)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data=np.float32)
+    assert all(np.dtype(t) == np.float32 for t in arg_types)
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_group():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Group([a + b, a * b])
+    assert len(c.list_outputs()) == 2
+
+
+def test_json_roundtrip(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    assert net2.infer_shape(data=(2, 10))[1] == net.infer_shape(data=(2, 10))[1]
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net3 = mx.sym.load(fname)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_attr_scope_and_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("x")
+    assert v.attr("ctx_group") == "dev1"
+    w = mx.sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert w.attr("__shape__") == "(3, 4)"
+    assert w.attr("__lr_mult__") == "2.0"
+
+
+def test_var_shape_used_in_infer():
+    w = mx.sym.Variable("w", shape=(4, 3))
+    x = mx.sym.Variable("x")
+    out = mx.sym.dot(x, w)
+    arg_shapes, out_shapes, _ = out.infer_shape(x=(2, 4))
+    assert out_shapes == [(2, 3)]
+
+
+def test_name_manager_unique():
+    s1 = mx.sym.relu(mx.sym.Variable("d1"))
+    s2 = mx.sym.relu(mx.sym.Variable("d2"))
+    assert s1.name != s2.name
+
+
+def test_arith_operators():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    x = np.array([[2.0, 4.0]], np.float32)
+    y = np.array([[1.0, 3.0]], np.float32)
+    for sym, expected in [
+            (a + b, x + y), (a - b, x - y), (a * b, x * y), (a / b, x / y),
+            (a + 1, x + 1), (2 * a, 2 * x), (a ** 2, x ** 2), (-a, -x)]:
+        ex = sym.bind(mx.current_context(),
+                      args={"a": mx.nd.array(x), "b": mx.nd.array(y)}
+                      if "b" in sym.list_arguments() else {"a": mx.nd.array(x)})
+        ex.forward()
+        np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected,
+                                   rtol=1e-5)
+
+
+def test_multi_output_indexing():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=3, axis=1, name="split")
+    assert len(parts.list_outputs()) == 3
+    p0 = parts[0]
+    ex = p0.bind(mx.current_context(),
+                 args={"data": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))})
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [[0], [3]])
+
+
+def test_infer_shape_error():
+    net = _mlp()
+    with pytest.raises(MXNetError):
+        net.infer_shape()
+    # partial succeeds
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    assert out_shapes[0] is None
