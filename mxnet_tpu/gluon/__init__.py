@@ -1,1 +1,10 @@
 """Gluon: imperative/hybrid neural-network API (ref: python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Parameter, ParameterDict, DeferredInitializationError  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
+from . import data  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+from . import model_zoo  # noqa: F401

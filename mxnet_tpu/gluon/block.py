@@ -220,11 +220,7 @@ class Block:
         raise NotImplementedError
 
 
-def _indent(s_, num_spaces):
-    lines = s_.split("\n")
-    first = lines.pop(0)
-    lines = [(num_spaces * " ") + line for line in lines]
-    return "\n".join([first] + lines)
+from .utils import _indent  # noqa: E402  (shared with nn layers' __repr__)
 
 
 class HybridBlock(Block):
@@ -308,14 +304,16 @@ class HybridBlock(Block):
         ctx = flat_args[0].context
         key = tuple((a.shape, str(a.dtype)) for a in flat_args)
         prog = self._cached_programs.get(key)
-        params = self.collect_params()
         if prog is None:
+            params = self.collect_params()
             from ..executor import Executor
             arg_names = out.list_arguments()
             aux_names = out.list_auxiliary_states()
             param_by_name = dict(params.items())
             arg_dict, grad_dict, aux_dict = {}, {}, {}
             req = {}
+            input_by_name = {i.name: a for i, a in
+                             zip(inputs, flat_args)}
             for name in arg_names:
                 if name in param_by_name:
                     p = param_by_name[name]
@@ -324,26 +322,35 @@ class HybridBlock(Block):
                     if p.grad_req != "null":
                         grad_dict[name] = p.grad(ctx)
                 else:
+                    arg_dict[name] = input_by_name[name]
                     req[name] = "null"
             for name in aux_names:
                 aux_dict[name] = param_by_name[name].data(ctx)
             input_names = [i.name for i in inputs]
+            # params bound into this executor, fixed for its lifetime —
+            # captured once so the hot path doesn't walk the block tree
+            bound_params = [
+                (name, p) for name, p in params.items()
+                if name in arg_dict or name in aux_dict]
             prog = (Executor(out, ctx, dict(arg_dict), grad_dict, aux_dict,
-                             req), input_names)
+                             req), input_names, bound_params)
             self._cached_programs[key] = prog
-        exe, input_names = prog
+        exe, input_names, bound_params = prog
         for name, arr in zip(input_names, flat_args):
             exe.arg_dict[name]._h.array = arr._h.array
-        # refresh param handles (Trainer updates rebind them)
-        for name, p in params.items():
-            if name in exe.arg_dict and p._data is not None:
+        # refresh param handles (set_data/load_params rebind them)
+        for name, p in bound_params:
+            if p._data is None:
+                continue
+            if name in exe.arg_dict:
                 exe.arg_dict[name]._h.array = p.data(ctx)._h.array
-            if name in exe.aux_dict and p._data is not None:
+            if name in exe.aux_dict:
                 exe.aux_dict[name]._h.array = p.data(ctx)._h.array
         is_train = autograd.is_training()
         outputs = exe.forward(is_train=is_train)
         if autograd.is_recording():
-            func = _CachedOpFunction(exe, input_names, flat_args, params)
+            func = _CachedOpFunction(exe, input_names, flat_args,
+                                     dict(bound_params))
             outputs = func._record(outputs)
         ret, _ = _regroup(outputs, self._out_format)
         return ret
@@ -356,7 +363,7 @@ class HybridBlock(Block):
                     return self._call_cached_op(x, *args)
                 except DeferredInitializationError:
                     self._deferred_infer_shape(x, *args)
-                    for _, param in self.params.items():
+                    for _, param in self.collect_params().items():
                         param._finish_deferred_init()
                     return self._call_cached_op(x, *args)
             try:
@@ -364,7 +371,7 @@ class HybridBlock(Block):
                           for i, j in self._reg_params.items()}
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
-                for _, param in self.params.items():
+                for _, param in self.collect_params().items():
                     param._finish_deferred_init()
                 params = {i: j.data(x.context)
                           for i, j in self._reg_params.items()}
@@ -436,15 +443,19 @@ class _CachedOpFunction:
         # run executor backward: fills param grad buffers (grad_dict holds
         # the very same NDArrays as Parameter._grad); returns input grads
         exe = self.exe
-        saved_req = dict(exe._grad_req)
         exe.backward(out_grads=list(head_grads))
-        # input gradients: vjp w.r.t. data inputs
+        # input gradients are only needed when an input is itself on the
+        # tape (x.attach_grad() or upstream op) — the common training loop
+        # feeds raw data, so skip the extra vjp then
+        needs_input_grads = any(
+            getattr(a, "_tape_entry", None) is not None
+            or getattr(a, "_grad", None) is not None
+            for a in self.flat_args)
+        if not needs_input_grads:
+            return [None] * len(self.flat_args)
         import jax
-        import jax.numpy as jnp
         arg_vals = [exe.arg_dict[n]._h.array for n in exe._prog.arg_names]
-        # only compute input grads if any input is on the tape upstream
-        grads_for_inputs = []
-        need = [n for n in self.input_names]
+        need = list(self.input_names)
 
         def f(input_vals):
             amap = dict(zip(exe._prog.arg_names, arg_vals))

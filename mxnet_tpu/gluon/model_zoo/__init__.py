@@ -1,0 +1,3 @@
+"""Gluon model zoo (parity: python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import model_store  # noqa: F401
+from . import vision  # noqa: F401

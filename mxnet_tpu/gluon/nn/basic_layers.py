@@ -269,15 +269,8 @@ class LayerNorm(HybridBlock):
                                     allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, gamma, beta):
-        mean = F.mean(x, axis=self._axis, keepdims=True)
-        var = F.mean(F.square(F.broadcast_sub(x, mean)), axis=self._axis,
-                     keepdims=True)
-        out = F.broadcast_div(F.broadcast_sub(x, mean),
-                              F.sqrt(var + self._epsilon))
-        return F.broadcast_add(F.broadcast_mul(out, gamma.reshape((1, -1))
-                                               if hasattr(gamma, "reshape")
-                                               else gamma), beta.reshape((1, -1))
-                               if hasattr(beta, "reshape") else beta)
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
 
 
 class Embedding(HybridBlock):

@@ -44,6 +44,14 @@ class Parameter:
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self.grad_req = grad_req
+        if isinstance(init, str):
+            # accept registry names ("zeros", "xavier", ...) anywhere an
+            # initializer is expected (ref: mx.init registry semantics)
+            from ..initializer import _INITIALIZER_REGISTRY
+            klass = _INITIALIZER_REGISTRY.get(init.lower())
+            if klass is None:
+                raise ValueError("unknown initializer %r" % init)
+            init = klass()
         self.init = init
 
     def __repr__(self):
@@ -103,8 +111,11 @@ class Parameter:
                     "Failed loading Parameter %s from saved params: " \
                     "shape incompatible expected %s vs saved %s" % (
                         self.name, str(self.shape), str(data.shape))
-        if self.dtype:
+        if self.dtype is not None:
             from ..base import np_dtype
+            want = np_dtype(self.dtype)
+            if np_dtype(data.dtype) != want:
+                data = data.astype(want)
         if isinstance(ctx, Context):
             ctx = [ctx]
         if self._data is None:

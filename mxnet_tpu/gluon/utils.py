@@ -31,7 +31,8 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
                   for i in range(num_slice)]
     else:
         from .. import ndarray as nd
-        slices = [nd.slice_axis(data, batch_axis, i * step, (i + 1) * step)
+        slices = [nd.slice_axis(data, batch_axis, i * step,
+                                (i + 1) * step if i < num_slice - 1 else size)
                   for i in range(num_slice)]
     return slices
 

@@ -1,0 +1,10 @@
+"""Symbol-API model builders (parity: example/image-classification/symbols/).
+
+These mirror the reference's example symbol factories so Module-based training
+scripts (train_mnist.py / train_imagenet.py style) work unchanged.
+"""
+from . import resnet  # noqa: F401
+from . import lenet  # noqa: F401
+from . import mlp  # noqa: F401
+
+get_symbol = resnet.get_symbol

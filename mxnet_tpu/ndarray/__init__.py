@@ -25,12 +25,14 @@ def _make_op_func(canonical, op):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
         inputs = []
-        pos_attrs = {}
+        scalar_pos = []
         for a in args:
             if isinstance(a, NDArray):
                 inputs.append(a)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
                 inputs.extend(a)
+            else:
+                scalar_pos.append(a)
         nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
         if nd_kwargs:
@@ -39,14 +41,20 @@ def _make_op_func(canonical, op):
                 if n in nd_kwargs:
                     inputs.append(nd_kwargs.pop(n))
             inputs.extend(nd_kwargs.values())  # unknown names: positional order
-        # non-NDArray positional args map onto declared attr order (rare; e.g.
-        # nd.one_hot(indices, depth))
+        if scalar_pos:
+            # non-NDArray positional args map onto declared attr order
+            # (nd.clip(x, a_min, a_max), nd.one_hot(indices, depth))
+            free = [k for k in op.params if k not in attrs]
+            for k, v in zip(free, scalar_pos):
+                attrs[k] = v
         return _invoke(canonical, inputs, attrs, out=out)
 
     fn.__name__ = canonical
     fn.__doc__ = op.doc or ("%s (auto-generated from the op registry)" % canonical)
     return fn
 
+
+from . import sparse  # noqa: F401,E402
 
 _mod = _sys.modules[__name__]
 _GENERATED = {}

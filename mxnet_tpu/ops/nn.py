@@ -396,6 +396,40 @@ register("InstanceNorm", _instance_norm, input_names=("data", "gamma", "beta"),
          infer_shape=_in_infer_shape, params={"eps": (pFloat, 1e-3)})
 
 
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=axis, keepdims=True)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+def _ln_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, None
+    axis = int(attrs.get("axis", -1))
+    c = dshape[axis]
+    filled = [dshape, (c,), (c,)]
+    n_out = 1
+    if attrs.get("output_mean_var"):
+        red = tuple(s for i, s in enumerate(dshape)
+                    if i != (axis % len(dshape)))
+        return filled, [dshape, red, red]
+    return filled, [dshape]
+
+
+register("LayerNorm", _layer_norm, input_names=("data", "gamma", "beta"),
+         infer_shape=_ln_infer_shape,
+         num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+         params={"axis": (pInt, -1), "eps": (pFloat, 1e-5),
+                 "output_mean_var": (pBool, False)})
+
+
 def _l2_normalization(data, eps=1e-10, mode="instance"):
     if mode == "instance":
         n = jnp.sqrt(jnp.sum(jnp.square(data.reshape(data.shape[0], -1)), axis=1) + eps)
