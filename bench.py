@@ -1,0 +1,59 @@
+"""Driver benchmark: ResNet-50 batch-32 inference throughput on one chip.
+
+Mirrors the reference's scoring benchmark
+(example/image-classification/benchmark_score.py; published P100 number:
+713.17 img/s at batch 32, docs/faq/perf.md:138-148 — see BASELINE.md).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 713.17  # ResNet-50 inference, batch 32, P100 (BASELINE.md)
+BATCH = 32
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    ctx = mx.tpu() if jax.default_backend() in ("tpu", "axon") else mx.cpu()
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    exe = sym.simple_bind(ctx, grad_req="null",
+                          data=(BATCH, 3, 224, 224))
+    # random weights — throughput doesn't depend on values
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.uniform(
+        0, 1, (BATCH, 3, 224, 224)).astype(np.float32)
+
+    for _ in range(WARMUP):
+        exe.forward(is_train=False)
+        exe.outputs[0].wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        exe.forward(is_train=False)
+    exe.outputs[0].wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_inference_batch32",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""TPU parallelism layer: device meshes, shardings, and collectives.
+
+This package is where the new framework goes beyond the reference's
+data-parallel ceiling (SURVEY.md §2.5: MXNet v1.0 has DP + manual model
+placement only — no tensor/pipeline/sequence/expert parallelism).  On TPU the
+idiomatic stack is a `jax.sharding.Mesh` with named axes and XLA collectives
+over ICI, so all five parallelism styles are first-class here:
+
+- dp  — data parallel: batch sharded, gradients psum'd (replaces
+        kvstore comm.h / kvstore_nccl.h / ps-lite, ref §2.5)
+- tp  — tensor parallel: weight matrices sharded, activations all-gathered /
+        reduce-scattered by XLA from sharding annotations
+- pp  — pipeline parallel: layer stages on mesh slices, microbatched
+- sp  — sequence/context parallel: sequence dim sharded, ring attention
+        ppermutes KV blocks around the ICI ring
+- ep  — expert parallel: MoE experts sharded, all_to_all dispatch
+
+Everything composes through `pjit`/`shard_map` over one Mesh.
+"""
+from .mesh import (  # noqa: F401
+    MeshSpec, create_mesh, current_mesh, set_current_mesh, local_mesh,
+    batch_sharding, replicated_sharding, shard_params_rule,
+)
+from .ring import ring_attention, ring_self_attention  # noqa: F401
+from .moe import MoELayer, moe_ffn  # noqa: F401
+from .pipeline import pipeline_stages  # noqa: F401
+from .train import ShardedTrainStep  # noqa: F401

@@ -1,0 +1,108 @@
+"""Device mesh management.
+
+The mesh is the TPU-native replacement for the reference's device lists
+(Module's `context=[mx.gpu(i), ...]`, executor_group.py:266 decide_slices) and
+its comm topology (comm.h P2P rings, ps-lite server graph).  One global Mesh
+with named axes; shardings are `NamedSharding(mesh, PartitionSpec(...))`.
+Collectives ride ICI within a slice and DCN across slices — XLA picks the
+route from device coordinates; nothing here needs to know which is which.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: data, pipeline(stage), expert, tensor(model), sequence
+AXIS_ORDER = ("dp", "pp", "ep", "tp", "sp")
+
+_CURRENT_MESH = None
+
+
+@dataclass
+class MeshSpec:
+    """Named axis sizes; axes of size 1 are kept (harmless to XLA) so a
+    single spec works from 1 chip to a pod."""
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def sizes(self):
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    @property
+    def n_devices(self):
+        return int(np.prod(self.sizes()))
+
+
+def create_mesh(spec=None, devices=None, **axis_sizes):
+    """Create a Mesh.  create_mesh(dp=4, tp=2) or create_mesh(MeshSpec(...)).
+
+    Unspecified axes default to 1; if no axis is given, all devices go to dp
+    (pure data parallel — the reference's only mode).
+    """
+    if spec is None:
+        spec = MeshSpec(**axis_sizes) if axis_sizes else None
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec(dp=len(devices))
+    if spec.n_devices != len(devices):
+        raise ValueError("mesh spec %s needs %d devices, got %d" %
+                         (spec, spec.n_devices, len(devices)))
+    dev_array = np.array(devices).reshape(spec.sizes())
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_mesh(**axis_sizes):
+    """Mesh over this host's addressable devices only."""
+    return create_mesh(devices=jax.local_devices(), **axis_sizes)
+
+
+def set_current_mesh(mesh):
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    return mesh
+
+
+def current_mesh():
+    """The process-wide default mesh (created lazily: all devices on dp)."""
+    global _CURRENT_MESH
+    if _CURRENT_MESH is None:
+        _CURRENT_MESH = create_mesh()
+    return _CURRENT_MESH
+
+
+def batch_sharding(mesh, extra_axes=()):
+    """Shard dim 0 (batch) over dp; optionally dim 1 (sequence) over sp."""
+    spec = [("dp",)]
+    for a in extra_axes:
+        spec.append((a,) if a else None)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_params_rule(mesh, name, shape):
+    """Default parameter partitioning rule.
+
+    2D weights (out, in): shard the larger dim over tp when divisible —
+    the megatron-style column/row split emerges from XLA's propagation of
+    these annotations.  Everything else replicates (dp gradients still
+    psum via the batch sharding).
+    """
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and len(shape) == 2:
+        if shape[0] % tp == 0:
+            return NamedSharding(mesh, P("tp", None))
+        if shape[1] % tp == 0:
+            return NamedSharding(mesh, P(None, "tp"))
+    if tp > 1 and len(shape) == 4 and shape[0] % tp == 0:
+        # conv weights (O, I, kh, kw): shard output channels
+        return NamedSharding(mesh, P("tp", None, None, None))
+    return NamedSharding(mesh, P())
