@@ -57,7 +57,9 @@ class Initializer:
             klass, kwargs = json.loads(init)
             _INITIALIZER_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
         else:
-            if desc.endswith("weight"):
+            if desc.endswith("weight") or desc.endswith("parameters"):
+                # "parameters" = fused-RNN flat vectors (FusedRNN initializer
+                # unpacks them per-gate; ref: mx.init.FusedRNN)
                 self._init_weight(desc, arr)
             elif desc.endswith("bias"):
                 self._init_bias(desc, arr)
@@ -368,3 +370,8 @@ class FusedRNN(Initializer):
             else:
                 self._init(arg_desc, args[name])
         arr[:] = cell.pack_weights(args)["parameters"]
+
+
+# common aliases (ref: mx.init registry accepts "zeros"/"ones" names)
+_INITIALIZER_REGISTRY.setdefault("zeros", Zero)
+_INITIALIZER_REGISTRY.setdefault("ones", One)

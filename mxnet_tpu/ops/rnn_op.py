@@ -1,0 +1,191 @@
+"""Fused RNN operator: vanilla RNN / LSTM / GRU, multi-layer, bidirectional.
+
+Parity target: the reference's `RNN` op (src/operator/rnn-inl.h +
+cudnn_rnn-inl.h:152) — which on CPU is `LOG(FATAL) "RNN is only available
+for gpu"` (rnn.cc:33).  Here the cell steps are a `lax.scan` per
+layer/direction: XLA fuses the gate matmuls into MXU-sized batched GEMMs,
+so one code path serves every backend — the GPU-only hole does not exist.
+
+Weight layout matches the reference/cuDNN flat vector (GetRnnParamSize,
+rnn-inl.h): per layer, per direction: W [G*H, in], R [G*H, H] for all
+layers first, then biases bW [G*H], bR [G*H] in the same order.  Gate order:
+LSTM i,f,g,o; GRU r,z,n (cuDNN order).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, pInt, pFloat, pBool, pStr
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    g = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        size += dirs * g * state_size * (in_sz + state_size)  # W + R
+    size += num_layers * dirs * 2 * g * state_size  # biases
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size,
+                   bidirectional, mode):
+    """Split the flat parameter vector into per-(layer,dir) W/R/bW/bR."""
+    g = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    h = state_size
+    ws, off = [], 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * dirs
+        for d in range(dirs):
+            w = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            r = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            ws.append([w, r, None, None])
+    for layer in range(num_layers):
+        for d in range(dirs):
+            i = layer * dirs + d
+            ws[i][2] = params[off:off + g * h]
+            off += g * h
+            ws[i][3] = params[off:off + g * h]
+            off += g * h
+    return ws
+
+
+def _cell_step(mode, h_prev, c_prev, x_proj, w_r, b_r):
+    """One time step given precomputed input projection x_proj [N, G*H]."""
+    hsz = h_prev.shape[-1]
+    rec = h_prev @ w_r.T + b_r
+    if mode == "lstm":
+        z = x_proj + rec
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        hn = o * jnp.tanh(c)
+        return hn, c
+    if mode == "gru":
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        rr, rz, rn = jnp.split(rec, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + rr)
+        z = jax.nn.sigmoid(xz + rz)
+        n = jnp.tanh(xn + r * rn)
+        hn = (1.0 - z) * n + z * h_prev
+        return hn, c_prev
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    hn = act(x_proj + rec)
+    return hn, c_prev
+
+
+def _run_layer(mode, x, h0, c0, w, r, bw, br, reverse=False):
+    """x: [T, N, in]; returns (out [T, N, H], hT, cT).
+    The input projection for all timesteps is one big GEMM (MXU-friendly);
+    the scan carries only the recurrent matmul."""
+    x_proj = jnp.einsum("tni,gi->tng", x, w) + bw
+
+    def step(carry, xp):
+        h_prev, c_prev = carry
+        hn, cn = _cell_step(mode, h_prev, c_prev, xp, r, br)
+        return (hn, cn), hn
+
+    xs = x_proj[::-1] if reverse else x_proj
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        out = out[::-1]
+    return out, hT, cT
+
+
+def _rnn_impl(key, data, parameters, state, *maybe_cell, state_size=0,
+              num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+              state_outputs=False, lstm_state_clip_min=None,
+              lstm_state_clip_max=None, lstm_state_clip_nan=False,
+              _train=False):
+    has_cell = mode == "lstm"
+    state_cell = maybe_cell[0] if has_cell else None
+    T, N, input_size = data.shape
+    h = int(state_size)
+    L = int(num_layers)
+    dirs = 2 if bidirectional else 1
+    ws = _unpack_params(parameters, L, input_size, h, bidirectional, mode)
+
+    x = data
+    h_states, c_states = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            i = layer * dirs + d
+            w, r, bw, br = ws[i]
+            h0 = state[i]
+            c0 = state_cell[i] if has_cell else jnp.zeros_like(h0)
+            out, hT, cT = _run_layer(mode, x, h0, c0, w, r, bw, br,
+                                     reverse=(d == 1))
+            if mode == "lstm" and lstm_state_clip_min is not None:
+                cT = jnp.clip(cT, lstm_state_clip_min, lstm_state_clip_max)
+            outs.append(out)
+            h_states.append(hT)
+            c_states.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and _train and layer != L - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), 0.0)
+
+    hy = jnp.stack(h_states)
+    if has_cell:
+        return x, hy, jnp.stack(c_states)
+    return x, hy
+
+
+def _rnn_num_outputs(attrs):
+    # visible outputs: output [+ hy [+ cy]] when state_outputs
+    so = attrs.get("state_outputs")
+    mode = attrs.get("mode", "lstm")
+    if so in (True, "True", "true", 1, "1"):
+        return 3 if mode == "lstm" else 2
+    return 1
+
+
+def _rnn_infer_shape(in_shapes, attrs):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, None
+    T, N, input_size = dshape
+    h = int(attrs["state_size"])
+    L = int(attrs["num_layers"])
+    bid = bool(attrs.get("bidirectional", False))
+    mode = attrs.get("mode", "lstm")
+    dirs = 2 if bid else 1
+    psize = rnn_param_size(L, input_size, h, bid, mode)
+    filled = list(in_shapes)
+    filled[1] = (psize,)
+    filled[2] = (L * dirs, N, h)
+    if mode == "lstm" and len(filled) > 3:
+        filled[3] = (L * dirs, N, h)
+    out = [(T, N, h * dirs), (L * dirs, N, h)]
+    if mode == "lstm":
+        out.append((L * dirs, N, h))
+    return filled, out
+
+
+register("RNN", _rnn_impl,
+         input_names=("data", "parameters", "state", "state_cell"),
+         num_inputs=lambda attrs: 4 if attrs.get("mode", "lstm") == "lstm"
+         else 3,
+         num_outputs=_rnn_num_outputs,
+         infer_shape=_rnn_infer_shape,
+         needs_rng=True, takes_train_flag=True,
+         params={
+             "state_size": (pInt, 0), "num_layers": (pInt, 1),
+             "bidirectional": (pBool, False), "mode": (pStr, "lstm"),
+             "p": (pFloat, 0.0), "state_outputs": (pBool, False),
+             "lstm_state_clip_min": (pFloat, None),
+             "lstm_state_clip_max": (pFloat, None),
+             "lstm_state_clip_nan": (pBool, False),
+         })

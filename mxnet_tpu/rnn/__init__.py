@@ -1,1 +1,4 @@
-"""RNN cells + BucketSentenceIter (ref: python/mxnet/rnn/)."""
+"""Legacy RNN cells + bucketing io (ref: python/mxnet/rnn/)."""
+from .rnn_cell import *  # noqa: F401,F403
+from .io import BucketSentenceIter  # noqa: F401
+from . import rnn_cell  # noqa: F401

@@ -340,8 +340,12 @@ class Symbol:
         aux_shapes = [shapes.get((_find_var(order, n), 0))
                       for n in self.list_auxiliary_states()]
         out_shapes = [shapes.get((node, idx)) for node, idx in self._entries]
-        if not partial and any(s is None for s in arg_shapes + out_shapes):
-            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+        def _incomplete(s):
+            return s is None or any(int(d) == 0 for d in s)
+
+        if not partial and any(_incomplete(s) for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes)
+                       if _incomplete(s)]
             raise MXNetError("infer_shape incomplete; unknown: %s" % missing)
         return arg_shapes, out_shapes, aux_shapes
 
@@ -364,7 +368,12 @@ class Symbol:
 
     def _infer(self, known_shapes, known_dtypes):
         """Joint fixed-point shape+dtype inference over the graph
-        (ref: infer_graph_attr_pass.cc — same single-generic-pass idea)."""
+        (ref: infer_graph_attr_pass.cc — same single-generic-pass idea).
+
+        Partial shapes follow MXNet semantics: a 0 dim means "unknown dim"
+        (deferred params pass shape=(0,...), begin_state passes (0, H)).
+        Partials flow through inference and merge per-dim as information
+        arrives; a shape is complete once no dim is 0."""
         order = self._topo()
         shapes = {}
         dtypes = {}
@@ -374,29 +383,81 @@ class Symbol:
                 if s is None and "__shape__" in node.attrs:
                     from ..base import str_to_attr
                     s = tuple(str_to_attr(node.attrs["__shape__"]))
+                if s is not None and all(int(d) == 0 for d in s):
+                    s = None  # all-unknown partial carries no information
                 shapes[(node, 0)] = tuple(s) if s is not None else None
                 dt = known_dtypes.get(node.name)
                 if dt is None and "__dtype__" in node.attrs:
                     dt = np_dtype(node.attrs["__dtype__"])
                 dtypes[(node, 0)] = dt
 
-        for _ in range(10):
+        def complete(s):
+            return s is not None and all(int(d) != 0 for d in s)
+
+        def merge(old, new):
+            """Unify two partial shapes, preferring known dims."""
+            if new is None:
+                return old
+            new = tuple(int(d) for d in new)
+            if old is None or len(old) != len(new):
+                return new
+            return tuple(n if o == 0 else o for o, n in zip(old, new))
+
+        def store(table, key, new_s):
+            merged = merge(table.get(key), new_s)
+            if merged != table.get(key):
+                table[key] = merged
+                return True
+            return False
+
+        def eval_partial(op, eval_ins, dts, a2):
+            """eval_shape with unknown (0) dims.  Complete inputs evaluate
+            directly; partials evaluate twice with the unknown dims
+            substituted by two sentinels — output dims that differ between
+            the runs depend on an unknown input and are reported as 0
+            (unknown), dims that agree are genuinely known (the Concat/Pad
+            dim-combining case).  Output dtypes never depend on dims, so
+            they are valid either way."""
+            if all(complete(s) for s in eval_ins):
+                return eval_shape_op(op, eval_ins, dts, a2)
+
+            def sub(v):
+                return [tuple(v if int(d) == 0 else int(d) for d in s)
+                        for s in eval_ins]
+            out1, dts1 = eval_shape_op(op, sub(1), dts, a2)
+            out2, _ = eval_shape_op(op, sub(2), dts, a2)
+            outs = [tuple(d1 if d1 == d2 else 0
+                          for d1, d2 in zip(s1, s2)) if len(s1) == len(s2)
+                    else None
+                    for s1, s2 in zip(out1, out2)]
+            return outs, dts1
+
+        # per-node attrs / output counts are invariant across sweeps
+        node_info = {}
+        for node in order:
+            if node.is_var:
+                continue
+            op = get_op(node.op_name)
+            attrs = op.normalize_attrs(node.attrs)
+            if op.key_var_num_args and not attrs.get(op.key_var_num_args):
+                attrs[op.key_var_num_args] = len(node.inputs)
+            node_info[node] = (op, attrs, node.num_outputs(),
+                               len(op.mutate_map))
+
+        for _ in range(len(order) + 10):
             changed = False
             for node in order:
                 if node.is_var:
                     continue
-                op = get_op(node.op_name)
-                attrs = op.normalize_attrs(node.attrs)
-                if op.key_var_num_args and not attrs.get(op.key_var_num_args):
-                    attrs[op.key_var_num_args] = len(node.inputs)
+                op, attrs, n_out, n_state = node_info[node]
                 in_entries = node.inputs
                 in_shapes = [shapes.get((n, i)) for n, i in in_entries]
                 in_dtypes = [dtypes.get((n, i)) for n, i in in_entries]
-                n_out = node.num_outputs()
-                n_state = len(op.mutate_map)
                 # already fully inferred?
-                if all(shapes.get((node, i)) is not None for i in range(n_out)) \
-                        and all(s is not None for s in in_shapes):
+                if all(complete(shapes.get((node, i))) for i in range(n_out)) \
+                        and all(complete(s) for s in in_shapes) \
+                        and all(dtypes.get((node, i)) is not None
+                                for i in range(n_out)):
                     continue
                 filled, out_shapes = None, None
                 if op.infer_shape is not None:
@@ -405,30 +466,42 @@ class Symbol:
                     except Exception:
                         filled = None
                 elif all(s is not None for s in in_shapes):
+                    eval_ins = in_shapes
+                    # elementwise ops require identical input shapes, so
+                    # partials heal each other per-dim (ElemwiseShape rule)
+                    if (op.name.startswith("elemwise_")
+                            or op.name in ("_grad_add", "add_n",
+                                           "where")) \
+                            and len({len(s) for s in in_shapes}) == 1:
+                        acc = in_shapes[0]
+                        for s in in_shapes[1:]:
+                            acc = merge(acc, s)
+                        eval_ins = [acc] * len(in_shapes)
+                        filled = eval_ins
                     dts = [d if d is not None else np.float32 for d in in_dtypes]
                     a2 = {k: v for k, v in attrs.items() if k != "_train"}
                     if op.takes_train_flag:
                         a2["_train"] = True
                     try:
-                        out_shapes_all, out_dts = eval_shape_op(op, in_shapes, dts, a2)
+                        out_shapes_all, out_dts = eval_partial(
+                            op, eval_ins, dts, a2)
                     except Exception:
-                        continue
-                    out_shapes = out_shapes_all
-                    for i in range(min(n_out, len(out_dts))):
-                        if dtypes.get((node, i)) is None:
-                            dtypes[(node, i)] = out_dts[i]
-                            changed = True
-                    filled = in_shapes
+                        out_shapes_all, out_dts = None, None
+                    if out_shapes_all is not None:
+                        out_shapes = out_shapes_all
+                        # out dtypes are trustworthy once input dtypes are
+                        # real (not the float32 guess above)
+                        if all(d is not None for d in in_dtypes):
+                            for i in range(min(n_out, len(out_dts))):
+                                if dtypes.get((node, i)) is None:
+                                    dtypes[(node, i)] = out_dts[i]
+                                    changed = True
                 if filled is not None:
                     for (n, i), s in zip(in_entries, filled):
-                        if s is not None and shapes.get((n, i)) is None:
-                            shapes[(n, i)] = tuple(s)
-                            changed = True
+                        changed |= store(shapes, (n, i), s)
                 if out_shapes is not None:
                     for i, s in enumerate(out_shapes[:n_out + n_state]):
-                        if s is not None and shapes.get((node, i)) is None:
-                            shapes[(node, i)] = tuple(s)
-                            changed = True
+                        changed |= store(shapes, (node, i), s)
                 # dtype propagation: default = first known input dtype
                 known_dt = next((d for d in in_dtypes if d is not None), None)
                 if known_dt is not None:

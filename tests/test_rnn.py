@@ -1,0 +1,153 @@
+"""RNN tests: fused op numerics, gluon layers, legacy cells, bucketing.
+
+The reference could only test its fused RNN on GPU (rnn.cc:33 is a fatal on
+CPU); here the same op runs everywhere, so the numeric oracle is a plain
+numpy LSTM/GRU step.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.rnn_op import rnn_param_size
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, params, h0, c0, H):
+    """Single-layer unidirectional LSTM oracle, cuDNN flat layout."""
+    T, N, I = x.shape
+    g = 4
+    off = 0
+    W = params[off:off + g * H * I].reshape(g * H, I); off += g * H * I
+    R = params[off:off + g * H * H].reshape(g * H, H); off += g * H * H
+    bW = params[off:off + g * H]; off += g * H
+    bR = params[off:off + g * H]
+    h, c = h0.copy(), c0.copy()
+    outs = []
+    for t in range(T):
+        z = x[t] @ W.T + bW + h @ R.T + bR
+        i, f, gg, o = np.split(z, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        gg = np.tanh(gg)
+        c = f * c + i * gg
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_fused_lstm_matches_numpy():
+    T, N, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    ps = rnn_param_size(1, I, H, False, "lstm")
+    params = rng.uniform(-0.5, 0.5, ps).astype(np.float32)
+    x = rng.randn(T, N, I).astype(np.float32)
+    h0 = np.zeros((N, H), np.float32)
+    c0 = np.zeros((N, H), np.float32)
+    out = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                    mx.nd.array(h0[None]), mx.nd.array(c0[None]),
+                    state_size=H, num_layers=1, mode="lstm",
+                    state_outputs=True)
+    ref_out, ref_h, ref_c = _np_lstm(x, params.astype(np.float64), h0, c0, H)
+    np.testing.assert_allclose(out[0].asnumpy(), ref_out, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(out[1].asnumpy()[0], ref_h, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(out[2].asnumpy()[0], ref_c, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fused_rnn_shapes_bidirectional():
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    ps = rnn_param_size(L, I, H, True, "gru")
+    out = mx.nd.RNN(mx.nd.array(np.zeros((T, N, I), np.float32)),
+                    mx.nd.array(np.zeros(ps, np.float32)),
+                    mx.nd.array(np.zeros((2 * L, N, H), np.float32)),
+                    state_size=H, num_layers=L, bidirectional=True,
+                    mode="gru", state_outputs=True)
+    assert out[0].shape == (T, N, 2 * H)
+    assert out[1].shape == (2 * L, N, H)
+
+
+def test_gluon_lstm_layer_trains():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import rnn, Trainer
+    net = rnn.LSTM(8, num_layers=1)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(6, 4, 5).astype(np.float32))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            y = net(x)
+            loss = mx.nd.sum(y * y)
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_gluon_cell_vs_fused():
+    """Unrolled LSTMCell == fused LSTM when fed identical weights."""
+    from mxnet_tpu.gluon import rnn
+    H, I, T, N = 4, 3, 5, 2
+    rng = np.random.RandomState(1)
+    fused = rnn.LSTM(H, input_size=I)
+    fused.initialize()
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused layer weights into the cell
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    x = mx.nd.array(rng.randn(T, N, I).astype(np.float32))
+    y_fused = fused(x)
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(y_fused.asnumpy(), outs.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_legacy_fused_cell_unroll_and_pack():
+    from mxnet_tpu import rnn
+    data = mx.sym.Variable("data")
+    cell = rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                            get_next_state=True)
+    outputs, states = cell.unroll(6, data, layout="NTC", merge_outputs=True)
+    _, oshapes, _ = outputs.infer_shape(data=(4, 6, 5))
+    assert oshapes[0] == (4, 6, 8)
+
+    c2 = rnn.FusedRNNCell(4, num_layers=2, mode="gru", bidirectional=True,
+                          prefix="g_")
+    n = rnn_param_size(2, 3, 4, True, "gru")
+    arr = mx.nd.array(np.arange(n, dtype="float32"))
+    un = c2.unpack_weights({"g_parameters": arr})
+    re = c2.pack_weights(un)
+    np.testing.assert_allclose(re["g_parameters"].asnumpy(), arr.asnumpy())
+
+
+def test_legacy_stacked_cells_infer():
+    from mxnet_tpu import rnn
+    data = mx.sym.Variable("data")
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(rnn.GRUCell(8, prefix="l1_"))
+    out, _ = stack.unroll(4, data, merge_outputs=True)
+    _, oshapes, _ = out.infer_shape(data=(2, 4, 3))
+    assert oshapes[0] == (2, 4, 8)
+
+
+def test_bucket_sentence_iter():
+    from mxnet_tpu.rnn import BucketSentenceIter
+    sent = [[1, 2, 3], [4, 5], [1, 2, 3, 4, 5, 6], [2, 3],
+            [1, 1, 1], [2, 2, 2], [3, 3], [4, 4]]
+    it = BucketSentenceIter(sent, batch_size=2, buckets=[3, 6])
+    keys = set()
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.data[0].shape[1] == batch.bucket_key
+        keys.add(batch.bucket_key)
+        n += 1
+    assert n >= 3
