@@ -1,0 +1,3 @@
+"""Vision datasets + transforms (ref: python/mxnet/gluon/data/vision.py)."""
+from .datasets import *  # noqa: F401,F403
+from . import transforms  # noqa: F401

@@ -1,0 +1,172 @@
+"""Vision datasets (parity: python/mxnet/gluon/data/vision.py).
+
+This environment has no network egress, so datasets read the standard file
+formats from a local root (default ~/.mxnet/datasets/<name>) and raise a
+clear error when absent — the reference's auto-download becomes
+place-the-files-here.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..dataset import _DownloadedDataset, RecordFileDataset
+from ....ndarray import array as nd_array
+from .... import image as image_mod
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
+
+
+def _find(root, names):
+    for name in names:
+        p = os.path.join(root, name)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(
+        "none of %s found under %s; this environment has no network "
+        "egress — place the dataset files there manually" % (names, root))
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx-ubyte files (ref: vision.py:MNIST)."""
+
+    _base = "mnist"
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(os.path.expanduser("~"), ".mxnet",
+                                    "datasets", self._base)
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        img_path = _find(self._root, [img_name, img_name + ".gz"])
+        lbl_path = _find(self._root, [lbl_name, lbl_name + ".gz"])
+        data = _read_idx_images(img_path)
+        label = _read_idx_labels(lbl_path)
+        self._data = nd_array(data, dtype=np.uint8)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    _base = "fashion-mnist"
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python pickle batches (ref: vision.py:CIFAR10)."""
+
+    _prefix = "cifar-10-batches-py"
+    _train_batches = ["data_batch_%d" % i for i in range(1, 6)]
+    _test_batches = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(os.path.expanduser("~"), ".mxnet",
+                                    "datasets", "cifar10")
+        super().__init__(root, transform)
+
+    def _read_batch(self, path):
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, np.asarray(d[self._label_key], np.int32)
+
+    def _get_data(self):
+        names = self._train_batches if self._train else self._test_batches
+        base = self._root
+        if os.path.isdir(os.path.join(base, self._prefix)):
+            base = os.path.join(base, self._prefix)
+        datas, labels = [], []
+        for name in names:
+            d, l = self._read_batch(_find(base, [name]))
+            datas.append(d)
+            labels.append(l)
+        self._data = nd_array(np.concatenate(datas), dtype=np.uint8)
+        self._label = np.concatenate(labels)
+
+
+class CIFAR100(CIFAR10):
+    _prefix = "cifar-100-python"
+    _train_batches = ["train"]
+    _test_batches = ["test"]
+
+    def __init__(self, root=None, fine_label=True, train=True,
+                 transform=None):
+        self._label_key = b"fine_labels" if fine_label else b"coarse_labels"
+        root = root or os.path.join(os.path.expanduser("~"), ".mxnet",
+                                    "datasets", "cifar100")
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a .rec file (ref: vision.py:ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        img = image_mod.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(_DownloadedDataset):
+    """root/<class>/<img>.jpg layout (ref: vision.py:ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._flag = flag
+        self._exts = [".jpg", ".jpeg", ".png"]
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+        self._label = [i[1] for i in self.items]
+
+    def __getitem__(self, idx):
+        img = image_mod.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,1 +1,3 @@
 """Image iterators + augmenters (ref: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from . import image  # noqa: F401

@@ -1,0 +1,100 @@
+"""Gluon data + image pipeline tests (parity model: tests/python/unittest/
+test_gluon_data.py, test_image.py, test_recordio.py in the reference)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu import recordio
+
+
+def test_array_dataset_and_loader():
+    X = np.random.rand(20, 3).astype(np.float32)
+    Y = np.arange(20, dtype=np.float32)
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 20
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(x0, X[3])
+    dl = gdata.DataLoader(ds, batch_size=6, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)  # last_batch='keep'
+    dl2 = gdata.DataLoader(ds, batch_size=6, last_batch="discard")
+    assert len(list(dl2)) == 3
+
+
+def test_dataloader_threaded_workers():
+    ds = gdata.ArrayDataset(np.arange(64, dtype=np.float32))
+    dl = gdata.DataLoader(ds, batch_size=8, num_workers=3)
+    got = np.concatenate([b.asnumpy() for b in dl])
+    np.testing.assert_allclose(np.sort(got), np.arange(64))
+
+
+def test_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(10))).transform(lambda x: x * 2)
+    assert ds[4] == 8
+
+
+def test_recordio_roundtrip():
+    tmp = tempfile.mkdtemp()
+    rec = os.path.join(tmp, "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(b"record%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(rec, "r")
+    for i in range(5):
+        assert r.read() == b"record%d" % i
+    r.close()
+
+
+def test_indexed_recordio_and_image_dataset():
+    import cv2
+    tmp = tempfile.mkdtemp()
+    rec = os.path.join(tmp, "t.rec")
+    idx = os.path.join(tmp, "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(6):
+        arr = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", arr)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.tobytes()))
+    w.close()
+    ds = gdata.vision.ImageRecordDataset(rec)
+    assert len(ds) == 6
+    img, label = ds[4]
+    assert img.shape == (8, 8, 3)
+    assert label == 4.0
+
+    it = mx.image.ImageIter(3, (3, 8, 8), path_imgrec=rec, path_imgidx=idx)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 8, 8)
+
+
+def test_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = mx.nd.array((np.random.rand(32, 32, 3) * 255).astype(np.uint8))
+    t = T.Compose([T.Resize(16), T.ToTensor(),
+                   T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = t(img)
+    assert out.shape == (3, 16, 16)
+    a = out.asnumpy()
+    assert a.min() >= -1.001 and a.max() <= 1.001
+
+
+def test_augmenters():
+    img = mx.nd.array((np.random.rand(24, 24, 3) * 255).astype(np.uint8))
+    augs = mx.image.CreateAugmenter((3, 16, 16), resize=20, rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, hue=0.1, pca_noise=0.1)
+    out = img
+    for aug in augs:
+        out = aug(out)
+    arr = out.asnumpy() if isinstance(out, mx.nd.NDArray) else out
+    assert arr.shape == (16, 16, 3)
+    assert np.isfinite(arr).all()
