@@ -1,0 +1,346 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Parity: include/mxnet/ndarray.h:59-63 storage types + python/mxnet/ndarray/
+sparse.py (1,280 LoC).  TPU-native design (SURVEY.md §7 hard-part 7): XLA has
+no sparse buffers, so sparse arrays hold dense aux arrays (indices/indptr/
+data) and computations lower to gather/scatter-add — which is exactly how
+embedding-style row_sparse gradients want to execute on the MXU anyway.
+The API (creation, aux_data access, tostype, retain, sparse dot) matches the
+reference so sparse training scripts run unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from .ndarray import NDArray, array as nd_array, zeros as nd_zeros, _invoke
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "empty", "array"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base for sparse storage (ref: sparse.py:BaseSparseNDArray)."""
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iadd__(self, other):
+        raise MXNetError("not supported for this storage type")
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        out = self.todense().astype(dtype)
+        return out.tostype(self.stype)
+
+    def todense(self):
+        raise NotImplementedError
+
+    def copy(self):
+        return self.todense().tostype(self.stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First dim sparse: data[K, ...] at rows indices[K]
+    (ref: sparse.py:RowSparseNDArray)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        dense_placeholder = jnp.zeros((0,))
+        super().__init__(dense_placeholder, ctx)
+        self._stype = "row_sparse"
+        self._data_arr = data if isinstance(data, NDArray) else nd_array(data)
+        self._indices = indices if isinstance(indices, NDArray) \
+            else nd_array(indices, dtype=np.int64)
+        self._sshape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def dtype(self):
+        return self._data_arr.dtype
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def data(self):
+        return self._data_arr
+
+    def _aux_data(self, i):
+        assert i == 0
+        return self._indices
+
+    def todense(self):
+        out = jnp.zeros(self._sshape, np_dtype(self.dtype))
+        idx = self._indices._h.array.astype(jnp.int32)
+        out = out.at[idx].set(self._data_arr._h.array)
+        return NDArray(out)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError("cast_storage from row_sparse to %s is not "
+                         "supported" % stype)
+
+    def retain(self, row_ids):
+        """Keep only the given rows (ref: sparse retain op)."""
+        rid = row_ids.asnumpy().astype(np.int64) \
+            if isinstance(row_ids, NDArray) else np.asarray(row_ids, np.int64)
+        cur = self._indices.asnumpy()
+        mask = np.isin(cur, rid)
+        new_idx = cur[mask]
+        data = self._data_arr.asnumpy()[mask]
+        return RowSparseNDArray(nd_array(data, dtype=self.dtype),
+                                nd_array(new_idx, dtype=np.int64),
+                                self._sshape)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(str(d) for d in self._sshape), self.context)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            return self.todense().copyto(other)
+        return super().copyto(other)
+
+    def wait_to_read(self):
+        self._data_arr.wait_to_read()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ref: sparse.py:CSRNDArray)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(jnp.zeros((0,)), ctx)
+        self._stype = "csr"
+        self._data_arr = data if isinstance(data, NDArray) else nd_array(data)
+        self._indices = indices if isinstance(indices, NDArray) \
+            else nd_array(indices, dtype=np.int64)
+        self._indptr = indptr if isinstance(indptr, NDArray) \
+            else nd_array(indptr, dtype=np.int64)
+        self._sshape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def dtype(self):
+        return self._data_arr.dtype
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def data(self):
+        return self._data_arr
+
+    def _aux_data(self, i):
+        return (self._indptr, self._indices)[i]
+
+    def todense(self):
+        data = self._data_arr.asnumpy()
+        indices = self._indices.asnumpy()
+        indptr = self._indptr.asnumpy()
+        out = np.zeros(self._sshape, np_dtype(self.dtype))
+        for r in range(self._sshape[0]):
+            cols = indices[indptr[r]:indptr[r + 1]]
+            out[r, cols] = data[indptr[r]:indptr[r + 1]]
+        return nd_array(out, dtype=self.dtype)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError("cast_storage from csr to %s is not supported"
+                         % stype)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(str(d) for d in self._sshape), self.context)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._sshape[0]
+            data = self._data_arr.asnumpy()
+            indices = self._indices.asnumpy()
+            indptr = self._indptr.asnumpy()
+            new_ptr = indptr[start:stop + 1] - indptr[start]
+            lo, hi = indptr[start], indptr[stop]
+            return CSRNDArray(nd_array(data[lo:hi], dtype=self.dtype),
+                              nd_array(indices[lo:hi], dtype=np.int64),
+                              nd_array(new_ptr, dtype=np.int64),
+                              (stop - start, self._sshape[1]))
+        raise MXNetError("CSRNDArray only supports slice on axis 0")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source
+    (ref: sparse.py:row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data if not isinstance(data, NDArray)
+                          else data.asnumpy(),
+                          np_dtype(dtype or np.float32))
+        indices = np.asarray(indices if not isinstance(indices, NDArray)
+                             else indices.asnumpy(), np.int64)
+        o = np.argsort(indices)
+        return RowSparseNDArray(nd_array(data[o], dtype=data.dtype),
+                                nd_array(indices[o], dtype=np.int64),
+                                shape or ((int(indices.max()) + 1,)
+                                          + data.shape[1:]))
+    if isinstance(arg1, NDArray):
+        return arg1.tostype("row_sparse")
+    arr = np.asarray(arg1, np_dtype(dtype or np.float32))
+    return _dense_np_to_rowsparse(arr, shape or arr.shape)
+
+
+def _dense_np_to_rowsparse(arr, shape):
+    nz = np.where(np.any(arr.reshape(arr.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(nd_array(arr[nz], dtype=arr.dtype),
+                            nd_array(nz.astype(np.int64), dtype=np.int64),
+                            shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (ref: sparse.py:csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(nd_array(np.asarray(data,
+                                              np_dtype(dtype or np.float32))),
+                          nd_array(np.asarray(indices, np.int64),
+                                   dtype=np.int64),
+                          nd_array(np.asarray(indptr, np.int64),
+                                   dtype=np.int64),
+                          shape)
+    if isinstance(arg1, NDArray):
+        return arg1.tostype("csr")
+    arr = np.asarray(arg1, np_dtype(dtype or np.float32))
+    return _dense_np_to_csr(arr, shape or arr.shape)
+
+
+def _dense_np_to_csr(arr, shape):
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(arr.shape[0]):
+        cols = np.nonzero(arr[r])[0]
+        indices.extend(cols.tolist())
+        data.extend(arr[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(nd_array(np.asarray(data, arr.dtype)),
+                      nd_array(np.asarray(indices, np.int64),
+                               dtype=np.int64),
+                      nd_array(np.asarray(indptr, np.int64), dtype=np.int64),
+                      shape)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = np_dtype(dtype or np.float32)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            nd_array(np.zeros((0,) + tuple(shape[1:]), dtype)),
+            nd_array(np.zeros((0,), np.int64), dtype=np.int64), shape)
+    if stype == "csr":
+        return CSRNDArray(
+            nd_array(np.zeros((0,), dtype)),
+            nd_array(np.zeros((0,), np.int64), dtype=np.int64),
+            nd_array(np.zeros((shape[0] + 1,), np.int64), dtype=np.int64),
+            shape)
+    if stype == "default":
+        return nd_zeros(shape, ctx, dtype=dtype)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, (RowSparseNDArray, CSRNDArray)):
+        return source_array
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(source_array):
+            csr = source_array.tocsr()
+            return CSRNDArray(nd_array(csr.data, dtype=dtype or csr.dtype),
+                              nd_array(csr.indices.astype(np.int64),
+                                       dtype=np.int64),
+                              nd_array(csr.indptr.astype(np.int64),
+                                       dtype=np.int64), csr.shape)
+    except ImportError:
+        pass
+    raise MXNetError("use row_sparse_array/csr_matrix for dense sources")
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (ref: cast_storage op,
+    src/operator/tensor/cast_storage-inl.h)."""
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    dense = arr.asnumpy()
+    if stype == "row_sparse":
+        return _dense_np_to_rowsparse(dense, arr.shape)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr storage requires a 2D array")
+        return _dense_np_to_csr(dense, arr.shape)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: src/operator/tensor/dot-inl.h).  csr x dense
+    lowers to a gather/segment multiply; row_sparse falls back to dense —
+    on TPU the MXU wants the dense batched form anyway."""
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
+                                                      BaseSparseNDArray):
+        data = lhs.data._h.array
+        indices = lhs.indices.asnumpy()
+        indptr = lhs.indptr.asnumpy()
+        n_rows = lhs.shape[0]
+        rows = np.repeat(np.arange(n_rows), np.diff(indptr))
+        r = rhs._h.array
+        if transpose_a:
+            # out[k, :] = sum over nnz with col==k of data * rhs[row]
+            gathered = r[rows.astype(np.int32)] * data[:, None]
+            out = jnp.zeros((lhs.shape[1], r.shape[1]), r.dtype)
+            out = out.at[jnp.asarray(indices.astype(np.int32))].add(gathered)
+        else:
+            gathered = r[jnp.asarray(indices.astype(np.int32))] * data[:, None]
+            out = jnp.zeros((n_rows, r.shape[1]), r.dtype)
+            out = out.at[jnp.asarray(rows.astype(np.int32))].add(gathered)
+        return NDArray(out)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l.dot(r, transpose_a, transpose_b)
+
+
+def add(lhs, rhs):
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r
+
+
+def retain(data, indices):
+    """Sparse retain (ref: sparse_retain op)."""
+    if isinstance(data, RowSparseNDArray):
+        return data.retain(indices)
+    raise MXNetError("retain only supports row_sparse")

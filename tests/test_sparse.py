@@ -1,0 +1,82 @@
+"""Sparse NDArray tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(*shape).astype(np.float32)
+    d[rng.rand(*shape) > density] = 0
+    return d
+
+
+def test_row_sparse_roundtrip():
+    d = _rand_dense((8, 5))
+    rs = sparse.row_sparse_array(d)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(rs.todense().asnumpy(), d)
+    np.testing.assert_allclose(rs.asnumpy(), d)
+    rs2 = mx.nd.array(d).tostype("row_sparse")
+    np.testing.assert_allclose(rs2.asnumpy(), d)
+
+
+def test_row_sparse_from_data_indices():
+    data = np.ones((2, 3), np.float32)
+    rs = sparse.row_sparse_array((data, [4, 1]), shape=(6, 3))
+    dense = rs.asnumpy()
+    assert dense[1].sum() == 3 and dense[4].sum() == 3
+    assert dense.sum() == 6
+    # indices come back sorted
+    np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 4])
+
+
+def test_csr_roundtrip_and_dot():
+    d = _rand_dense((6, 4), seed=1)
+    csr = sparse.csr_matrix(d)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), d)
+    rhs = np.random.RandomState(2).rand(4, 3).astype(np.float32)
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5, atol=1e-5)
+    lhsT = np.random.RandomState(3).rand(6, 2).astype(np.float32)
+    outT = sparse.dot(csr, mx.nd.array(lhsT), transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(), d.T @ lhsT, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_csr_slice():
+    d = _rand_dense((6, 4), seed=4)
+    csr = sparse.csr_matrix(d)
+    sl = csr[1:4]
+    np.testing.assert_allclose(sl.asnumpy(), d[1:4])
+
+
+def test_retain():
+    d = _rand_dense((8, 3), density=1.0, seed=5)
+    rs = sparse.row_sparse_array(d)
+    kept = sparse.retain(rs, mx.nd.array([2.0, 5.0]))
+    dense = kept.asnumpy()
+    np.testing.assert_allclose(dense[2], d[2])
+    np.testing.assert_allclose(dense[5], d[5])
+    assert np.abs(dense).sum() == np.abs(d[2]).sum() + np.abs(d[5]).sum()
+
+
+def test_sparse_zeros():
+    rs = sparse.zeros("row_sparse", (4, 3))
+    assert rs.asnumpy().sum() == 0
+    csr = sparse.zeros("csr", (4, 3))
+    assert csr.asnumpy().sum() == 0
+
+
+def test_cast_storage():
+    d = _rand_dense((5, 5), seed=6)
+    nd = mx.nd.array(d)
+    for stype in ("row_sparse", "csr"):
+        s = sparse.cast_storage(nd, stype)
+        assert s.stype == stype
+        back = sparse.cast_storage(s, "default")
+        np.testing.assert_allclose(back.asnumpy(), d)
