@@ -50,3 +50,8 @@ from . import visualization
 from .visualization import plot_network
 from . import rnn
 from . import image
+from . import operator
+from . import models
+from . import parallel
+from . import predict
+from . import io_native

@@ -1,0 +1,246 @@
+"""Native (C++) runtime bindings: recordio fast path + dependency engine.
+
+The reference's native layer is C++ behind a flat C ABI consumed over
+ctypes (python/mxnet/base.py pattern); this package does the same for the
+components where native code actually matters on a TPU host: record IO
+with threaded prefetch (feeding the chip, SURVEY.md §2.4/§7 hard-part 8)
+and a host-side dependency engine (SURVEY.md §2.1).  Build is lazy: the
+first import compiles src/*.cc with g++ into a cached .so; every consumer
+falls back to the pure-Python path if a toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libmxnet_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    srcs = [os.path.join(_SRC_DIR, f) for f in ("recordio.cc", "engine.cc")]
+    if not all(os.path.exists(s) for s in srcs):
+        return None
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            path = _LIB_PATH
+            srcs = [os.path.join(_SRC_DIR, f)
+                    for f in ("recordio.cc", "engine.cc")]
+            if not os.path.exists(path) or any(
+                    os.path.exists(s)
+                    and os.path.getmtime(s) > os.path.getmtime(path)
+                    for s in srcs):
+                path = _build()
+            if path is None:
+                return None
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def _declare(lib):
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.rio_reader_open.restype = ctypes.c_void_p
+    lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.rio_reader_next.restype = u8p
+    lib.rio_reader_next.argtypes = [ctypes.c_void_p, i64p]
+    lib.rio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rio_reader_tell.restype = ctypes.c_int64
+    lib.rio_reader_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.rio_writer_write.restype = ctypes.c_int64
+    lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+    lib.rio_writer_tell.restype = ctypes.c_int64
+    lib.rio_writer_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_prefetch_open.restype = ctypes.c_void_p
+    lib.rio_prefetch_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.rio_prefetch_next.restype = u8p
+    lib.rio_prefetch_next.argtypes = [ctypes.c_void_p, i64p]
+    lib.rio_prefetch_close.argtypes = [ctypes.c_void_p]
+    lib.engine_create.restype = ctypes.c_void_p
+    lib.engine_create.argtypes = [ctypes.c_int]
+    lib.engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.engine_new_var.restype = ctypes.c_int64
+    lib.engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.engine_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, i64p,
+        ctypes.c_int, i64p, ctypes.c_int]
+    lib.engine_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.engine_wait_for_all.argtypes = [ctypes.c_void_p]
+
+
+class NativeRecordReader:
+    """Sequential reader over the native library."""
+
+    def __init__(self, path, prefetch=True, capacity=256):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._prefetch = prefetch
+        if prefetch:
+            self._h = lib.rio_prefetch_open(path.encode(), capacity)
+        else:
+            self._h = lib.rio_reader_open(path.encode())
+        if not self._h:
+            raise FileNotFoundError(2, "cannot open record file", path)
+        self._len = ctypes.c_int64(0)
+
+    def read(self):
+        """Next record payload as bytes, or None at EOF.  Raises on a
+        corrupt stream (bad magic / truncated payload) — matching the pure
+        Python framing's MXNetError instead of masking data loss as EOF."""
+        if self._prefetch:
+            ptr = self._lib.rio_prefetch_next(self._h,
+                                              ctypes.byref(self._len))
+        else:
+            ptr = self._lib.rio_reader_next(self._h, ctypes.byref(self._len))
+        if self._len.value == -1:
+            return None
+        if self._len.value < 0:
+            from ..base import MXNetError
+            raise MXNetError("invalid record magic (corrupt record file)")
+        return ctypes.string_at(ptr, self._len.value)
+
+    def seek(self, pos):
+        assert not self._prefetch, "prefetch reader is sequential"
+        self._lib.rio_reader_seek(self._h, pos)
+
+    def tell(self):
+        assert not self._prefetch
+        return self._lib.rio_reader_tell(self._h)
+
+    def close(self):
+        if self._h:
+            if self._prefetch:
+                self._lib.rio_prefetch_close(self._h)
+            else:
+                self._lib.rio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+
+class NativeRecordWriter:
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_writer_open(path.encode())
+        if not self._h:
+            raise FileNotFoundError(2, "cannot open record file", path)
+
+    def write(self, buf):
+        """Write one record; returns its byte offset (for .idx files)."""
+        return self._lib.rio_writer_write(self._h, bytes(buf), len(buf))
+
+    def tell(self):
+        return self._lib.rio_writer_tell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """Host-side dependency engine (ref semantics: Engine::Push/WaitForVar/
+    WaitForAll, include/mxnet/engine.h:96-291)."""
+
+    def __init__(self, num_workers=2):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.engine_create(num_workers)
+        self._keep = {}  # op id -> callback keepalive
+        self._next = 0
+        self._cb_lock = threading.Lock()
+
+    def new_var(self):
+        return self._lib.engine_new_var(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        """Schedule fn() honoring read/write ordering on the given vars."""
+        with self._cb_lock:
+            op_id = self._next
+            self._next += 1
+
+        def trampoline(_):
+            try:
+                fn()
+            finally:
+                with self._cb_lock:
+                    self._keep.pop(op_id, None)
+
+        cb = _CB_TYPE(trampoline)
+        with self._cb_lock:
+            self._keep[op_id] = cb
+        reads = (ctypes.c_int64 * len(const_vars))(*const_vars)
+        writes = (ctypes.c_int64 * len(mutable_vars))(*mutable_vars)
+        self._lib.engine_push(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            reads, len(const_vars), writes, len(mutable_vars))
+
+    def wait_for_var(self, var):
+        self._lib.engine_wait_for_var(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.engine_wait_for_all(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.engine_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

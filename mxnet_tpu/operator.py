@@ -1,0 +1,199 @@
+"""Custom Python operators (parity: python/mxnet/operator.py CustomOp/
+CustomOpProp + src/operator/custom/custom-inl.h).
+
+The reference runs custom ops through async C callbacks back into Python;
+here the imperative path simply calls the Python forward/backward, and the
+symbolic (jitted) path wraps them in `jax.pure_callback` so a Custom node
+can live inside a compiled graph — the TPU analog of the reference's
+"run this node on the frontend" escape hatch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, np_dtype
+from .ops.registry import register, pStr, pAny
+
+__all__ = ["CustomOp", "CustomOpProp", "register_op", "get_prop"]
+
+
+class CustomOp:
+    """Base class for custom operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace", "add"):
+            if req == "add":
+                dst[:] = dst[:] + src if hasattr(dst, "__getitem__") else src
+            else:
+                dst[:] = src
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (ref: operator.py:CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_PROP_REGISTRY = {}
+
+
+def register_op(reg_name):
+    """Decorator: register a CustomOpProp under op_type=reg_name
+    (ref: mx.operator.register)."""
+
+    def do_register(prop_cls):
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+# the reference exposes this as mx.operator.register
+register_cls = register_op
+
+
+def get_prop(op_type):
+    cls = _PROP_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError("custom op type %r is not registered" % op_type)
+    return cls()
+
+
+class _NumpyShim:
+    """Adapter handed to CustomOp.forward: holds a list of numpy arrays and
+    supports the dst[:] = src assignment convention."""
+
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+    def __getitem__(self, i):
+        return self.arrays[i]
+
+
+class _Slot:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __setitem__(self, key, src):
+        src = np.asarray(src.asnumpy() if hasattr(src, "asnumpy") else src)
+        if key == slice(None):
+            self.value = src.astype(self.value.dtype, copy=False)
+        else:
+            v = self.value.copy()
+            v[key] = src
+            self.value = v
+
+    def asnumpy(self):
+        return self.value
+
+
+def _custom_impl(*arrays, op_type=None, _train=False, **attrs):
+    """Custom op compute: runs the user's Python forward via pure_callback
+    so it is jit-safe; gradients flow via a custom_vjp calling the user's
+    backward the same way."""
+    prop = get_prop(op_type)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(a.shape) for a in arrays]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    out_dtypes = [arrays[0].dtype] * n_out
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), d)
+                    for s, d in zip(out_shapes, out_dtypes)]
+
+    def host_forward(*host_arrays):
+        op = prop.create_operator(None, in_shapes,
+                                  [a.dtype for a in host_arrays])
+        ins = [np.asarray(a) for a in host_arrays]
+        outs = [_Slot(np.zeros(tuple(s), np_dtype(d)))
+                for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train=True, req=["write"] * n_out,
+                   in_data=ins, out_data=outs, aux=[])
+        return tuple(o.value for o in outs)
+
+    @jax.custom_vjp
+    def fwd(*xs):
+        out = jax.pure_callback(host_forward, tuple(result_shape), *xs)
+        return out if n_out > 1 else (out[0],)
+
+    def fwd_fwd(*xs):
+        out = fwd(*xs)
+        return out, (xs, out)
+
+    def fwd_bwd(res, gs):
+        xs, outs = res
+
+        def host_backward(*args):
+            k = len(gs)
+            grad_arrays = [np.asarray(a) for a in args[:k]]
+            xs_arrays = [np.asarray(a) for a in args[k:k + len(xs)]]
+            out_arrays = [np.asarray(a) for a in args[k + len(xs):]]
+            op = prop.create_operator(None, in_shapes,
+                                      [a.dtype for a in xs_arrays])
+            igrads = [_Slot(np.zeros_like(a)) for a in xs_arrays]
+            op.backward(req=["write"] * len(xs), out_grad=grad_arrays,
+                        in_data=xs_arrays, out_data=out_arrays,
+                        in_grad=igrads, aux=[])
+            return tuple(g.value for g in igrads)
+
+        shapes = [jax.ShapeDtypeStruct(tuple(x.shape), x.dtype) for x in xs]
+        grads = jax.pure_callback(host_backward, tuple(shapes),
+                                  *(tuple(gs) + tuple(xs) + tuple(outs)))
+        return tuple(grads)
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    out = fwd(*arrays)
+    return out if n_out > 1 else out[0]
+
+
+def _custom_infer_shape(in_shapes, attrs):
+    if any(s is None for s in in_shapes):
+        return in_shapes, None
+    prop = get_prop(attrs["op_type"])
+    ins, outs, _ = prop.infer_shape([list(s) for s in in_shapes])
+    return [tuple(s) for s in ins], [tuple(s) for s in outs]
+
+
+register("Custom", _custom_impl, num_inputs=None,
+         num_outputs=lambda attrs: len(
+             get_prop(attrs["op_type"]).list_outputs()),
+         infer_shape=_custom_infer_shape,
+         takes_train_flag=True,
+         params={"op_type": (pStr, None)})

@@ -1,0 +1,395 @@
+"""Contrib operators (parity: src/operator/contrib/ — SURVEY.md §2.3).
+
+CTCLoss replaces the vendored warp-ctc CUDA kernels with a lax.scan
+log-space alpha recursion (differentiable through JAX autodiff — no
+hand-written backward).  Detection ops (box_nms, box_iou, MultiBox*) are
+XLA compositions with fixed shapes (top-k style selection instead of
+data-dependent filtering).  quantize/dequantize mirror the int8
+experiments; fft/ifft map to jnp.fft.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, pInt, pFloat, pBool, pStr, pShape
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (ref: src/operator/contrib/ctc_loss-inl.h, blank label = 0)
+# ---------------------------------------------------------------------------
+
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    """data: [T, N, A] unnormalized activations; label: [N, L] padded with 0
+    (blank).  Returns [N] negative log likelihoods."""
+    T, N, A = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)
+
+    lab = label.astype(jnp.int32)
+    if blank_label == "last":
+        blank = A - 1
+    else:
+        blank = 0
+    # valid label length per sample: positions with label > 0 (blank-padded)
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((lab != blank) & (lab >= 0), axis=1) \
+            .astype(jnp.int32)
+    if use_data_lengths and data_lengths is not None:
+        seq_len = data_lengths.astype(jnp.int32)
+    else:
+        seq_len = jnp.full((N,), T, jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank (length S=2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    ext_valid = jnp.arange(S)[None, :] < (2 * lab_len + 1)[:, None]
+
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((N, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(N), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, logp[0, jnp.arange(N), ext[:, 1]], _NEG_INF))
+
+    def step(alpha, t):
+        a_prev = alpha
+        a_m1 = jnp.pad(a_prev, ((0, 0), (1, 0)),
+                       constant_values=_NEG_INF)[:, :S]
+        a_m2 = jnp.pad(a_prev, ((0, 0), (2, 0)),
+                       constant_values=_NEG_INF)[:, :S]
+        a_m2 = jnp.where(can_skip, a_m2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2)
+        emit = logp[t, jnp.arange(N)[:, None], ext]
+        new_alpha = merged + emit
+        new_alpha = jnp.where(ext_valid, new_alpha, _NEG_INF)
+        # frozen once past this sample's sequence length
+        new_alpha = jnp.where((t < seq_len)[:, None], new_alpha, a_prev)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # final prob: alpha at last blank + last label of each sample
+    last = 2 * lab_len  # index of final blank
+    idxN = jnp.arange(N)
+    a_last = alpha[idxN, last]
+    a_prev = jnp.where(lab_len > 0,
+                       alpha[idxN, jnp.maximum(last - 1, 0)], _NEG_INF)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll
+
+
+register("_contrib_CTCLoss", _ctc_loss,
+         input_names=("data", "label", "data_lengths", "label_lengths"),
+         num_inputs=lambda attrs: 2 + bool(attrs.get("use_data_lengths"))
+         + bool(attrs.get("use_label_lengths")),
+         aliases=("ctc_loss", "CTCLoss", "_contrib_ctc_loss"),
+         params={"use_data_lengths": (pBool, False),
+                 "use_label_lengths": (pBool, False),
+                 "blank_label": (pStr, "first")})
+
+
+# ---------------------------------------------------------------------------
+# Bounding boxes (ref: src/operator/contrib/bounding_box-inl.h)
+# ---------------------------------------------------------------------------
+
+def _box_area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def _pairwise_iou(a, b):
+    """a: [..., M, 4], b: [..., K, 4] corner format -> [..., M, K]."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a)[..., :, None] + _box_area(b)[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        def to_corner(x):
+            cx, cy, w, h = (x[..., 0], x[..., 1], x[..., 2], x[..., 3])
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    return _pairwise_iou(lhs, rhs)
+
+
+register("_contrib_box_iou", _box_iou, num_inputs=2,
+         aliases=("box_iou",), params={"format": (pStr, "corner")})
+
+
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """Fixed-shape NMS: iterate over boxes in score order with lax.scan,
+    suppressing overlaps — output keeps input shape with suppressed entries
+    set to -1 (the reference's convention)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:]) if data.ndim > 2 \
+        else data[None]
+    B, M, E = flat.shape
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        cls = batch[:, id_index] if id_index >= 0 else jnp.zeros((M,))
+        valid = scores > valid_thresh
+        order = jnp.argsort(-scores)
+        rank = jnp.argsort(order)  # rank[j] = position of box j in order
+        iou = _pairwise_iou(boxes, boxes)
+
+        def step(keep, i):
+            idx = order[i]
+            ok = valid[idx] & keep[idx]
+            # suppress later-ordered (lower-scored) overlapping boxes
+            overlap = iou[idx] > overlap_thresh
+            same_cls = (cls == cls[idx]) | force_suppress
+            later = rank > i
+            suppress = overlap & same_cls & later & ok
+            return keep & ~suppress, None
+
+        keep0 = jnp.ones((M,), bool)
+        keep, _ = lax.scan(step, keep0, jnp.arange(M))
+        keep = keep & valid
+        if topk > 0:
+            rank = jnp.argsort(jnp.argsort(-scores))
+            keep = keep & (rank < topk)
+        return jnp.where(keep[:, None], batch, -1.0)
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+register("_contrib_box_nms", _box_nms, num_inputs=1,
+         aliases=("box_nms",),
+         params={"overlap_thresh": (pFloat, 0.5), "valid_thresh": (pFloat, 0),
+                 "topk": (pInt, -1), "coord_start": (pInt, 2),
+                 "score_index": (pInt, 1), "id_index": (pInt, -1),
+                 "force_suppress": (pBool, False),
+                 "in_format": (pStr, "corner"),
+                 "out_format": (pStr, "corner")})
+
+
+# ---------------------------------------------------------------------------
+# MultiBox (SSD) ops (ref: src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD anchor boxes for a feature map [N, C, H, W] ->
+    [1, H*W*(len(sizes)+len(ratios)-1), 4]."""
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    centers = jnp.stack([cxg.reshape(-1), cyg.reshape(-1)], axis=-1)
+
+    whs = []
+    for i, s in enumerate(sizes):
+        r = float(ratios[0]) ** 0.5
+        whs.append((s * r, s / r))
+    for r in list(ratios)[1:]:
+        r = float(r) ** 0.5
+        s = float(sizes[0])
+        whs.append((s * r, s / r))
+    wh = jnp.asarray(whs)  # [K, 2]
+
+    K = wh.shape[0]
+    c = jnp.repeat(centers[:, None, :], K, axis=1)  # [HW, K, 2]
+    half = wh[None, :, :] / 2
+    boxes = jnp.concatenate([c - half, c + half], axis=-1).reshape(-1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0, 1)
+    return boxes[None]
+
+
+register("_contrib_MultiBoxPrior", _multibox_prior, num_inputs=1,
+         aliases=("MultiBoxPrior",),
+         params={"sizes": (pShape, (1.0,)), "ratios": (pShape, (1.0,)),
+                 "clip": (pBool, False), "steps": (pShape, (-1.0, -1.0)),
+                 "offsets": (pShape, (0.5, 0.5))})
+
+
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1, negative_mining_ratio=-1,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground-truth boxes (simplified bipartite+threshold
+    matching).  anchor [1, A, 4]; label [N, O, 5] (cls,4 box, -1 padded);
+    returns (loc_target [N, A*4], loc_mask [N, A*4], cls_target [N, A])."""
+    A = anchor.shape[1]
+    anc = anchor[0]
+    v = jnp.asarray(variances)
+
+    def one(lab):
+        gt_cls = lab[:, 0]
+        gt_box = lab[:, 1:5]
+        valid = gt_cls >= 0
+        iou = _pairwise_iou(anc, gt_box)  # [A, O]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou > overlap_threshold
+        tgt_box = gt_box[best_gt]
+        # encode offsets
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(tgt_box[:, 2] - tgt_box[:, 0], 1e-8)
+        gh = jnp.maximum(tgt_box[:, 3] - tgt_box[:, 1], 1e-8)
+        gcx = (tgt_box[:, 0] + tgt_box[:, 2]) / 2
+        gcy = (tgt_box[:, 1] + tgt_box[:, 3]) / 2
+        loc = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-8) / v[0],
+                         (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1],
+                         jnp.log(gw / jnp.maximum(aw, 1e-8)) / v[2],
+                         jnp.log(gh / jnp.maximum(ah, 1e-8)) / v[3]],
+                        axis=-1)
+        loc = jnp.where(matched[:, None], loc, 0.0)
+        mask = jnp.where(matched[:, None], 1.0,
+                         0.0) * jnp.ones((A, 4))
+        cls_t = jnp.where(matched, gt_cls[best_gt] + 1, 0.0)
+        return loc.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+register("_contrib_MultiBoxTarget", _multibox_target,
+         input_names=("anchor", "label", "cls_pred"), num_outputs=3,
+         aliases=("MultiBoxTarget",),
+         params={"overlap_threshold": (pFloat, 0.5),
+                 "ignore_label": (pFloat, -1),
+                 "negative_mining_ratio": (pFloat, -1),
+                 "negative_mining_thresh": (pFloat, 0.5),
+                 "minimum_negative_samples": (pInt, 0),
+                 "variances": (pShape, (0.1, 0.1, 0.2, 0.2))})
+
+
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions into detections [N, A, 6] (cls, score, 4 box)."""
+    N = cls_prob.shape[0]
+    A = anchor.shape[1]
+    anc = anchor[0]
+    v = jnp.asarray(variances)
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+
+    def one(probs, loc):
+        loc = loc.reshape(A, 4)
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * v[2]) * aw
+        h = jnp.exp(loc[:, 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0, 1)
+        # best non-background class per anchor
+        fg = jnp.concatenate(
+            [probs[:background_id], probs[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        det = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None], score[:, None], boxes],
+            axis=-1)
+        det = _box_nms(det[None], overlap_thresh=nms_threshold,
+                       valid_thresh=threshold, topk=nms_topk,
+                       coord_start=2, score_index=1, id_index=0,
+                       force_suppress=force_suppress)[0]
+        return det
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+register("_contrib_MultiBoxDetection", _multibox_detection,
+         input_names=("cls_prob", "loc_pred", "anchor"),
+         aliases=("MultiBoxDetection",),
+         params={"clip": (pBool, True), "threshold": (pFloat, 0.01),
+                 "background_id": (pInt, 0),
+                 "nms_threshold": (pFloat, 0.5),
+                 "force_suppress": (pBool, False),
+                 "variances": (pShape, (0.1, 0.1, 0.2, 0.2)),
+                 "nms_topk": (pInt, -1)})
+
+
+# ---------------------------------------------------------------------------
+# Quantization (ref: src/operator/contrib/quantize*.cc int8 experiments)
+# ---------------------------------------------------------------------------
+
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    if out_type == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-8)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+register("_contrib_quantize", _quantize,
+         input_names=("data", "min_range", "max_range"), num_outputs=3,
+         aliases=("quantize",), params={"out_type": (pStr, "uint8")})
+
+
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+register("_contrib_dequantize", _dequantize,
+         input_names=("data", "min_range", "max_range"),
+         aliases=("dequantize",), params={"out_type": (pStr, "float32")})
+
+
+# ---------------------------------------------------------------------------
+# FFT (ref: src/operator/contrib/fft-inl.h — cuFFT in the reference)
+# ---------------------------------------------------------------------------
+
+def _fft(data, compute_size=128):
+    """Real-to-complex FFT over the last dim; output interleaves re/im
+    (the reference's layout: [..., 2*n])."""
+    out = jnp.fft.fft(data, axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+register("_contrib_fft", _fft, num_inputs=1, aliases=("fft",),
+         params={"compute_size": (pInt, 128)})
+
+
+def _ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(data.dtype) * n
+
+
+register("_contrib_ifft", _ifft, num_inputs=1, aliases=("ifft",),
+         params={"compute_size": (pInt, 128)})

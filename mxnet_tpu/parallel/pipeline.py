@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def _pipeline_local(stage_params, x_micro, stage_fn, axis_name):
@@ -78,6 +78,6 @@ def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(params_spec, P(None, batch_axis)),
                    out_specs=P(None, batch_axis),
-                   check_rep=False)
+                   check_vma=False)
     y_micro = fn(stage_params, x_micro)
     return y_micro.reshape((b,) + y_micro.shape[2:])

@@ -1,0 +1,87 @@
+"""Deployment/inference API (parity: include/mxnet/c_predict_api.h +
+src/c_api/c_predict_api.cc — MXPredCreate/SetInput/Forward/GetOutput).
+
+The reference's predict ABI loads a symbol JSON + param blob and runs
+forward-only; here the loaded graph jits once per input signature and runs
+as a single XLA computation (faster than the reference's per-node engine
+pushes for the same workflow)."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import NDArray, array as nd_array, load as nd_load
+from .symbol import load_json as sym_load_json
+
+
+class Predictor:
+    """MXPredCreate equivalent: (symbol_json, params) -> forward machine."""
+
+    def __init__(self, symbol_json, param_bytes_or_file, input_shapes,
+                 dev_type="cpu", dev_id=0, ctx=None):
+        from . import symbol as sym_mod
+        if isinstance(symbol_json, str) and symbol_json.lstrip().startswith("{"):
+            self._symbol = sym_load_json(symbol_json)
+        else:
+            with open(symbol_json) as f:
+                self._symbol = sym_load_json(f.read())
+        if isinstance(param_bytes_or_file, (dict,)):
+            params = param_bytes_or_file
+        else:
+            params = nd_load(param_bytes_or_file)
+        arg_params = {k[4:]: v for k, v in params.items()
+                      if k.startswith("arg:")}
+        aux_params = {k[4:]: v for k, v in params.items()
+                      if k.startswith("aux:")}
+        if not arg_params and not aux_params:
+            arg_params = params
+        if ctx is None:
+            from .context import Context
+            ctx = Context(Context.devstr2type.get(dev_type, 1), dev_id)
+        self._ctx = ctx
+        if isinstance(input_shapes, dict):
+            shape_kwargs = dict(input_shapes)
+        else:
+            shape_kwargs = {"data": tuple(input_shapes)}
+        # strip loss heads for prediction: keep outputs as-is (SoftmaxOutput
+        # forward is softmax, matching the reference's predict behavior)
+        self._exe = self._symbol.simple_bind(ctx, grad_req="null",
+                                             **shape_kwargs)
+        self._exe.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+        self._input_names = set(shape_kwargs)
+
+    def set_input(self, name, data):
+        """MXPredSetInput."""
+        if name not in self._exe.arg_dict:
+            raise MXNetError("unknown input %r" % name)
+        if not isinstance(data, NDArray):
+            data = nd_array(np.asarray(data))
+        data.copyto(self._exe.arg_dict[name])
+
+    def forward(self, **inputs):
+        """MXPredForward; inputs may be passed as kwargs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._exe.forward(is_train=False)
+
+    def get_output(self, index=0):
+        """MXPredGetOutput."""
+        return self._exe.outputs[index]
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: re-bind with new shapes (re-jit per signature)."""
+        self._exe = self._exe.reshape(**input_shapes)
+        return self
+
+
+def load_checkpoint_predictor(prefix, epoch, input_shapes, ctx=None):
+    """Convenience: build a Predictor from save_checkpoint artifacts."""
+    from .model import load_checkpoint
+    sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    params = {"arg:%s" % k: v for k, v in arg_params.items()}
+    params.update({"aux:%s" % k: v for k, v in aux_params.items()})
+    return Predictor(sym.tojson(), params, input_shapes, ctx=ctx)

@@ -24,21 +24,42 @@ def _pack_record(data):
     return header + data + b"\x00" * pad
 
 
+def _native_lib():
+    try:
+        from . import io_native
+        return io_native.get_lib() and io_native
+    except Exception:
+        return None
+
+
 class MXRecordIO:
-    """Sequential record reader/writer (ref: recordio.py:36)."""
+    """Sequential record reader/writer (ref: recordio.py:36).
+
+    Fast path: the C++ runtime (src/recordio.cc via mxnet_tpu/io_native)
+    handles framing; falls back to pure Python when no toolchain."""
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._native = None
         self.open()
 
     def open(self):
+        native = _native_lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            if native is not None:
+                self._native = native.NativeRecordWriter(self.uri)
+            else:
+                self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            if native is not None:
+                # non-prefetch reader: seek() must work for indexed reads
+                self._native = native.NativeRecordReader(self.uri,
+                                                         prefetch=False)
+            else:
+                self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -46,7 +67,12 @@ class MXRecordIO:
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._native is not None:
+                self._native.close()
+                self._native = None
+            if self.handle is not None:
+                self.handle.close()
+                self.handle = None
             self.is_open = False
 
     def reset(self):
@@ -61,10 +87,15 @@ class MXRecordIO:
 
     def write(self, buf):
         assert self.writable
+        if self._native is not None:
+            self._native.write(buf)
+            return
         self.handle.write(_pack_record(buf))
 
     def read(self):
         assert not self.writable
+        if self._native is not None:
+            return self._native.read()
         header = self.handle.read(8)
         if len(header) < 8:
             return None
@@ -78,10 +109,15 @@ class MXRecordIO:
         return data
 
     def tell(self):
+        if self._native is not None:
+            return self._native.tell()
         return self.handle.tell()
 
     def seek(self, pos):
         assert not self.writable
+        if self._native is not None:
+            self._native.seek(pos)
+            return
         self.handle.seek(pos)
 
 

@@ -1,0 +1,227 @@
+// Dependency engine: variables, operations, read/write ordering, worker pool.
+//
+// Native counterpart of the reference's threaded dependency engine
+// (SURVEY.md §2.1: src/engine/threaded_engine.{h,cc} — per-variable version
+// queues serializing writers against readers, atomic wait counters, worker
+// threads).  On TPU the XLA runtime owns on-device scheduling, so this
+// engine's scope is the part XLA does not cover: HOST-side task ordering —
+// async checkpoint writes, data-pipeline stages, callback sequencing.  The
+// observable semantics match the reference: push(fn, const_vars,
+// mutable_vars) runs fn once all pending writers of its reads and all
+// pending readers/writers of its writes are done; wait_for_var/wait_for_all
+// block the caller.
+//
+// Design difference from the reference (deliberate): instead of intrusive
+// per-var linked lists of VersionedVarBlocks with atomic wait counters, each
+// var keeps two counters (pending readers of the current version, plus a
+// writer queue position) guarded by one engine mutex — host-side op rates
+// (thousands/sec, not millions) don't justify lock-free structures, and the
+// single-mutex design is trivially TSAN-clean.
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Callback = void (*)(void*);
+
+struct Op {
+  Callback fn;
+  void* arg;
+  std::vector<int64_t> reads;
+  std::vector<int64_t> writes;
+  int pending_deps = 0;  // unresolved var dependencies
+};
+
+struct Var {
+  // queue of ops (by id) wanting this var, in push order; an op entry is
+  // a reader (shared) or writer (exclusive)
+  struct Want {
+    int64_t op_id;
+    bool write;
+  };
+  std::deque<Want> queue;
+  int active_readers = 0;
+  bool active_writer = false;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : stop_(false), inflight_(0) {
+    for (int i = 0; i < (num_workers > 0 ? num_workers : 2); ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  void Push(Callback fn, void* arg, const int64_t* reads, int n_reads,
+            const int64_t* writes, int n_writes) {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t op_id = next_op_++;
+    Op op;
+    op.fn = fn;
+    op.arg = arg;
+    op.writes.assign(writes, writes + n_writes);
+    // dedup: a var both read and written counts as a write only (the
+    // reference rejects overlap via CheckDuplicate, threaded_engine.h:409;
+    // here the useful semantic — exclusive access — is kept instead)
+    for (int i = 0; i < n_reads; ++i) {
+      bool dup = false;
+      for (int j = 0; j < n_writes; ++j) {
+        if (reads[i] == writes[j]) dup = true;
+      }
+      if (!dup) op.reads.push_back(reads[i]);
+    }
+    ++inflight_;
+    // enqueue on each var; the op becomes runnable when it reaches the
+    // head-compatible position on every var queue
+    for (int64_t v : op.reads) vars_[v].queue.push_back({op_id, false});
+    for (int64_t v : op.writes) vars_[v].queue.push_back({op_id, true});
+    op.pending_deps = static_cast<int>(op.reads.size() + op.writes.size());
+    std::vector<int64_t> touched = op.reads;
+    touched.insert(touched.end(), op.writes.begin(), op.writes.end());
+    if (touched.empty()) {
+      // no dependencies: immediately runnable
+      ready_.push_back(op_id);
+      ready_cv_.notify_one();
+    }
+    ops_.emplace(op_id, std::move(op));
+    for (int64_t v : touched) TryGrant(v);
+  }
+
+  void WaitForVar(int64_t var) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] {
+      auto it = vars_.find(var);
+      return it == vars_.end() ||
+             (it->second.queue.empty() && !it->second.active_writer &&
+              it->second.active_readers == 0);
+    });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return inflight_ == 0; });
+  }
+
+ private:
+  // grant queue heads: consecutive readers run concurrently; a writer
+  // needs the queue head exclusively (the reference's versioned-queue rule)
+  void TryGrant(int64_t vid) {
+    Var& var = vars_[vid];
+    while (!var.queue.empty()) {
+      Var::Want head = var.queue.front();
+      Op& op = ops_[head.op_id];
+      if (head.write) {
+        if (var.active_readers > 0 || var.active_writer) break;
+        var.active_writer = true;
+      } else {
+        if (var.active_writer) break;
+        ++var.active_readers;
+      }
+      var.queue.pop_front();
+      if (--op.pending_deps == 0) {
+        ready_.push_back(head.op_id);
+        ready_cv_.notify_one();
+      }
+      if (head.write) break;  // nothing can pass an active writer
+    }
+  }
+
+  void Release(const Op& op) {
+    for (int64_t v : op.reads) {
+      Var& var = vars_[v];
+      --var.active_readers;
+      TryGrant(v);
+    }
+    for (int64_t v : op.writes) {
+      Var& var = vars_[v];
+      var.active_writer = false;
+      TryGrant(v);
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      int64_t op_id;
+      Callback fn;
+      void* arg;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_cv_.wait(lk, [this] { return !ready_.empty() || stop_; });
+        if (stop_ && ready_.empty()) return;
+        op_id = ready_.front();
+        ready_.pop_front();
+        fn = ops_[op_id].fn;
+        arg = ops_[op_id].arg;
+      }
+      fn(arg);  // run outside the lock
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        Release(ops_[op_id]);
+        ops_.erase(op_id);
+        --inflight_;
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable ready_cv_, done_cv_;
+  std::unordered_map<int64_t, Var> vars_;
+  std::unordered_map<int64_t, Op> ops_;
+  std::deque<int64_t> ready_;
+  std::vector<std::thread> workers_;
+  bool stop_;
+  int inflight_;
+  int64_t next_var_ = 1;
+  int64_t next_op_ = 1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* engine_create(int num_workers) { return new Engine(num_workers); }
+
+void engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+int64_t engine_new_var(void* e) { return static_cast<Engine*>(e)->NewVar(); }
+
+void engine_push(void* e, void (*fn)(void*), void* arg,
+                 const int64_t* reads, int n_reads, const int64_t* writes,
+                 int n_writes) {
+  static_cast<Engine*>(e)->Push(fn, arg, reads, n_reads, writes, n_writes);
+}
+
+void engine_wait_for_var(void* e, int64_t var) {
+  static_cast<Engine*>(e)->WaitForVar(var);
+}
+
+void engine_wait_for_all(void* e) { static_cast<Engine*>(e)->WaitForAll(); }
+
+}  // extern "C"

@@ -1,0 +1,158 @@
+"""Contrib ops + CustomOp + predict API tests (parity model:
+tests/python/unittest/test_operator.py contrib sections, test_custom_op,
+tests/python/predict)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as mxop
+from mxnet_tpu import autograd
+
+
+def test_custom_op_forward_backward():
+    @mxop.register_op("testsquare")
+    class SquareProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(mxop.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] ** 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                2 * in_data[0] * out_grad[0])
+            return Op()
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    autograd.mark_variables([x], [mx.nd.zeros(x.shape)])
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="testsquare")
+        loss = mx.nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    T, N, A = 6, 3, 5
+    rng = np.random.RandomState(0)
+    data = rng.randn(T, N, A).astype(np.float32)
+    labels = np.array([[1, 2, 3], [4, 1, 0], [2, 0, 0]], np.float32)
+    lab_lens = [3, 2, 1]
+    ours = mx.nd.ctc_loss(mx.nd.array(data), mx.nd.array(labels)).asnumpy()
+    logp = torch.log_softmax(torch.tensor(data), dim=-1)
+    tgt = torch.tensor([1, 2, 3, 4, 1, 2], dtype=torch.int32)
+    ref = torch.nn.functional.ctc_loss(
+        logp, tgt, torch.tensor([T] * N, dtype=torch.int32),
+        torch.tensor(lab_lens, dtype=torch.int32), blank=0,
+        reduction="none")
+    np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_finite():
+    T, N, A = 5, 2, 4
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.randn(T, N, A).astype(np.float32))
+    label = mx.nd.array(np.array([[1, 2], [3, 0]], np.float32))
+    autograd.mark_variables([data], [mx.nd.zeros(data.shape)])
+    with autograd.record():
+        loss = mx.nd.sum(mx.nd.ctc_loss(data, label))
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_box_iou_and_nms():
+    boxes = mx.nd.array(np.array(
+        [[0, 0, 1, 1], [0.1, 0.1, 1.1, 1.1], [2, 2, 3, 3]], np.float32))
+    iou = mx.nd.box_iou(boxes, boxes).asnumpy()
+    np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-6)
+    assert iou[0, 2] == 0.0
+    assert 0.5 < iou[0, 1] < 0.9
+
+    # NMS: [cls, score, x1, y1, x2, y2]
+    dets = mx.nd.array(np.array([
+        [0, 0.9, 0, 0, 1, 1],
+        [0, 0.8, 0.05, 0.05, 1.05, 1.05],  # overlaps the first -> suppressed
+        [0, 0.7, 2, 2, 3, 3],
+    ], np.float32))
+    out = mx.nd.box_nms(dets, overlap_thresh=0.5, coord_start=2,
+                        score_index=1, id_index=0).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)
+    assert (out[1] == -1).all()  # suppressed
+    assert out[2, 1] == pytest.approx(0.7)
+
+
+def test_multibox_prior_and_detection_shapes():
+    feat = mx.nd.zeros((1, 8, 4, 4))
+    anchors = mx.nd.MultiBoxPrior(feat, sizes=(0.5, 0.25), ratios=(1, 2))
+    A = 4 * 4 * 3  # H*W*(sizes+ratios-1)
+    assert anchors.shape == (1, A, 4)
+    cls_prob = mx.nd.array(np.random.rand(2, 3, A).astype(np.float32))
+    loc_pred = mx.nd.array(
+        np.random.randn(2, A * 4).astype(np.float32) * 0.01)
+    det = mx.nd.MultiBoxDetection(cls_prob, loc_pred, anchors)
+    assert det.shape == (2, A, 6)
+
+
+def test_multibox_target():
+    anchors = mx.nd.array(np.array(
+        [[[0, 0, 0.5, 0.5], [0.5, 0.5, 1, 1]]], np.float32))
+    label = mx.nd.array(np.array(
+        [[[1, 0.52, 0.52, 0.98, 0.98]]], np.float32))
+    cls_pred = mx.nd.zeros((1, 2, 2))
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()
+    assert ct[0, 1] == 2.0  # second anchor matched (class 1 -> target 2)
+    assert ct[0, 0] == 0.0  # first anchor background
+    assert loc_m.asnumpy()[0, 4:].sum() == 4
+
+
+def test_quantize_dequantize_roundtrip():
+    d = np.linspace(-1, 1, 11).astype(np.float32)
+    q, mn, mx_ = mx.nd.quantize(mx.nd.array(d), mx.nd.array([-1.0]),
+                                mx.nd.array([1.0]), out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = mx.nd.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), d, atol=0.01)
+
+
+def test_fft_roundtrip():
+    d = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    f = mx.nd.fft(mx.nd.array(d))
+    assert f.shape == (2, 16)
+    back = mx.nd.ifft(f) / 8  # reference convention scales by n
+    np.testing.assert_allclose(back.asnumpy(), d, atol=1e-4)
+
+
+def test_predictor_roundtrip():
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    Y = (X @ rng.randn(6, 3)).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    tmp = tempfile.mkdtemp()
+    prefix = os.path.join(tmp, "m")
+    mod.save_checkpoint(prefix, 2)
+
+    from mxnet_tpu.predict import Predictor, load_checkpoint_predictor
+    p = load_checkpoint_predictor(prefix, 2, {"data": (4, 6)})
+    p.forward(data=X[:4])
+    out = p.get_output(0).asnumpy()
+    it.reset()
+    ref = mod.predict(it).asnumpy()[:4]
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    p2 = Predictor(prefix + "-symbol.json", prefix + "-0002.params",
+                   {"data": (4, 6)})
+    p2.forward(data=X[:4])
+    np.testing.assert_allclose(p2.get_output(0).asnumpy(), ref, atol=1e-5)
