@@ -1,0 +1,64 @@
+"""Inference scoring benchmark (parity: example/image-classification/
+benchmark_score.py — the source of the BASELINE.md tables)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol(network, num_layers, image_shape):
+    if network == "resnet":
+        from mxnet_tpu.models import resnet
+        return resnet.get_symbol(1000, num_layers, image_shape)
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model(network)
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
+          iters=20):
+    sym = get_symbol(network, num_layers, image_shape)
+    shape = tuple(int(x) for x in image_shape.split(","))
+    exe = sym.simple_bind(dev, grad_req="null",
+                          data=(batch_size,) + shape)
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.uniform(
+        0, 1, (batch_size,) + shape).astype(np.float32)
+    for _ in range(3):
+        exe.forward(is_train=False)
+        exe.outputs[0].wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        exe.forward(is_train=False)
+    exe.outputs[0].wait_to_read()
+    return batch_size * iters / (time.perf_counter() - t0)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score a network")
+    parser.add_argument("--network", type=str, default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--batch-sizes", type=str, default="1,2,4,8,16,32")
+    args = parser.parse_args()
+
+    import jax
+    dev = mx.tpu() if jax.default_backend() in ("tpu", "axon") else mx.cpu()
+    for b in [int(x) for x in args.batch_sizes.split(",")]:
+        speed = score(args.network, args.num_layers, dev, b,
+                      args.image_shape)
+        print("network: %s-%d, batch: %3d, image/sec: %.2f" %
+              (args.network, args.num_layers, b, speed))

@@ -1,0 +1,93 @@
+"""Data loaders for the image-classification examples (parity:
+example/image-classification/common/data.py)."""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, help="training record file")
+    data.add_argument("--data-val", type=str, help="validation record file")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--pad-size", type=int, default=0)
+    data.add_argument("--data-nthreads", type=int, default=4)
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation")
+    aug.add_argument("--random-crop", type=int, default=1)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+def get_mnist_iter(args, kv):
+    """MNIST iterators from local idx-ubyte files (auto-download removed —
+    zero-egress environment)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    root = getattr(args, "data_dir", None) or os.path.join(
+        os.path.expanduser("~"), ".mxnet", "datasets", "mnist")
+    flat = len(image_shape) == 1
+    train = mx.io.MNISTIter(
+        image=os.path.join(root, "train-images-idx3-ubyte"),
+        label=os.path.join(root, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True, flat=flat,
+        part_index=kv.rank, num_parts=kv.num_workers)
+    val = mx.io.MNISTIter(
+        image=os.path.join(root, "t10k-images-idx3-ubyte"),
+        label=os.path.join(root, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat)
+    return train, val
+
+
+def get_rec_iter(args, kv=None):
+    """ImageRecordIter pair over packed .rec files."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    rgb_mean = [float(i) for i in args.rgb_mean.split(",")]
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    train = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=image_shape,
+        path_imgrec=args.data_train, shuffle=True,
+        part_index=rank, num_parts=nworker,
+        rand_crop=args.random_crop > 0, rand_mirror=args.random_mirror > 0,
+        mean=np.asarray(rgb_mean))
+    if not args.data_val:
+        return train, None
+    val = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=image_shape,
+        path_imgrec=args.data_val, part_index=rank, num_parts=nworker,
+        mean=np.asarray(rgb_mean))
+    return train, val
+
+
+def get_synthetic_iter(args, kv=None):
+    """Synthetic random-image iterators (benchmarking without a dataset —
+    the reference's benchmark_score.py pattern)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    num = getattr(args, "num_examples", 1024)
+    num = min(num, 2048)
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (num,) + image_shape).astype(np.float32)
+    Y = rng.randint(0, args.num_classes, (num,)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[:256], Y[:256], batch_size=args.batch_size,
+                            label_name="softmax_label")
+    return train, val

@@ -1,0 +1,47 @@
+"""Train ImageNet (parity: example/image-classification/train_imagenet.py —
+BASELINE.json config #2/#5: ResNet-50 symbolic, single chip or
+kvstore='tpu_ici' data parallel)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from common import fit as common_fit
+from common import data as common_data
+
+import mxnet_tpu as mx
+
+
+def get_symbol(args):
+    import importlib
+    from mxnet_tpu import models
+    net = importlib.import_module("mxnet_tpu.models.%s" % args.network)
+    return net.get_symbol(num_classes=args.num_classes,
+                          num_layers=args.num_layers,
+                          image_shape=args.image_shape)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    common_data.add_data_args(parser)
+    common_data.add_data_aug_args(parser)
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="use synthetic data (benchmark without a "
+                             "dataset)")
+    parser.set_defaults(network="resnet", num_layers=50, batch_size=32,
+                        num_epochs=1, lr=0.1)
+    args = parser.parse_args()
+
+    sym = get_symbol(args)
+    if args.synthetic or not args.data_train:
+        loader = common_data.get_synthetic_iter
+    else:
+        loader = common_data.get_rec_iter
+    common_fit.fit(args, sym, loader)

@@ -1,0 +1,43 @@
+"""Train MNIST (parity: example/image-classification/train_mnist.py —
+BASELINE.json config #1: LeNet MNIST via mx.mod.Module)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from common import fit as common_fit
+from common import data as common_data
+
+import mxnet_tpu as mx
+
+
+def get_symbol(args):
+    from mxnet_tpu.models import lenet, mlp
+    if args.network == "mlp":
+        return mlp.get_symbol(num_classes=args.num_classes)
+    return lenet.get_symbol(num_classes=args.num_classes)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default=None)
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="use synthetic data (no dataset files needed)")
+    common_fit.add_fit_args(parser)
+    parser.set_defaults(network="lenet", num_epochs=10, batch_size=64,
+                        lr=0.05, lr_step_epochs="10", image_shape="1,28,28")
+    parser.add_argument("--image-shape", type=str, default="1,28,28")
+    args = parser.parse_args()
+
+    sym = get_symbol(args)
+    loader = common_data.get_synthetic_iter if args.synthetic \
+        else common_data.get_mnist_iter
+    common_fit.fit(args, sym, loader)

@@ -67,6 +67,11 @@ class KVStore:
             stored = self._stored.get(k)
             if stored is None:
                 raise MXNetError("key %r has not been initialized" % (k,))
+            if merged.context != stored.context:
+                # the store owns the weight's device (ref: CommCPU stages
+                # reduction on CPU, comm.h:103); bring the merged gradient
+                # to it before the update
+                merged = merged.as_in_context(stored.context)
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, stored)
             else:

@@ -255,6 +255,16 @@ class Module(BaseModule):
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
+        # TPU-first: with one process and a non-distributed store there is
+        # nothing to synchronize — updating through the host-side store
+        # would stage every parameter through CPU each batch.  Update
+        # locally on device instead (same math: one optimizer application
+        # to the summed gradient).
+        if (kvstore is not None and len(self._context) == 1
+                and "dist" not in kvstore.type
+                and kvstore.num_workers == 1):
+            kvstore = None
+            update_on_kvstore = False
         batch_size = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
             batch_size *= kvstore.num_workers
@@ -302,6 +312,13 @@ class Module(BaseModule):
 
         self.optimizer_initialized = True
 
+        # one-dispatch-per-batch fused fwd+bwd+update (north star); falls
+        # back silently when the configuration isn't supported
+        from .fused_step import FusedTrainStep
+        self._fused_step = FusedTrainStep(self) \
+            if FusedTrainStep.supports(self) else None
+        self._fused_pending = None
+
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
@@ -344,10 +361,23 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)
 
+    def forward_backward(self, data_batch):
+        if getattr(self, "_fused_step", None) is not None:
+            # defer: the fused program runs fwd+bwd+update in update()
+            self._fused_pending = data_batch
+            return
+        super().forward_backward(data_batch)
+
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if getattr(self, "_fused_step", None) is not None \
+                and self._fused_pending is not None:
+            batch = self._fused_pending
+            self._fused_pending = None
+            self._fused_step.run(batch)
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -379,7 +409,11 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if getattr(self, "_fused_step", None) is not None:
+            import pickle
+            with open(fname, "wb") as fout:
+                pickle.dump(self._fused_step.export_states(), fout)
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as fout:
@@ -387,7 +421,11 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if getattr(self, "_fused_step", None) is not None:
+            import pickle
+            with open(fname, "rb") as f:
+                self._fused_step.load_states(pickle.load(f))
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:

@@ -138,13 +138,15 @@ def _custom_impl(*arrays, op_type=None, _train=False, **attrs):
     result_shape = [jax.ShapeDtypeStruct(tuple(s), d)
                     for s, d in zip(out_shapes, out_dtypes)]
 
+    is_train = bool(_train)
+
     def host_forward(*host_arrays):
         op = prop.create_operator(None, in_shapes,
                                   [a.dtype for a in host_arrays])
         ins = [np.asarray(a) for a in host_arrays]
         outs = [_Slot(np.zeros(tuple(s), np_dtype(d)))
                 for s, d in zip(out_shapes, out_dtypes)]
-        op.forward(is_train=True, req=["write"] * n_out,
+        op.forward(is_train=is_train, req=["write"] * n_out,
                    in_data=ins, out_data=outs, aux=[])
         return tuple(o.value for o in outs)
 

@@ -10,3 +10,4 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import extra  # noqa: F401

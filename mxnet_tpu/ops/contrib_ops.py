@@ -138,6 +138,9 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     """Fixed-shape NMS: iterate over boxes in score order with lax.scan,
     suppressing overlaps — output keeps input shape with suppressed entries
     set to -1 (the reference's convention)."""
+    if in_format != out_format:
+        raise ValueError("box_nms: in_format != out_format is not "
+                         "supported (boxes pass through unchanged)")
     shape = data.shape
     flat = data.reshape((-1,) + shape[-2:]) if data.ndim > 2 \
         else data[None]
@@ -146,6 +149,11 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     def one(batch):
         scores = batch[:, score_index]
         boxes = batch[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            cx, cy, w, h = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
+                            boxes[:, 3])
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                               cy + h / 2], axis=-1)
         cls = batch[:, id_index] if id_index >= 0 else jnp.zeros((M,))
         valid = scores > valid_thresh
         order = jnp.argsort(-scores)

@@ -1,0 +1,512 @@
+"""Remaining layer/linalg/optimizer ops for reference parity.
+
+Covers the tail of SURVEY.md §2.3: spatial transformer family
+(src/operator/spatial_transformer-inl.h, grid_generator-inl.h,
+bilinear_sampler-inl.h), ROIPooling (roi_pooling-inl.h), Correlation
+(correlation-inl.h), Crop, depth/space, smooth_l1, the linalg ops
+(tensor/la_op.h — LAPACK/cuBLAS in the reference, jnp.linalg/XLA here),
+khatri_rao, and the optimizer update ops not yet registered
+(src/operator/optimizer_op-inl.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, pInt, pFloat, pBool, pStr, pShape
+
+
+# ---------------------------------------------------------------------------
+# Bilinear sampling family (ref: bilinear_sampler-inl.h — cudnn
+# SpatialTfSampler in the reference; pure gather arithmetic here)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, grid):
+    """data [N,C,H,W], grid [N,2,Ho,Wo] with x,y in [-1,1] -> [N,C,Ho,Wo]."""
+    N, C, H, W = data.shape
+    x = (grid[:, 0] + 1) * (W - 1) / 2   # [N, Ho, Wo]
+    y = (grid[:, 1] + 1) * (H - 1) / 2
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = x - x0
+    wy1 = y - y0
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        # in-bounds mask (reference zero-pads outside)
+        ok = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+        vals = jax.vmap(lambda d, yi_, xi_: d[:, yi_, xi_])(data, yi, xi)
+        return vals * ok[:, None].astype(data.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x1)
+    v10 = gather(y1, x0)
+    v11 = gather(y1, x1)
+    wx1e = wx1[:, None]
+    wy1e = wy1[:, None]
+    out = (v00 * (1 - wx1e) * (1 - wy1e) + v01 * wx1e * (1 - wy1e) +
+           v10 * (1 - wx1e) * wy1e + v11 * wx1e * wy1e)
+    return out
+
+
+register("BilinearSampler", _bilinear_sample,
+         input_names=("data", "grid"))
+
+
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data [N, 6] -> sampling grid [N, 2, H, W];
+    warp: data [N, 2, H, W] flow -> grid."""
+    H, W = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        N = data.shape[0]
+        theta = data.reshape(N, 2, 3)
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+        xg, yg = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(xg)
+        coords = jnp.stack([xg, yg, ones], axis=0).reshape(3, -1)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # [N, 2, H*W]
+        return out.reshape(N, 2, H, W)
+    # warp: flow field added to the identity grid, normalized
+    N, _, Hf, Wf = data.shape
+    ys = jnp.arange(Hf, dtype=data.dtype)
+    xs = jnp.arange(Wf, dtype=data.dtype)
+    xg, yg = jnp.meshgrid(xs, ys)
+    x = (xg + data[:, 0]) * 2 / max(Wf - 1, 1) - 1
+    y = (yg + data[:, 1]) * 2 / max(Hf - 1, 1) - 1
+    return jnp.stack([x, y], axis=1)
+
+
+def _grid_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, None
+    if attrs.get("transform_type", "affine") == "affine":
+        H, W = attrs["target_shape"]
+        return in_shapes, [(d[0], 2, int(H), int(W))]
+    return in_shapes, [d]
+
+
+register("GridGenerator", _grid_generator, num_inputs=1,
+         infer_shape=_grid_infer_shape,
+         params={"transform_type": (pStr, "affine"),
+                 "target_shape": (pShape, (0, 0))})
+
+
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear"):
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sample(data, grid)
+
+
+def _st_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, None
+    H, W = attrs["target_shape"]
+    filled = list(in_shapes)
+    filled[1] = (d[0], 6)
+    return filled, [(d[0], d[1], int(H), int(W))]
+
+
+register("SpatialTransformer", _spatial_transformer,
+         input_names=("data", "loc"), infer_shape=_st_infer_shape,
+         params={"target_shape": (pShape, (0, 0)),
+                 "transform_type": (pStr, "affine"),
+                 "sampler_type": (pStr, "bilinear")})
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling (ref: roi_pooling-inl.h)
+# ---------------------------------------------------------------------------
+
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """data [N,C,H,W]; rois [R,5] (batch_idx, x1, y1, x2, y2) in image
+    coords -> [R, C, ph, pw].  Fixed-shape max pool per output cell."""
+    N, C, H, W = data.shape
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[b]  # [C, H, W]
+        ygrid = jnp.arange(H, dtype=data.dtype)
+        xgrid = jnp.arange(W, dtype=data.dtype)
+
+        def cell(py, px):
+            ys = y1 + py * bin_h
+            ye = y1 + (py + 1) * bin_h
+            xs = x1 + px * bin_w
+            xe = x1 + (px + 1) * bin_w
+            my = (ygrid >= jnp.floor(ys)) & (ygrid < jnp.ceil(ye))
+            mxm = (xgrid >= jnp.floor(xs)) & (xgrid < jnp.ceil(xe))
+            mask = my[:, None] & mxm[None, :]
+            neg = jnp.finfo(data.dtype).min
+            masked = jnp.where(mask[None], img, neg)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(mask), v, 0.0)
+
+        rows = [jnp.stack([cell(py, px) for px in range(pw)], axis=-1)
+                for py in range(ph)]
+        return jnp.stack(rows, axis=-2)  # [C, ph, pw]
+
+    return jax.vmap(one)(rois)
+
+
+def _roi_infer_shape(in_shapes, attrs):
+    d, r = in_shapes[0], in_shapes[1]
+    if d is None or r is None:
+        return in_shapes, None
+    ph, pw = attrs["pooled_size"]
+    return in_shapes, [(r[0], d[1], int(ph), int(pw))]
+
+
+register("ROIPooling", _roi_pooling, input_names=("data", "rois"),
+         infer_shape=_roi_infer_shape,
+         params={"pooled_size": (pShape, (1, 1)),
+                 "spatial_scale": (pFloat, 1.0)})
+
+
+# ---------------------------------------------------------------------------
+# Correlation (ref: correlation-inl.h — FlowNet cost volume)
+# ---------------------------------------------------------------------------
+
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """Cost volume between two feature maps; output
+    [N, D*D, H, W] with D = 2*(max_displacement/stride2)+1.  Out-of-bounds
+    displacements contribute zeros (the reference zero-pads; rolling would
+    wrap the opposite border into border costs)."""
+    N, C, H, W = data1.shape
+    md = int(max_displacement)
+    s2 = int(stride2)
+    d2p = jnp.pad(data2, ((0, 0), (0, 0), (md, md), (md, md)))
+    outs = []
+    for dy in range(-md, md + 1, s2):
+        for dx in range(-md, md + 1, s2):
+            shifted = d2p[:, :, md + dy:md + dy + H, md + dx:md + dx + W]
+            prod = data1 * shifted if is_multiply \
+                else jnp.abs(data1 - shifted)
+            outs.append(jnp.mean(prod, axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+register("Correlation", _correlation, input_names=("data1", "data2"),
+         params={"kernel_size": (pInt, 1), "max_displacement": (pInt, 1),
+                 "stride1": (pInt, 1), "stride2": (pInt, 1),
+                 "pad_size": (pInt, 0), "is_multiply": (pBool, True)})
+
+
+# ---------------------------------------------------------------------------
+# Crop / depth-space / smooth_l1 (ref: crop-inl.h, matrix_op, smooth_l1)
+# ---------------------------------------------------------------------------
+
+def _crop(*args, offset=(0, 0), h_w=(0, 0), center_crop=False,
+          num_args=0):
+    data = args[0]
+    if len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0 = (H - th) // 2
+        x0 = (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+def _crop_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, None
+    if len(in_shapes) == 2:
+        ref = in_shapes[1]
+        if ref is None:
+            return in_shapes, None
+        th, tw = ref[2], ref[3]
+    else:
+        th, tw = attrs["h_w"]
+    return in_shapes, [(d[0], d[1], int(th), int(tw))]
+
+
+register("Crop", _crop, num_inputs=None, key_var_num_args="num_args",
+         infer_shape=_crop_infer_shape,
+         params={"offset": (pShape, (0, 0)), "h_w": (pShape, (0, 0)),
+                 "center_crop": (pBool, False), "num_args": (pInt, 0)})
+
+
+def _depth_to_space(data, block_size=1):
+    N, C, H, W = data.shape
+    b = int(block_size)
+    x = data.reshape(N, b, b, C // (b * b), H, W)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(N, C // (b * b), H * b, W * b)
+
+
+register("depth_to_space", _depth_to_space, num_inputs=1,
+         params={"block_size": (pInt, 1)})
+
+
+def _space_to_depth(data, block_size=1):
+    N, C, H, W = data.shape
+    b = int(block_size)
+    x = data.reshape(N, C, H // b, b, W // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(N, C * b * b, H // b, W // b)
+
+
+register("space_to_depth", _space_to_depth, num_inputs=1,
+         params={"block_size": (pInt, 1)})
+
+
+def _smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * data * data,
+                     jnp.abs(data) - 0.5 / s2)
+
+
+register("smooth_l1", _smooth_l1, num_inputs=1,
+         params={"scalar": (pFloat, 1.0)})
+
+
+def _identity_kl_sparse(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9):
+    # forward identity; KL sparsity penalty applies only to gradients in
+    # the reference (training-time regularizer)
+    return data
+
+
+register("IdentityAttachKLSparseReg", _identity_kl_sparse, num_inputs=1,
+         params={"sparseness_target": (pFloat, 0.1),
+                 "penalty": (pFloat, 0.001), "momentum": (pFloat, 0.9)})
+
+
+# ---------------------------------------------------------------------------
+# linalg ops (ref: tensor/la_op.h — LAPACK in the reference)
+# ---------------------------------------------------------------------------
+
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0):
+    At = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    Bt = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (At @ Bt) + beta * C
+
+
+register("linalg_gemm", _linalg_gemm, input_names=("A", "B", "C"),
+         aliases=("_linalg_gemm",),
+         params={"transpose_a": (pBool, False), "transpose_b": (pBool, False),
+                 "alpha": (pFloat, 1.0), "beta": (pFloat, 1.0)})
+
+
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    Bt = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (At @ Bt)
+
+
+register("linalg_gemm2", _linalg_gemm2, input_names=("A", "B"),
+         aliases=("_linalg_gemm2",),
+         params={"transpose_a": (pBool, False), "transpose_b": (pBool, False),
+                 "alpha": (pFloat, 1.0)})
+
+
+register("linalg_potrf", lambda A: jnp.linalg.cholesky(A),
+         num_inputs=1, aliases=("_linalg_potrf",))
+
+
+def _linalg_potri(A):
+    """Input is the lower Cholesky factor L (potrf output); returns
+    (L L^T)^{-1} = L^{-T} L^{-1} via triangular solve."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.swapaxes(Linv, -1, -2) @ Linv
+
+
+register("linalg_potri", _linalg_potri, num_inputs=1,
+         aliases=("_linalg_potri",))
+
+
+def _linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2) if transpose else A
+    if rightside:
+        # solve X A = alpha B  =>  A^T X^T = alpha B^T
+        X = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(At, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not transpose)
+        return jnp.swapaxes(X, -1, -2)
+    return jax.scipy.linalg.solve_triangular(At, alpha * B,
+                                             lower=not transpose)
+
+
+register("linalg_trsm", _linalg_trsm, input_names=("A", "B"),
+         aliases=("_linalg_trsm",),
+         params={"transpose": (pBool, False), "rightside": (pBool, False),
+                 "alpha": (pFloat, 1.0)})
+
+
+def _linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2) if transpose else A
+    if rightside:
+        return alpha * (B @ At)
+    return alpha * (At @ B)
+
+
+register("linalg_trmm", _linalg_trmm, input_names=("A", "B"),
+         aliases=("_linalg_trmm",),
+         params={"transpose": (pBool, False), "rightside": (pBool, False),
+                 "alpha": (pFloat, 1.0)})
+
+
+def _linalg_syrk(A, transpose=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (At @ A if transpose else A @ At)
+
+
+register("linalg_syrk", _linalg_syrk, num_inputs=1,
+         aliases=("_linalg_syrk",),
+         params={"transpose": (pBool, False), "alpha": (pFloat, 1.0)})
+
+
+register("linalg_sumlogdiag",
+         lambda A: jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)),
+                           axis=-1),
+         num_inputs=1, aliases=("_linalg_sumlogdiag",))
+
+
+def _khatri_rao(*args, num_args=0):
+    """Column-wise Kronecker product (ref: contrib/krprod)."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+register("khatri_rao", _khatri_rao, num_inputs=None,
+         key_var_num_args="num_args",
+         aliases=("_contrib_khatri_rao",),
+         params={"num_args": (pInt, 0)})
+
+
+# ---------------------------------------------------------------------------
+# Remaining optimizer update ops (ref: optimizer_op-inl.h)
+# mutate_map convention: trailing outputs rebind weight (and states)
+# ---------------------------------------------------------------------------
+
+def _ftml_update(weight, grad, d, v, z, lr, t=1, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    v_t = beta2 * v + (1 - beta2) * g * g
+    d_t = (1 - beta1 ** t) / lr * \
+        (jnp.sqrt(v_t / (1 - beta2 ** t)) + epsilon)
+    sigma_t = d_t - beta1 * d
+    z_t = beta1 * z + (1 - beta1) * g - sigma_t * weight
+    w_t = -z_t / d_t
+    return w_t, d_t, v_t, z_t
+
+
+register("ftml_update", _ftml_update,
+         input_names=("weight", "grad", "d", "v", "z"),
+         num_outputs=1, mutate_map=(2, 3, 4),
+         params={"lr": (pFloat, None), "t": (pInt, 1),
+                 "beta1": (pFloat, 0.6), "beta2": (pFloat, 0.999),
+                 "epsilon": (pFloat, 1e-8), "wd": (pFloat, 0.0),
+                 "rescale_grad": (pFloat, 1.0), "clip_grad": (pFloat, -1.0)})
+
+
+def _nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mom_t = momentum * mom + g
+    return weight - lr * (momentum * mom_t + g), mom_t
+
+
+register("nag_mom_update", _nag_mom_update,
+         input_names=("weight", "grad", "mom"),
+         num_outputs=1, mutate_map=(2,),
+         params={"lr": (pFloat, None), "momentum": (pFloat, 0.0),
+                 "wd": (pFloat, 0.0), "rescale_grad": (pFloat, 1.0),
+                 "clip_gradient": (pFloat, -1.0)})
+
+
+def _sgld_update(key, weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    noise = jax.random.normal(key, weight.shape, weight.dtype) * \
+        jnp.sqrt(lr)
+    return weight - lr / 2 * g + noise
+
+
+register("sgld_update", _sgld_update, input_names=("weight", "grad"),
+         needs_rng=True,
+         params={"lr": (pFloat, None), "wd": (pFloat, 0.0),
+                 "rescale_grad": (pFloat, 1.0),
+                 "clip_gradient": (pFloat, -1.0)})
+
+
+def _adamax_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                   t=1, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   epsilon=1e-8):
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m_t = beta1 * mean + (1 - beta1) * g
+    u_t = jnp.maximum(beta2 * var, jnp.abs(g))
+    return weight - lr / (1 - beta1 ** t) * m_t / (u_t + epsilon), m_t, u_t
+
+
+register("adamax_update", _adamax_update,
+         input_names=("weight", "grad", "mean", "var"),
+         num_outputs=1, mutate_map=(2, 3),
+         params={"lr": (pFloat, None), "beta1": (pFloat, 0.9),
+                 "beta2": (pFloat, 0.999), "t": (pInt, 1),
+                 "wd": (pFloat, 0.0), "rescale_grad": (pFloat, 1.0),
+                 "clip_gradient": (pFloat, -1.0),
+                 "epsilon": (pFloat, 1e-8)})
+
+
+def _nadam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                  t=1, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  epsilon=1e-8, schedule_decay=0.004):
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mu_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+    mu_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    g_hat = g / (1 - mu_t)
+    m_t = beta1 * mean + (1 - beta1) * g
+    m_hat = m_t / (1 - mu_t1)
+    v_t = beta2 * var + (1 - beta2) * g * g
+    v_hat = v_t / (1 - beta2 ** t)
+    m_bar = (1 - mu_t) * g_hat + mu_t1 * m_hat
+    return (weight - lr * m_bar / (jnp.sqrt(v_hat) + epsilon), m_t, v_t)
+
+
+register("nadam_update", _nadam_update,
+         input_names=("weight", "grad", "mean", "var"),
+         num_outputs=1, mutate_map=(2, 3),
+         params={"lr": (pFloat, None), "beta1": (pFloat, 0.9),
+                 "beta2": (pFloat, 0.999), "t": (pInt, 1),
+                 "wd": (pFloat, 0.0), "rescale_grad": (pFloat, 1.0),
+                 "clip_gradient": (pFloat, -1.0), "epsilon": (pFloat, 1e-8),
+                 "schedule_decay": (pFloat, 0.004)})

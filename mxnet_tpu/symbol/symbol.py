@@ -622,7 +622,12 @@ def _create(op_name, sym_inputs, attrs, name=None):
     if op.input_names:
         full = list(op.input_names) + list(op.aux_names)
         nattrs = op.normalize_attrs(attrs)
-        n_expected = len(full)
+        if callable(op.num_inputs):
+            # attr-dependent arity (RNN's state_cell, CTCLoss's optional
+            # length inputs): never auto-create beyond the actual count
+            n_expected = op.num_inputs(nattrs)
+        else:
+            n_expected = len(full)
         if op_name in ("FullyConnected", "Convolution", "Deconvolution") and \
                 nattrs.get("no_bias"):
             n_expected -= 1

@@ -85,14 +85,24 @@ class Engine {
     Op op;
     op.fn = fn;
     op.arg = arg;
-    op.writes.assign(writes, writes + n_writes);
-    // dedup: a var both read and written counts as a write only (the
-    // reference rejects overlap via CheckDuplicate, threaded_engine.h:409;
-    // here the useful semantic — exclusive access — is kept instead)
+    // dedup everywhere: repeated vars within a list, and a var both read
+    // and written, would self-deadlock the grant queue (the reference
+    // rejects overlap via CheckDuplicate, threaded_engine.h:409; here the
+    // useful semantic — single exclusive/shared claim — is kept instead)
+    for (int j = 0; j < n_writes; ++j) {
+      bool dup = false;
+      for (size_t k = 0; k < op.writes.size(); ++k) {
+        if (op.writes[k] == writes[j]) dup = true;
+      }
+      if (!dup) op.writes.push_back(writes[j]);
+    }
     for (int i = 0; i < n_reads; ++i) {
       bool dup = false;
-      for (int j = 0; j < n_writes; ++j) {
-        if (reads[i] == writes[j]) dup = true;
+      for (size_t k = 0; k < op.writes.size(); ++k) {
+        if (op.writes[k] == reads[i]) dup = true;
+      }
+      for (size_t k = 0; k < op.reads.size(); ++k) {
+        if (op.reads[k] == reads[i]) dup = true;
       }
       if (!dup) op.reads.push_back(reads[i]);
     }

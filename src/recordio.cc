@@ -110,15 +110,22 @@ class Prefetcher {
   bool ok() const { return reader_.ok(); }
 
   // Blocks until a record (or EOF/corruption) is available.
-  // Returns 1 on data, 0 on EOF, -1 on corruption.
+  // Returns 1 on data, 0 on EOF, -1 on corruption.  The terminal status is
+  // sticky: reads past it keep returning it instead of blocking on the
+  // exited worker.
   int Next(std::vector<uint8_t>* out) {
     std::unique_lock<std::mutex> lk(mu_);
-    not_empty_.wait(lk, [this] { return !queue_.empty() || stop_; });
-    if (queue_.empty()) return 0;
+    not_empty_.wait(lk, [this] {
+      return !queue_.empty() || stop_ || terminal_ != 1;
+    });
+    if (queue_.empty()) return terminal_ != 1 ? terminal_ : 0;
     Record rec = std::move(queue_.front());
     queue_.pop_front();
     not_full_.notify_one();
-    if (rec.status != 1) return rec.status;
+    if (rec.status != 1) {
+      terminal_ = rec.status;
+      return rec.status;
+    }
     *out = std::move(rec.data);
     return 1;
   }
@@ -144,6 +151,7 @@ class Prefetcher {
   Reader reader_;
   size_t capacity_;
   bool stop_;
+  int terminal_ = 1;  // sticky terminal status once EOF/corrupt consumed
   std::deque<Record> queue_;
   std::mutex mu_;
   std::condition_variable not_empty_, not_full_;

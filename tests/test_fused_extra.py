@@ -1,0 +1,123 @@
+"""Fused train step parity + remaining layer/linalg op tests."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _make_module(ctx_list, kvstore=None, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(12, 4).astype(np.float32)
+    X = rng.randn(128, 12).astype(np.float32)
+    Y = (X @ W).argmax(axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=False,
+                           label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=ctx_list)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    kw = {"kvstore": kvstore} if kvstore else {}
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9}, **kw)
+    return mod, it
+
+
+def test_fused_step_matches_unfused():
+    """The one-dispatch fused program must produce identical parameters to
+    the general forward/backward/update path."""
+    mod_f, it = _make_module(mx.cpu())
+    assert mod_f._fused_step is not None
+    mod_u, _ = _make_module(mx.cpu())
+    mod_u._fused_step = None  # force the general path
+    # identical initial params
+    args, _ = mod_f.get_params()
+    mod_u.set_params(*mod_f.get_params())
+    for _ in range(2):
+        it.reset()
+        for batch in it:
+            mod_f.forward_backward(batch)
+            mod_f.update()
+            mod_u.forward_backward(batch)
+            mod_u.update()
+    pf, _ = mod_f.get_params()
+    pu, _ = mod_u.get_params()
+    for k in pf:
+        np.testing.assert_allclose(pf[k].asnumpy(), pu[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fused_step_outputs_feed_metrics():
+    mod, it = _make_module(mx.cpu())
+    metric = mx.metric.create("acc")
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.rand(2, 3, 5, 5).astype(np.float32))
+    theta = mx.nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(
+        np.float32))
+    out = mx.nd.SpatialTransformer(data, theta, target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), atol=1e-5)
+
+
+def test_roi_pooling_max():
+    rng = np.random.RandomState(1)
+    d = mx.nd.array(rng.rand(1, 4, 8, 8).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    rp = mx.nd.ROIPooling(d, rois, pooled_size=(1, 1), spatial_scale=1.0)
+    np.testing.assert_allclose(rp.asnumpy()[0, :, 0, 0],
+                               d.asnumpy()[0].max(axis=(1, 2)), rtol=1e-6)
+
+
+def test_linalg_ops():
+    rng = np.random.RandomState(2)
+    A = rng.rand(4, 4).astype(np.float32)
+    A = A @ A.T + 4 * np.eye(4, dtype=np.float32)
+    L = mx.nd.linalg_potrf(mx.nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, A, atol=1e-3)
+    B = rng.rand(4, 3).astype(np.float32)
+    X = mx.nd.linalg_trsm(L, mx.nd.array(B))
+    np.testing.assert_allclose(L.asnumpy() @ X.asnumpy(), B, atol=1e-3)
+    np.testing.assert_allclose(
+        mx.nd.linalg_syrk(mx.nd.array(B)).asnumpy(), B @ B.T, rtol=1e-4)
+    C = rng.rand(2, 5).astype(np.float32)
+    D = rng.rand(5, 3).astype(np.float32)
+    E = rng.rand(2, 3).astype(np.float32)
+    out = mx.nd.linalg_gemm(mx.nd.array(C), mx.nd.array(D), mx.nd.array(E),
+                            alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * (C @ D) + 0.5 * E,
+                               rtol=1e-5)
+    sld = mx.nd.linalg_sumlogdiag(mx.nd.array(A))
+    np.testing.assert_allclose(sld.asnumpy(),
+                               np.log(np.diag(A)).sum(), rtol=1e-5)
+
+
+def test_depth_space_roundtrip_and_smooth_l1():
+    rng = np.random.RandomState(3)
+    z = mx.nd.array(rng.rand(2, 8, 3, 3).astype(np.float32))
+    rt = mx.nd.space_to_depth(mx.nd.depth_to_space(z, block_size=2),
+                              block_size=2)
+    np.testing.assert_allclose(rt.asnumpy(), z.asnumpy())
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.5, 2.0], np.float32))
+    np.testing.assert_allclose(mx.nd.smooth_l1(x, 1.0).asnumpy(),
+                               [1.5, 0.125, 0.125, 1.5])
+
+
+def test_new_optimizer_ops_exist():
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,)) * 0.1
+    m = mx.nd.zeros((4,))
+    v = mx.nd.zeros((4,))
+    out = mx.nd.adamax_update(w, g, m, v, lr=0.1)
+    assert np.isfinite(out.asnumpy()).all()
+    out2 = mx.nd.nag_mom_update(w, g, m, lr=0.1, momentum=0.9)
+    assert np.isfinite(out2.asnumpy()).all()

@@ -1,0 +1,68 @@
+"""Measure gradient-aggregation bandwidth (parity: tools/bandwidth/
+measure.py — there it times kvstore push/pull over NCCL/ps-lite; here it
+times the tpu_ici reduce + broadcast over the device mesh)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser(description="measure kvstore bandwidth")
+    parser.add_argument("--kv-store", type=str, default="tpu_ici")
+    parser.add_argument("--num-arrays", type=int, default=10)
+    parser.add_argument("--size-mb", type=float, default=16)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--num-devices", type=int, default=0,
+                        help="0 = all visible devices")
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+    import jax
+
+    devs = jax.devices()
+    n = args.num_devices or len(devs)
+    n_elem = int(args.size_mb * 1024 * 1024 / 4)
+
+    kv = mx.kvstore.create(args.kv_store)
+    rng = np.random.RandomState(0)
+    arrays = []
+    for i in range(args.num_arrays):
+        vals = [mx.nd.array(rng.rand(n_elem).astype(np.float32))
+                for _ in range(n)]
+        kv.init(i, vals[0])
+        arrays.append(vals)
+    outs = [[mx.nd.zeros((n_elem,)) for _ in range(n)]
+            for _ in range(args.num_arrays)]
+
+    for i, vals in enumerate(arrays):  # warmup
+        kv.push(i, vals)
+        kv.pull(i, out=outs[i])
+    for o in outs[-1]:
+        o.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        for i, vals in enumerate(arrays):
+            kv.push(i, vals)
+            kv.pull(i, out=outs[i])
+    for o in outs[-1]:
+        o.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    total_gb = args.iters * args.num_arrays * args.size_mb * n * 2 / 1024
+    print("kvstore=%s devices=%d arrays=%d size=%.0fMB: %.2f GB/s "
+          "(%.1f ms/round)" % (
+              args.kv_store, n, args.num_arrays, args.size_mb,
+              total_gb / dt,
+              dt / (args.iters * args.num_arrays) * 1000))
+
+
+if __name__ == "__main__":
+    main()

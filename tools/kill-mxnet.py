@@ -1,0 +1,19 @@
+"""Kill stray training processes on the hosts in a hostfile
+(parity: tools/kill-mxnet.py)."""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print("usage: %s <hostfile> <prog_name>" % sys.argv[0])
+        sys.exit(1)
+    hostfile, prog = sys.argv[1], sys.argv[2]
+    kill_cmd = "pkill -f '%s' || true" % prog
+    with open(hostfile) as f:
+        hosts = [l.strip() for l in f if l.strip()]
+    for h in hosts:
+        print("killing %s on %s" % (prog, h))
+        subprocess.call("ssh -o StrictHostKeyChecking=no %s \"%s\"" %
+                        (h, kill_cmd), shell=True)
