@@ -18,11 +18,10 @@ def get_symbol(network, num_layers, image_shape):
     if network == "resnet":
         from mxnet_tpu.models import resnet
         return resnet.get_symbol(1000, num_layers, image_shape)
+    # gluon zoo models: compose into a Symbol for the bind path
     from mxnet_tpu.gluon.model_zoo import vision
     net = vision.get_model(network)
-    net.initialize()
-    net.hybridize()
-    return net
+    return net(mx.sym.Variable("data"))
 
 
 def score(network, num_layers, dev, batch_size, image_shape="3,224,224",

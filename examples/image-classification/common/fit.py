@@ -88,7 +88,9 @@ def fit(args, network, data_loader, **kwargs):
     train, val = data_loader(args, kv)
     devs = _devices(args)
 
-    epoch_size = args.num_examples // args.batch_size \
+    # per-worker batches per epoch (the lr schedule steps on each worker's
+    # own update count, so the global epoch boundary divides by num_workers)
+    epoch_size = args.num_examples // args.batch_size // kv.num_workers \
         if hasattr(args, "num_examples") else 1000
     lr, lr_scheduler = _get_lr_scheduler(args, kv, epoch_size)
 

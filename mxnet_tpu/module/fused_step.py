@@ -27,12 +27,6 @@ from .. import random as _random
 from ..ndarray import NDArray
 
 
-def _mult(d, idx, name, default=1.0):
-    if idx in d:
-        return d[idx]
-    return d.get(name, default)
-
-
 class FusedTrainStep:
     @staticmethod
     def supports(module):
@@ -60,6 +54,7 @@ class FusedTrainStep:
         self.module = module
         self.exe = module._exec_group.execs[0]
         self.opt = module._optimizer
+        self.ran = False
         exe = self.exe
         prog = exe._prog
         self.prog = prog
@@ -134,6 +129,17 @@ class FusedTrainStep:
 
     def run(self, data_batch):
         module = self.module
+        if module._exec_group.execs[0] is not self.exe:
+            # a reshape rebuilt the executors: rebind to the live one,
+            # carrying the momentum state over by name
+            self.exe = module._exec_group.execs[0]
+            mom = self.mom
+            self.__init__(module)
+            if mom is not None and self.mom is not None:
+                for n, v in mom.items():
+                    if n in self.mom and v.shape == self.mom[n].shape:
+                        self.mom[n] = v
+        self.ran = True
         exe = self.exe
         # load batch into the bound input buffers (device upload + dtype
         # cast; the batch usually arrives host-side from the data pipeline)

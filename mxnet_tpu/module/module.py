@@ -317,7 +317,7 @@ class Module(BaseModule):
         from .fused_step import FusedTrainStep
         self._fused_step = FusedTrainStep(self) \
             if FusedTrainStep.supports(self) else None
-        self._fused_pending = None
+        self._fused_pending = False
 
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -363,8 +363,12 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         if getattr(self, "_fused_step", None) is not None:
-            # defer: the fused program runs fwd+bwd+update in update()
-            self._fused_pending = data_batch
+            # the fused program IS forward+backward+update: outputs are
+            # available immediately (update_metric may run before update()),
+            # and the matching update() call becomes a no-op
+            self._fused_step.run(data_batch)
+            self._fused_pending = True
+            self._params_dirty = True
             return
         super().forward_backward(data_batch)
 
@@ -372,12 +376,16 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
-        if getattr(self, "_fused_step", None) is not None \
-                and self._fused_pending is not None:
-            batch = self._fused_pending
-            self._fused_pending = None
-            self._fused_step.run(batch)
-            return
+        if getattr(self, "_fused_step", None) is not None:
+            if self._fused_pending:
+                self._fused_pending = False  # applied in forward_backward
+                return
+            # update() without a fused forward_backward: the caller drives
+            # forward/backward explicitly — retire the fused path so there
+            # is exactly one optimizer-state store
+            self.logger.info("explicit forward/backward detected; "
+                             "disabling the fused train step")
+            self._fused_step = None
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -409,7 +417,8 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if getattr(self, "_fused_step", None) is not None:
+        if getattr(self, "_fused_step", None) is not None \
+                and self._fused_step.ran:
             import pickle
             with open(fname, "wb") as fout:
                 pickle.dump(self._fused_step.export_states(), fout)

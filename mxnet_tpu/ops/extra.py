@@ -185,22 +185,43 @@ register("ROIPooling", _roi_pooling, input_names=("data", "rois"),
 
 def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                  stride2=1, pad_size=0, is_multiply=True):
-    """Cost volume between two feature maps; output
-    [N, D*D, H, W] with D = 2*(max_displacement/stride2)+1.  Out-of-bounds
-    displacements contribute zeros (the reference zero-pads; rolling would
-    wrap the opposite border into border costs)."""
+    """Cost volume between two feature maps (FlowNet-C).  Output
+    [N, D*D, H', W'] with D = 2*(max_displacement/stride2)+1 and
+    H' = H + 2*pad - 2*max_displacement strided by stride1 (the reference's
+    geometry).  Patch comparison over kernel_size x kernel_size windows;
+    out-of-bounds displacements contribute zeros (zero padding, not wrap)."""
     N, C, H, W = data1.shape
     md = int(max_displacement)
-    s2 = int(stride2)
-    d2p = jnp.pad(data2, ((0, 0), (0, 0), (md, md), (md, md)))
+    s1, s2 = int(stride1), int(stride2)
+    ks = int(kernel_size)
+    pad = int(pad_size)
+    d1p = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2p = jnp.pad(data2, ((0, 0), (0, 0), (pad + md, pad + md),
+                          (pad + md, pad + md)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    kh = ks // 2
+
+    def window_mean(x):
+        if ks == 1:
+            return x
+        w = lax.reduce_window(x, 0.0, lax.add, (1, 1, ks, ks),
+                              (1, 1, 1, 1), "SAME")
+        return w / (ks * ks)
+
     outs = []
     for dy in range(-md, md + 1, s2):
         for dx in range(-md, md + 1, s2):
-            shifted = d2p[:, :, md + dy:md + dy + H, md + dx:md + dx + W]
-            prod = data1 * shifted if is_multiply \
-                else jnp.abs(data1 - shifted)
-            outs.append(jnp.mean(prod, axis=1))
-    return jnp.stack(outs, axis=1)
+            shifted = d2p[:, :, md + dy:md + dy + Hp, md + dx:md + dx + Wp]
+            prod = d1p * shifted if is_multiply \
+                else jnp.abs(d1p - shifted)
+            cost = jnp.mean(prod, axis=1, keepdims=True)
+            outs.append(window_mean(cost)[:, 0])
+    out = jnp.stack(outs, axis=1)
+    # valid region: centers within max_displacement of the padded border,
+    # subsampled by stride1
+    out = out[:, :, md:Hp - md:s1, md:Wp - md:s1] if Hp - 2 * md > 0 \
+        else out[:, :, ::s1, ::s1]
+    return out
 
 
 register("Correlation", _correlation, input_names=("data1", "data2"),

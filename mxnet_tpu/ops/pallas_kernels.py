@@ -1,0 +1,160 @@
+"""Pallas TPU kernels for hot ops.
+
+Where the reference hand-writes CUDA for its hot paths (88 .cu files,
+SURVEY.md §2.3) this framework leans on XLA fusion — and reaches for Pallas
+only where a hand-scheduled kernel beats the compiler.  First citizen:
+blocked flash attention (online-softmax over KV tiles staged through VMEM,
+QK^T and PV on the MXU) — the single-chip building block under
+parallel/ring.py's sequence-parallel ring.
+
+All kernels ship with a pure-XLA fallback (`use_pallas=False` or non-TPU
+backends run the same math via jnp) and are validated against it in tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal, scale):
+    """[B, S, H, D] exact attention — the fallback + test oracle."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        n_q, n_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((n_q, n_k), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  causal, scale, block_q, block_k, n_kv_blocks):
+    """One (q-block, kv-block) grid step.  Grid = (BH, n_q, n_kv) with the
+    kv dimension innermost; m/l/acc scratch persists across kv steps of the
+    same q block (standard flash-attention accumulation)."""
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: kv blocks strictly above the diagonal contribute nothing
+    needed = (kv_idx * block_k <= q_idx * block_q + (block_q - 1)) \
+        if causal else (kv_idx == kv_idx)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                  # [block_q, d]
+        k = k_ref[0]                  # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            rows = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        # m/l scratch is lane-tiled [block_q, 128] (TPU min tile); the
+        # running stats live broadcast across lanes and are read back via
+        # a 1-lane slice of the loaded value
+        m_prev = m_ref[:][:, :1]      # [block_q, 1]
+        l_prev = l_ref[:][:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        lanes = m_ref.shape[1]
+        m_ref[:] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
+        l_ref[:] = jnp.broadcast_to(l_new, (l_new.shape[0], lanes))
+
+    @pl.when(kv_idx == n_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:][:, :1]
+        l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, use_pallas=None, interpret=None):
+    """Blocked flash attention.  q/k/v: [batch, seq, heads, head_dim].
+
+    use_pallas=None auto-selects: the Pallas kernel on TPU backends when
+    the sequence tiles evenly, the XLA reference otherwise.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if use_pallas is None:
+        use_pallas = (jax.default_backend() in ("tpu", "axon")
+                      and sq % min(block_q, sq) == 0
+                      and sk % min(block_k, sk) == 0)
+    if not use_pallas:
+        return _reference_attention(q, k, v, causal, scale)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = sq // block_q
+    n_kv = sk // block_k
+
+    # layout: fold heads into batch, [BH, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, n_kv_blocks=n_kv)
+
+    # the framework enables jax x64 globally (float64 NDArray API parity);
+    # Mosaic rejects 64-bit types, so trace the kernel under 32-bit rules
+    with jax.enable_x64(False):
+        out = _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv,
+                          block_q, block_k, q.dtype, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
+                block_k, dtype, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        **({"interpret": interpret} if interpret is not None else {}),
+    )(qf, kf, vf)
