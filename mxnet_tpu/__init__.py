@@ -55,3 +55,4 @@ from . import models
 from . import parallel
 from . import predict
 from . import io_native
+from . import checkpoint

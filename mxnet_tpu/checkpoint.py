@@ -1,0 +1,103 @@
+"""Pod-scale checkpointing: sharded, async, elastic-restart friendly.
+
+The reference's checkpoint format is symbol JSON + a single binary NDArray
+blob written by rank 0 (SURVEY.md §5.4); at pod scale that serializes
+terabytes through one host.  The TPU-native path (orbax/tensorstore) writes
+each parameter shard from the host that owns it, asynchronously, and
+restores onto any mesh topology — the checkpoint-based elastic restart
+story from SURVEY.md §5.3.
+
+Two tiers:
+- `save_checkpoint`/`load_checkpoint` (mxnet_tpu.model) stay byte-compatible
+  with the reference's two-artifact format for single-host use.
+- `ShardedCheckpointManager` here handles mesh-sharded params: Module or a
+  ShardedTrainStep hand it a name->jax.Array dict (possibly sharded over a
+  Mesh) and it round-trips through an orbax CheckpointManager.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["ShardedCheckpointManager", "save_sharded", "load_sharded"]
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+class ShardedCheckpointManager:
+    """Async sharded checkpoints with retention (ref counterpart:
+    mx.callback.do_checkpoint + NDArray::Save, scaled out)."""
+
+    def __init__(self, directory, max_to_keep=3, async_save=True):
+        ocp = _orbax()
+        self._dir = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                               enable_async_checkpointing=
+                                               async_save)
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def save(self, step, params, extra=None):
+        """params: {name: jax.Array | NDArray}; extra: small pytree of
+        host-side state (optimizer scalars, epoch counters)."""
+        ocp = _orbax()
+        arrays = {k: (v._h.array if hasattr(v, "_h") else v)
+                  for k, v in params.items()}
+        # 'extra' is always present so restore never has to probe for it
+        args = {"params": ocp.args.StandardSave(arrays),
+                "extra": ocp.args.JsonSave(extra if extra is not None
+                                           else {})}
+        self._mgr.save(step, args=ocp.args.Composite(**args))
+
+    def restore(self, step=None, like=None):
+        """Returns (params, extra).  `like` optionally maps name ->
+        jax.Array/ShapeDtypeStruct with target shardings so shards restore
+        directly onto the live mesh layout."""
+        ocp = _orbax()
+        if step is None:
+            step = self._mgr.latest_step()
+        kwargs = {}
+        if like is not None:
+            tmpl = {k: (v._h.array if hasattr(v, "_h") else v)
+                    for k, v in like.items()}
+            kwargs["params"] = ocp.args.StandardRestore(tmpl)
+        else:
+            kwargs["params"] = ocp.args.StandardRestore()
+        kwargs["extra"] = ocp.args.JsonRestore()
+        out = self._mgr.restore(step, args=ocp.args.Composite(**kwargs))
+        extra = out.get("extra")
+        return dict(out["params"]), (extra if extra else None)
+
+    def wait(self):
+        """Block until pending async saves are durable (call before exit
+        or before a barrier that tears down hosts)."""
+        self._mgr.wait_until_finished()
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_sharded(directory, step, params, extra=None):
+    mgr = ShardedCheckpointManager(directory, async_save=False)
+    try:
+        mgr.save(step, params, extra)
+        mgr.wait()
+    finally:
+        mgr.close()
+
+
+def load_sharded(directory, step=None, like=None):
+    mgr = ShardedCheckpointManager(directory, async_save=False)
+    try:
+        return mgr.restore(step, like=like)
+    finally:
+        mgr.close()

@@ -88,8 +88,36 @@ class KVStore:
                 stored.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense-backed emulation: full pull (row_sparse lives in ndarray.sparse)
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows (ref: kvstore.h row_sparse_pull —
+        the embedding-table fast path).  Dense-backed: the gather runs on
+        device; outputs are RowSparseNDArrays holding just those rows."""
+        assert out is not None
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        from ..ndarray import sparse as sp
+        from ..ndarray import NDArray, array as nd_array
+        import numpy as np
+        keys, outs = _key_value(key, out)
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, row_ids):
+            stored = self._stored[k]
+            dense = stored.todense() if hasattr(stored, "todense") else stored
+            ids = np.unique(rid.asnumpy().astype(np.int64))
+            rows = dense._h.array[ids]
+            if isinstance(olist, NDArray):
+                olist = [olist]
+            for o in olist:
+                result = sp.RowSparseNDArray(
+                    NDArray(rows), nd_array(ids, dtype=np.int64),
+                    dense.shape)
+                if isinstance(o, sp.RowSparseNDArray):
+                    o._data_arr = result._data_arr
+                    o._indices = result._indices
+                    o._sshape = result._sshape
+                else:
+                    result.todense().copyto(o)
 
     def set_gradient_compression(self, compression_params):
         self._compression_params = compression_params

@@ -365,11 +365,22 @@ class Module(BaseModule):
         if getattr(self, "_fused_step", None) is not None:
             # the fused program IS forward+backward+update: outputs are
             # available immediately (update_metric may run before update()),
-            # and the matching update() call becomes a no-op
-            self._fused_step.run(data_batch)
-            self._fused_pending = True
-            self._params_dirty = True
-            return
+            # and the matching update() call becomes a no-op.  Loops that
+            # deviate from the one-fb-one-update contract, or that change
+            # batch shapes mid-stream, retire the fused path.
+            batch_shapes = tuple(tuple(d.shape) for d in data_batch.data)
+            bound_shapes = tuple(tuple(d.shape) for d in self._data_shapes)
+            if self._fused_pending or batch_shapes != bound_shapes:
+                self.logger.info(
+                    "non-canonical training loop (repeated forward_backward "
+                    "or batch shape change); disabling the fused train step")
+                self._fused_step = None
+                self._fused_pending = False
+            else:
+                self._fused_step.run(data_batch)
+                self._fused_pending = True
+                self._params_dirty = True
+                return
         super().forward_backward(data_batch)
 
     def update(self):
@@ -419,9 +430,13 @@ class Module(BaseModule):
         assert self.optimizer_initialized
         if getattr(self, "_fused_step", None) is not None \
                 and self._fused_step.ran:
+            # self-describing container so load works regardless of which
+            # path the restoring process ends up using
             import pickle
             with open(fname, "wb") as fout:
-                pickle.dump(self._fused_step.export_states(), fout)
+                pickle.dump({"format": "fused_v1",
+                             "states": self._fused_step.export_states()},
+                            fout)
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -430,15 +445,33 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            raw = f.read()
+        import pickle
+        payload = None
+        try:
+            obj = pickle.loads(raw)
+            if isinstance(obj, dict) and obj.get("format") == "fused_v1":
+                payload = obj["states"]
+        except Exception:
+            pass
+        if payload is not None:
+            if getattr(self, "_fused_step", None) is not None:
+                self._fused_step.load_states(payload)
+            else:
+                self.logger.warning(
+                    "fused-format optimizer states loaded without a fused "
+                    "step; momentum not restored")
+            return
         if getattr(self, "_fused_step", None) is not None:
-            import pickle
-            with open(fname, "rb") as f:
-                self._fused_step.load_states(pickle.load(f))
-        elif self._update_on_kvstore:
+            self.logger.warning(
+                "updater-format optimizer states with a fused step active; "
+                "disabling the fused step to restore them faithfully")
+            self._fused_step = None
+        if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            self._updater.set_states(raw)
 
     def install_monitor(self, mon):
         assert self.binded

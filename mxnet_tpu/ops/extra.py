@@ -199,13 +199,14 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     d2p = jnp.pad(data2, ((0, 0), (0, 0), (pad + md, pad + md),
                           (pad + md, pad + md)))
     Hp, Wp = H + 2 * pad, W + 2 * pad
-    kh = ks // 2
 
     def window_mean(x):
+        """Top-left-anchored ks-window sums over VALID positions (the
+        reference sums tmp[y1+h][x1+w], h,w in [0,ks))."""
         if ks == 1:
             return x
         w = lax.reduce_window(x, 0.0, lax.add, (1, 1, ks, ks),
-                              (1, 1, 1, 1), "SAME")
+                              (1, 1, 1, 1), "VALID")
         return w / (ks * ks)
 
     outs = []
@@ -217,10 +218,15 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
             cost = jnp.mean(prod, axis=1, keepdims=True)
             outs.append(window_mean(cost)[:, 0])
     out = jnp.stack(outs, axis=1)
-    # valid region: centers within max_displacement of the padded border,
-    # subsampled by stride1
-    out = out[:, :, md:Hp - md:s1, md:Wp - md:s1] if Hp - 2 * md > 0 \
-        else out[:, :, ::s1, ::s1]
+    # first window top-left sits at max_displacement from the padded border
+    # (center offset = md + ks//2, matching the reference's
+    # border = max_displacement + kernel_radius geometry)
+    lim_h = Hp - ks + 1 - md
+    lim_w = Wp - ks + 1 - md
+    if lim_h > md and lim_w > md:
+        out = out[:, :, md:lim_h:s1, md:lim_w:s1]
+    else:
+        out = out[:, :, ::s1, ::s1]
     return out
 
 

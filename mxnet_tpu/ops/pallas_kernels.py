@@ -103,9 +103,11 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if use_pallas is None:
+        bq, bk = min(block_q, sq), min(block_k, sk)
         use_pallas = (jax.default_backend() in ("tpu", "axon")
-                      and sq % min(block_q, sq) == 0
-                      and sk % min(block_k, sk) == 0)
+                      and d % 128 == 0        # lane-tiled head dim
+                      and bq % 8 == 0 and bk % 8 == 0  # sublane-tiled blocks
+                      and sq % bq == 0 and sk % bk == 0)
     if not use_pallas:
         return _reference_attention(q, k, v, causal, scale)
 

@@ -1,6 +1,12 @@
-"""Test configuration: force a deterministic 8-virtual-device CPU platform
-(the reference's cpu<->gpu consistency strategy maps to cpu<->tpu here; the
-driver separately dry-runs the multi-chip path — see __graft_entry__.py)."""
+"""Test configuration: request a CPU platform with 8 virtual devices.
+
+Multi-device tests do NOT rely on these env vars taking effect (platform
+plugins may pin the default backend to a real TPU regardless): they build
+meshes explicitly from `jax.devices("cpu")`, which always exposes the 8
+virtual CPU devices configured below.  Single-device tests run on whatever
+the default backend is — cpu locally, the real chip under the driver —
+matching the reference's cpu<->gpu consistency strategy (SURVEY.md §4.2).
+"""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"

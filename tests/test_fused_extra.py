@@ -121,3 +121,23 @@ def test_new_optimizer_ops_exist():
     assert np.isfinite(out.asnumpy()).all()
     out2 = mx.nd.nag_mom_update(w, g, m, lr=0.1, momentum=0.9)
     assert np.isfinite(out2.asnumpy()).all()
+
+
+def test_sharded_checkpoint_roundtrip():
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import MeshSpec, create_mesh
+    from mxnet_tpu.checkpoint import save_sharded, load_sharded
+
+    mesh = create_mesh(MeshSpec(dp=4, tp=2), devices=jax.devices("cpu")[:8])
+    w = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(mesh, P("tp", None)))
+    b = jnp.ones((8,))
+    tmp = tempfile.mkdtemp()
+    save_sharded(tmp, 3, {"w": w, "b": b}, extra={"epoch": 3})
+    params, extra = load_sharded(tmp, like={"w": w, "b": b})
+    np.testing.assert_allclose(np.asarray(params["w"]), np.asarray(w))
+    assert params["w"].sharding == w.sharding
+    assert extra == {"epoch": 3}
