@@ -59,6 +59,9 @@ class ShardedCheckpointManager:
         ocp = _orbax()
         if step is None:
             step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no checkpoint steps found under %s" % self._dir)
         kwargs = {}
         if like is not None:
             tmpl = {k: (v._h.array if hasattr(v, "_h") else v)

@@ -40,6 +40,28 @@ class _Program:
         # nodes needing RNG keys, in topo order
         self.rng_nodes = [n for n in self.order
                           if not n.is_var and get_op(n.op_name).needs_rng]
+        # init-op nodes (zeros/ones/... with a `shape` attr) whose literal
+        # shape has unknown (0) dims — e.g. RNN begin_state zeros with
+        # batch 0 — take their real shape from graph inference at bind
+        # (the reference allocates by inferred shape via PlanMemory)
+        self._shape_overrides = {}
+
+    def finalize_shapes(self, known_shapes):
+        """Resolve 0-dim init-op shapes from inference given bound arg
+        shapes ({name: shape})."""
+        needs = [n for n in self.order
+                 if not n.is_var and "shape" in get_op(n.op_name).params
+                 and n.attrs.get("shape")
+                 and any(int(d) == 0 for d in
+                         get_op(n.op_name).normalize_attrs(n.attrs)
+                         .get("shape") or ())]
+        if not needs:
+            return
+        shapes, _ = self.symbol._infer(dict(known_shapes), {})
+        for n in needs:
+            s = shapes.get((n, 0))
+            if s is not None and all(int(d) != 0 for d in s):
+                self._shape_overrides[n] = tuple(int(d) for d in s)
 
     def evaluate(self, arg_map, aux_map, keys, train, tap=None):
         """Evaluate the graph given {name: jax.Array} maps.  Returns
@@ -60,6 +82,8 @@ class _Program:
             attrs = op.normalize_attrs(node.attrs)
             if op.key_var_num_args and not attrs.get(op.key_var_num_args):
                 attrs[op.key_var_num_args] = len(node.inputs)
+            if node in self._shape_overrides:
+                attrs["shape"] = self._shape_overrides[node]
             if op.takes_train_flag:
                 attrs["_train"] = train
             ins = [env[e] for e in node.inputs]
@@ -105,6 +129,9 @@ class Executor:
         self._monitor_all = False
 
         prog = self._prog
+        known = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        known.update({n: tuple(a.shape) for n, a in self.aux_dict.items()})
+        prog.finalize_shapes(known)
         n_keys = len(prog.rng_nodes)
 
         @functools.partial(jax.jit, static_argnums=(3,))

@@ -105,6 +105,10 @@ class KVStore:
             stored = self._stored[k]
             dense = stored.todense() if hasattr(stored, "todense") else stored
             ids = np.unique(rid.asnumpy().astype(np.int64))
+            if ids.size and (ids[0] < 0 or ids[-1] >= dense.shape[0]):
+                raise MXNetError(
+                    "row_sparse_pull: row id out of range for key %r "
+                    "(%d rows)" % (k, dense.shape[0]))
             rows = dense._h.array[ids]
             if isinstance(olist, NDArray):
                 olist = [olist]

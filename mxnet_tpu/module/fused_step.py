@@ -189,6 +189,17 @@ class FusedTrainStep:
             exe.aux_dict[n]._h.array = v
         exe.outputs = [NDArray(o) for o in outs]
 
+    def transfer_to_updater(self, updater):
+        """Seed a local Updater's per-index SGD momentum from the fused
+        buffers so retiring the fused path mid-training keeps momentum."""
+        if self.mom is None or updater is None:
+            return
+        from ..ndarray import NDArray
+        for j, name in enumerate(self.param_names):
+            idx = self.param_idx[j]
+            updater.states[idx] = NDArray(self.mom[name])
+            updater.states_synced[idx] = True
+
     # -- optimizer-state checkpoint interop ---------------------------------
     def export_states(self):
         if self.mom is None:

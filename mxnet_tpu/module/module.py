@@ -371,9 +371,13 @@ class Module(BaseModule):
             batch_shapes = tuple(tuple(d.shape) for d in data_batch.data)
             bound_shapes = tuple(tuple(d.shape) for d in self._data_shapes)
             if self._fused_pending or batch_shapes != bound_shapes:
-                self.logger.info(
+                self.logger.warning(
                     "non-canonical training loop (repeated forward_backward "
-                    "or batch shape change); disabling the fused train step")
+                    "or batch shape change); disabling the fused train "
+                    "step. Note: any update already applied by a prior "
+                    "fused forward_backward stands; momentum carries over "
+                    "to the local updater.")
+                self._fused_step.transfer_to_updater(self._updater)
                 self._fused_step = None
                 self._fused_pending = False
             else:
@@ -393,9 +397,10 @@ class Module(BaseModule):
                 return
             # update() without a fused forward_backward: the caller drives
             # forward/backward explicitly — retire the fused path so there
-            # is exactly one optimizer-state store
+            # is exactly one optimizer-state store (momentum carried over)
             self.logger.info("explicit forward/backward detected; "
                              "disabling the fused train step")
+            self._fused_step.transfer_to_updater(self._updater)
             self._fused_step = None
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -453,6 +458,10 @@ class Module(BaseModule):
             obj = pickle.loads(raw)
             if isinstance(obj, dict) and obj.get("format") == "fused_v1":
                 payload = obj["states"]
+            elif isinstance(obj, dict) and obj and all(
+                    isinstance(k, str) for k in obj):
+                # legacy fused format: bare name->array momentum dict
+                payload = obj
         except Exception:
             pass
         if payload is not None:

@@ -188,8 +188,41 @@ def _jitted(op_name, frozen_attrs):
 
 
 def apply_op(op, inputs, attrs):
-    """Run an op's impl on raw jax arrays with normalized attrs. Returns tuple."""
+    """Run an op's impl on raw jax arrays with normalized attrs. Returns tuple.
+
+    Inputs on different devices are gathered onto the first input's device
+    (the reference requires same-context operands; host-staged helpers like
+    initializers legitimately mix, so the dispatch makes it well-defined
+    rather than an error)."""
     fn = _jitted(op.name, _freeze(attrs))
+    if len(inputs) > 1:
+        # only committed single-device arrays pin a device (uncommitted
+        # ones — fresh keys, scalars — follow placement; mesh-sharded
+        # arrays are left to jit's own handling); jit rejects mixed
+        # committed devices, so gather onto the first committed device.
+        # Early-exit without allocations in the universal same-device case.
+        first_dev = None
+        mixed = False
+        sharded = False
+        for a in inputs:
+            if not getattr(a, "committed", False):
+                continue
+            devs = a.devices()
+            if len(devs) != 1:
+                sharded = True  # mesh-sharded: leave placement to jit
+                break
+            d = next(iter(devs))
+            if first_dev is None:
+                first_dev = d
+            elif d != first_dev:
+                mixed = True
+        if mixed and not sharded:
+            inputs = [
+                a if not getattr(a, "committed", False)
+                or len(a.devices()) != 1
+                or next(iter(a.devices())) == first_dev
+                else jax.device_put(a, first_dev)
+                for a in inputs]
     out = fn(*inputs)
     if not isinstance(out, (tuple, list)):
         out = (out,)

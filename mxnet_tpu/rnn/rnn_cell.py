@@ -293,7 +293,11 @@ class FusedRNNCell(BaseRNNCell):
         self._forget_bias = forget_bias
         self._directions = ["l", "r"] if bidirectional else ["l"]
         self._parameter_prefix = ""
-        self._parameter = self.params.get("parameters")
+        from ..initializer import FusedRNN as _FusedRNNInit
+        self._parameter = self.params.get(
+            "parameters",
+            init=_FusedRNNInit(None, num_hidden, num_layers, mode,
+                               bidirectional, forget_bias))
 
     @property
     def state_info(self):
