@@ -19,32 +19,6 @@ import numpy as np
 import mxnet_tpu as mx
 
 
-def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
-    with open(fname) as f:
-        lines = f.readlines()
-    lines = [filter(None, i.split(" ")) for i in lines]
-    sentences, vocab = mx.rnn.encode_sentences(
-        lines, vocab=vocab, invalid_label=invalid_label,
-        start_label=start_label) if hasattr(mx.rnn, "encode_sentences") \
-        else _encode(lines, vocab, invalid_label, start_label)
-    return sentences, vocab
-
-
-def _encode(lines, vocab, invalid_label, start_label):
-    if vocab is None:
-        vocab = {}
-        idx = start_label
-    sentences = []
-    for line in lines:
-        toks = []
-        for w in line:
-            if w not in vocab:
-                vocab[w] = len(vocab) + start_label
-            toks.append(vocab[w])
-        sentences.append(toks)
-    return sentences, vocab
-
-
 def synthetic_sentences(n=2000, vocab_size=50, seed=0):
     rng = np.random.RandomState(seed)
     out = []
@@ -92,10 +66,7 @@ if __name__ == "__main__":
         buckets=buckets, invalid_label=invalid_label)
 
     stack = mx.rnn.FusedRNNCell(args.num_hidden, num_layers=args.num_layers,
-                                mode="lstm").unfuse() \
-        if False else mx.rnn.FusedRNNCell(args.num_hidden,
-                                          num_layers=args.num_layers,
-                                          mode="lstm")
+                                mode="lstm")
 
     def sym_gen(seq_len):
         data = mx.sym.Variable("data")

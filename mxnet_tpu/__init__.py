@@ -49,6 +49,14 @@ from . import test_utils
 from . import visualization
 from .visualization import plot_network
 from . import rnn
+from . import libinfo
+from . import contrib
+from . import kvstore_server
+from .kvstore_server import _init_kvstore_server_module
+
+# ref: python/mxnet/__init__.py enters the server loop at import when
+# DMLC_ROLE=server (via kvstore_server.py); same hook here.
+_init_kvstore_server_module()
 from . import image
 from . import operator
 from . import models

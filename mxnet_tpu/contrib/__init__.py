@@ -1,0 +1,8 @@
+"""Experimental contrib namespace (ref: python/mxnet/contrib/__init__.py).
+
+Op-level contrib lives in mx.nd.contrib / mx.sym.contrib; this package
+holds the non-op extras (tensorboard bridge).
+"""
+from __future__ import annotations
+
+from . import tensorboard  # noqa: F401

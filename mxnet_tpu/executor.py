@@ -62,6 +62,14 @@ class _Program:
             s = shapes.get((n, 0))
             if s is not None and all(int(d) != 0 for d in s):
                 self._shape_overrides[n] = tuple(int(d) for d in s)
+            else:
+                # fail at bind with an actionable message instead of a
+                # ZeroDivisionError deep inside the jitted graph
+                raise MXNetError(
+                    "cannot resolve unknown dims of init op %r (shape %s) "
+                    "from bound argument shapes %s; pass full shapes to "
+                    "bind/simple_bind" % (
+                        n.op_name, n.attrs.get("shape"), dict(known_shapes)))
 
     def evaluate(self, arg_map, aux_map, keys, train, tap=None):
         """Evaluate the graph given {name: jax.Array} maps.  Returns

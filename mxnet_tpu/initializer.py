@@ -332,7 +332,7 @@ class LSTMBias(Initializer):
     def _init_weight(self, name, arr):
         arr[:] = 0.0
         num_hidden = int(arr.shape[0] / 4)
-        a = arr.asnumpy()
+        a = arr.asnumpy().copy()  # asnumpy views are read-only
         a[num_hidden:2 * num_hidden] = self.forget_bias
         arr[:] = a
 

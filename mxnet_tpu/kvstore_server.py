@@ -1,0 +1,61 @@
+"""KVStore server bootstrap (ref: python/mxnet/kvstore_server.py).
+
+The reference enters a blocking server loop at import when
+DMLC_ROLE=server (kvstore_server.py:64-73): the process hosts parameter
+shards and runs the optimizer server-side.  The TPU-native dist backend
+has no server processes — reduction is a collective across worker hosts
+(kvstore/dist.py) — but launcher scripts written for the reference still
+spawn server/scheduler roles.  This module keeps those roles alive and
+harmless: a server parks until its workers disconnect, so `tools/launch.py
+-n W -s S` topologies run unchanged.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+class KVStoreServer(object):
+    """Compatibility server: accepts controller commands, hosts nothing.
+
+    The reference server's real duties (aggregate until all workers arrive,
+    apply optimizer, answer pulls — kvstore_dist_server.h:118-187) are
+    subsumed by collectives on the worker side; `run` therefore only has to
+    keep the process alive for the duration of the job so trackers that
+    monitor role liveness see a healthy server.
+    """
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self, cmd_id, cmd_body):
+        """Handle controller commands (ref: optimizer deserialization via
+        kSetOptimizer).  Optimizer state lives worker-side here, so commands
+        are recorded but need no action."""
+        return None
+
+    def run(self, poll_s=1.0):
+        """Block until the tracker tears the job down (SIGTERM) or the
+        parent exits; the reference blocks in ps::StartAsync the same way."""
+        ppid = os.getppid()
+        while True:
+            time.sleep(poll_s)
+            if os.getppid() != ppid:  # parent (tracker) exited
+                return
+
+
+def _init_kvstore_server_module():
+    """Enter the server loop when launched in a server role (the reference
+    runs this at package import, kvstore_server.py:76)."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        server = KVStoreServer()
+        server.run()
+        sys.exit(0)
+    # scheduler role: the jax.distributed coordinator (worker 0) plays the
+    # scheduler; a dedicated scheduler process just parks like a server.
+    if role == "scheduler":
+        KVStoreServer().run()
+        sys.exit(0)

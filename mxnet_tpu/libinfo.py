@@ -1,0 +1,40 @@
+"""Library information (ref: python/mxnet/libinfo.py).
+
+The reference locates libmxnet.so for the ctypes bridge; here the native
+library is the optional host-runtime .so built from src/ (io_native), and
+the "backend" is JAX/XLA, so find_lib_path returns what exists and the
+feature list reports the TPU-native capabilities.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # noqa: F401  (single source of truth)
+
+
+def find_lib_path():
+    """Return candidate paths of the native host-runtime library.
+
+    Unlike the reference (which fails hard when libmxnet.so is missing,
+    libinfo.py:50), the native .so is optional here — compute runs through
+    XLA regardless; the list may be empty.
+    """
+    curr = os.path.dirname(os.path.realpath(os.path.expanduser(__file__)))
+    candidates = [
+        os.path.join(curr, "io_native", "libmxnet_tpu_native.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]
+
+
+def features():
+    """Capability flags, the analog of the reference's USE_* build flags
+    (make/config.mk:51-171 → SURVEY.md §5.6)."""
+    import jax
+    feats = {
+        "TPU": any(d.platform == "tpu" for d in jax.devices()),
+        "NATIVE_RUNTIME": bool(find_lib_path()),
+        "DIST_KVSTORE": True,
+        "PROFILER": True,
+        "PALLAS": True,
+    }
+    return feats

@@ -456,12 +456,11 @@ class Module(BaseModule):
         payload = None
         try:
             obj = pickle.loads(raw)
+            # only the explicit format tag identifies fused states — a bare
+            # str-keyed dict is ambiguous with kvstore updater states and
+            # must fall through to the kvstore/updater restore path
             if isinstance(obj, dict) and obj.get("format") == "fused_v1":
                 payload = obj["states"]
-            elif isinstance(obj, dict) and obj and all(
-                    isinstance(k, str) for k in obj):
-                # legacy fused format: bare name->array momentum dict
-                payload = obj
         except Exception:
             pass
         if payload is not None:

@@ -71,6 +71,11 @@ for _name, _op in list(_registry.op_registry().items()):
 
 onehot_encode = _GENERATED.get("one_hot")
 
+from . import linalg  # noqa: F401,E402  (ref: ndarray/linalg.py)
+from . import contrib  # noqa: F401,E402  (ref: ndarray/contrib.py)
+from . import image  # noqa: F401,E402  (ref: ndarray/image.py)
+from . import random  # noqa: F401,E402  (ref: ndarray/random.py)
+
 
 def __getattr__(name):  # late registrations (nn/random modules import order)
     _op_tbl = _registry.op_registry()

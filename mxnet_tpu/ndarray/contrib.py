@@ -1,0 +1,9 @@
+"""mx.nd.contrib — experimental ops (ref: python/mxnet/ndarray/contrib.py;
+ops from src/operator/contrib/)."""
+from __future__ import annotations
+
+from . import _make_op_func as _maker
+from ._prefix_ns import make_getattr, populate
+
+populate(globals(), "_contrib_", _maker)
+__getattr__ = make_getattr(__name__, globals(), "_contrib_", _maker)

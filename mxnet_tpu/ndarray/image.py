@@ -1,0 +1,9 @@
+"""mx.nd.image — imperative image ops (ref: python/mxnet/ndarray/image.py;
+ops from src/operator/image/image_random-inl.h)."""
+from __future__ import annotations
+
+from . import _make_op_func as _maker
+from ._prefix_ns import make_getattr, populate
+
+populate(globals(), "_image_", _maker)
+__getattr__ = make_getattr(__name__, globals(), "_image_", _maker)

@@ -1,0 +1,10 @@
+"""mx.nd.linalg — advanced linear algebra (ref: python/mxnet/ndarray/linalg.py;
+ops from src/operator/tensor/la_op.h: gemm, gemm2, potrf, potri, trmm, trsm,
+syrk, sumlogdiag)."""
+from __future__ import annotations
+
+from . import _make_op_func as _maker
+from ._prefix_ns import make_getattr, populate
+
+populate(globals(), "_linalg_", _maker)
+__getattr__ = make_getattr(__name__, globals(), "_linalg_", _maker)

@@ -1,0 +1,225 @@
+"""Imperative image ops: the `_image_*` family.
+
+Reference: src/operator/image/image_random.cc:41-124 (to_tensor, normalize,
+deterministic/random flips, brightness/contrast/saturation/hue jitter,
+color jitter, PCA lighting).  The reference's kernels are per-pixel CPU
+loops with an OMP random engine; here each op is a pure jnp function (the
+random variants draw from the functional PRNG key the registry threads
+through `needs_rng`), so augmentation can run jitted on device — or fused
+into the input pipeline — instead of on the host.
+
+Layout convention matches the reference: images are HWC (or NHWC batched),
+`to_tensor` converts to CHW float; `normalize` operates on CHW.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, pFloat
+from ..base import str_to_attr
+
+
+def pFloatTuple(v):
+    """Float-tuple attr (mean/std/alpha) — pShape would int-truncate."""
+    if isinstance(v, str):
+        v = str_to_attr(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# Rec. 601 luma weights — same constants the reference uses for its
+# grayscale blend (image_random-inl.h RGB2Gray coefficients).
+_R, _G, _B = 0.299, 0.587, 0.114
+
+
+def _to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1] (ref: _image_to_tensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+register("_image_to_tensor", _to_tensor, num_inputs=1, input_names=["data"],
+         doc="Convert an HWC uint8/float image to CHW float32 in [0,1].")
+
+
+def _normalize(data, mean=(0.0,), std=(1.0,)):
+    """(CHW - mean) / std, per channel (ref: _image_normalize)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if data.ndim == 3:
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+register("_image_normalize", _normalize, num_inputs=1, input_names=["data"],
+         params={"mean": (pFloatTuple, (0.0,)), "std": (pFloatTuple, (1.0,))},
+         doc="Normalize a CHW image with per-channel mean/std.")
+
+
+def _flip_lr(data):
+    return jnp.flip(data, axis=-2)  # HWC / NHWC: width axis
+
+
+def _flip_tb(data):
+    return jnp.flip(data, axis=-3)  # HWC / NHWC: height axis
+
+
+register("_image_flip_left_right", _flip_lr, num_inputs=1,
+         input_names=["data"])
+register("_image_flip_top_bottom", _flip_tb, num_inputs=1,
+         input_names=["data"])
+
+
+def _coin(key, data, flipped):
+    return jnp.where(jax.random.bernoulli(key), flipped, data)
+
+
+def _random_flip_lr(key, data):
+    return _coin(key, data, _flip_lr(data))
+
+
+def _random_flip_tb(key, data):
+    return _coin(key, data, _flip_tb(data))
+
+
+register("_image_random_flip_left_right", _random_flip_lr, num_inputs=1,
+         input_names=["data"], needs_rng=True)
+register("_image_random_flip_top_bottom", _random_flip_tb, num_inputs=1,
+         input_names=["data"], needs_rng=True)
+
+
+def _random_brightness(key, data, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data * alpha
+
+
+register("_image_random_brightness", _random_brightness, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"min_factor": (pFloat, 0.0), "max_factor": (pFloat, 0.0)})
+
+
+def _gray(data):
+    """Luma of an HWC/NHWC image, broadcastable back over channels."""
+    r, g, b = data[..., 0], data[..., 1], data[..., 2]
+    return (_R * r + _G * g + _B * b)[..., None]
+
+
+def _random_contrast(key, data, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    mean_gray = jnp.mean(_gray(data))
+    return data * alpha + mean_gray * (1.0 - alpha)
+
+
+def _random_saturation(key, data, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data * alpha + _gray(data) * (1.0 - alpha)
+
+
+register("_image_random_contrast", _random_contrast, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"min_factor": (pFloat, 0.0), "max_factor": (pFloat, 0.0)})
+register("_image_random_saturation", _random_saturation, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"min_factor": (pFloat, 0.0), "max_factor": (pFloat, 0.0)})
+
+
+def _hue_rotate(data, alpha):
+    """Rotate hue by `alpha` turns via the YIQ linear approximation the
+    reference uses (image_random-inl.h RandomHue)."""
+    u = jnp.cos(alpha * jnp.pi)
+    w = jnp.sin(alpha * jnp.pi)
+    # YIQ-space rotation folded into one RGB->RGB matrix
+    t = jnp.array([[0.299, 0.587, 0.114],
+                   [0.299, 0.587, 0.114],
+                   [0.299, 0.587, 0.114]], jnp.float32) + \
+        u * jnp.array([[0.701, -0.587, -0.114],
+                       [-0.299, 0.413, -0.114],
+                       [-0.299, -0.587, 0.886]], jnp.float32) + \
+        w * jnp.array([[0.168, -0.331, 0.5],   # NTSC I/Q mixing terms
+                       [0.328, 0.035, -0.5],
+                       [-0.497, 0.296, 0.201]], jnp.float32)
+    return jnp.einsum("...c,dc->...d", data, t.astype(data.dtype))
+
+
+def _random_hue(key, data, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _hue_rotate(data, alpha)
+
+
+register("_image_random_hue", _random_hue, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"min_factor": (pFloat, 0.0), "max_factor": (pFloat, 0.0)})
+
+
+def _random_color_jitter(key, data, brightness=0.0, contrast=0.0,
+                         saturation=0.0, hue=0.0):
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (the reference shuffles the order per call)."""
+    kb, kc, ks, kh, kperm = jax.random.split(key, 5)
+
+    def do_b(x):
+        return _random_brightness(kb, x, 1 - brightness, 1 + brightness)
+
+    def do_c(x):
+        return _random_contrast(kc, x, 1 - contrast, 1 + contrast)
+
+    def do_s(x):
+        return _random_saturation(ks, x, 1 - saturation, 1 + saturation)
+
+    def do_h(x):
+        return _random_hue(kh, x, -hue, hue)
+
+    # jit-safe random order: pick one of a fixed set of permutations
+    fns = [do_b, do_c, do_s, do_h]
+    perms = [(0, 1, 2, 3), (3, 2, 1, 0), (1, 3, 0, 2), (2, 0, 3, 1)]
+    idx = jax.random.randint(kperm, (), 0, len(perms))
+    branches = []
+    for p in perms:
+        def branch(x, p=p):
+            for i in p:
+                x = fns[i](x)
+            return x
+        branches.append(branch)
+    return jax.lax.switch(idx, branches, data)
+
+
+register("_image_random_color_jitter", _random_color_jitter, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"brightness": (pFloat, 0.0), "contrast": (pFloat, 0.0),
+                 "saturation": (pFloat, 0.0), "hue": (pFloat, 0.0)})
+
+# PCA lighting constants: ImageNet eigenvalues/vectors (the same public
+# AlexNet-paper constants the reference's docs use for adjust_lighting).
+_EIGVAL = jnp.array([55.46, 4.794, 1.148], jnp.float32)
+_EIGVEC = jnp.array([[-0.5675, 0.7192, 0.4009],
+                     [-0.5808, -0.0045, -0.8140],
+                     [-0.5836, -0.6948, 0.4203]], jnp.float32)
+
+
+def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """Add PCA-based lighting noise (ref: _image_adjust_lighting)."""
+    alpha = jnp.asarray(alpha, jnp.float32)
+    delta = _EIGVEC @ (alpha * _EIGVAL)
+    return data + delta.astype(data.dtype)
+
+
+register("_image_adjust_lighting", _adjust_lighting, num_inputs=1,
+         input_names=["data"],
+         params={"alpha": (pFloatTuple, (0.0, 0.0, 0.0))})
+
+
+def _random_lighting(key, data, alpha_std=0.05):
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    delta = _EIGVEC @ (alpha * _EIGVAL)
+    return data + delta.astype(data.dtype)
+
+
+register("_image_random_lighting", _random_lighting, num_inputs=1,
+         input_names=["data"], needs_rng=True,
+         params={"alpha_std": (pFloat, 0.05)})

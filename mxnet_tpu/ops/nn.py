@@ -103,16 +103,35 @@ def _fully_connected(data, weight, *rest, num_hidden=1, no_bias=False, flatten=T
     return out
 
 
-def _fc_infer_shape(in_shapes, attrs):
+def _fc_infer_shape(in_shapes, attrs, out_shapes=None):
     num_hidden = int(attrs["num_hidden"])
     no_bias = attrs.get("no_bias", False)
     flatten = attrs.get("flatten", True)
     dshape = in_shapes[0]
     if dshape is None:
         return in_shapes, [None]
-    in_dim = int(np.prod(dshape[1:])) if (flatten or len(dshape) == 2) else dshape[-1]
     filled = list(in_shapes)
-    filled[1] = (num_hidden, in_dim)
+    # backward inference: heal unknown (0) leading data dims from a known
+    # output shape — RNN begin_state zeros (0, H) feeding h2h resolve their
+    # batch dim this way (the reference's pass is bidirectional)
+    out = out_shapes[0] if out_shapes else None
+    if out is not None and any(int(d) == 0 for d in dshape):
+        if flatten or len(dshape) == 2:
+            if int(dshape[0]) == 0 and int(out[0]) != 0:
+                dshape = (int(out[0]),) + tuple(dshape[1:])
+        elif len(out) == len(dshape):
+            dshape = tuple(int(o) if int(d) == 0 and int(o) != 0 else int(d)
+                           for d, o in zip(dshape[:-1], out[:-1])) \
+                + (dshape[-1],)
+        filled[0] = dshape
+    if flatten or len(dshape) == 2:
+        in_dim = int(np.prod(dshape[1:]))
+        unknown = any(int(d) == 0 for d in dshape[1:])
+    else:
+        in_dim = int(dshape[-1])
+        unknown = in_dim == 0  # middle dims don't affect the weight shape
+    if not unknown:
+        filled[1] = (num_hidden, in_dim)
     if not no_bias:
         filled[2] = (num_hidden,)
     oshape = (dshape[0], num_hidden) if (flatten or len(dshape) == 2) \
@@ -122,7 +141,7 @@ def _fc_infer_shape(in_shapes, attrs):
 
 register("FullyConnected", _fully_connected,
          input_names=("data", "weight", "bias"),
-         infer_shape=_fc_infer_shape,
+         infer_shape=_fc_infer_shape, bidirectional_infer=True,
          params={"num_hidden": (pInt, 1), "no_bias": (pBool, False),
                  "flatten": (pBool, True)})
 

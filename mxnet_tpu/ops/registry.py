@@ -75,7 +75,7 @@ class Op:
     def __init__(self, name, impl, params=None, num_inputs=None, num_outputs=1,
                  infer_shape=None, infer_type=None, needs_rng=False,
                  mutate_map=(), input_names=None, aux_names=(),
-                 takes_train_flag=False,
+                 takes_train_flag=False, bidirectional_infer=False,
                  key_var_num_args=None, aliases=(), doc=""):
         self.name = name
         self.impl = impl
@@ -86,6 +86,9 @@ class Op:
         self.num_outputs = num_outputs
         self.infer_shape = infer_shape
         self.infer_type = infer_type
+        # infer_shape additionally accepts current output shapes as a third
+        # argument for backward out->in inference (declared, not introspected)
+        self.bidirectional_infer = bidirectional_infer
         self.needs_rng = needs_rng
         # trailing impl outputs (beyond the visible num_outputs) rebind these
         # input indices — in-place state updates (optimizer mom, BatchNorm
@@ -204,25 +207,26 @@ def apply_op(op, inputs, attrs):
         first_dev = None
         mixed = False
         sharded = False
+        input_devs = []  # per-input single committed device (or None)
         for a in inputs:
             if not getattr(a, "committed", False):
+                input_devs.append(None)
                 continue
             devs = a.devices()
             if len(devs) != 1:
                 sharded = True  # mesh-sharded: leave placement to jit
                 break
             d = next(iter(devs))
+            input_devs.append(d)
             if first_dev is None:
                 first_dev = d
             elif d != first_dev:
                 mixed = True
         if mixed and not sharded:
             inputs = [
-                a if not getattr(a, "committed", False)
-                or len(a.devices()) != 1
-                or next(iter(a.devices())) == first_dev
+                a if d is None or d == first_dev
                 else jax.device_put(a, first_dev)
-                for a in inputs]
+                for a, d in zip(inputs, input_devs)]
     out = fn(*inputs)
     if not isinstance(out, (tuple, list)):
         out = (out,)

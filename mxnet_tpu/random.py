@@ -39,4 +39,16 @@ def current_key():
     return _get().key
 
 
+def __getattr__(name):
+    # ref: python/mxnet/random.py does `from .ndarray.random import *`;
+    # resolved lazily here to avoid a circular import at package init.
+    if not name.startswith("_"):
+        from .ndarray import random as _nd_random
+        if name in _nd_random.__all__:
+            fn = getattr(_nd_random, name)
+            globals()[name] = fn
+            return fn
+    raise AttributeError("module 'mxnet_tpu.random' has no attribute %r" % name)
+
+
 # op-level frontends (populated by ndarray namespace gen): uniform, normal, ...

@@ -1,0 +1,8 @@
+"""mx.sym.image — symbolic image ops (ref: python/mxnet/symbol/image.py)."""
+from __future__ import annotations
+
+from . import _make_sym_func as _maker
+from ..ndarray._prefix_ns import make_getattr, populate
+
+populate(globals(), "_image_", _maker)
+__getattr__ = make_getattr(__name__, globals(), "_image_", _maker)

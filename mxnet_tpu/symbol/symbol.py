@@ -462,7 +462,19 @@ class Symbol:
                 filled, out_shapes = None, None
                 if op.infer_shape is not None:
                     try:
-                        filled, out_shapes = op.infer_shape(in_shapes, attrs)
+                        # ops registered with bidirectional_infer also get
+                        # the current (possibly partial) output shapes,
+                        # enabling backward out->in inference — the
+                        # reference's fixed-point pass is bidirectional
+                        # the same way (infer_graph_attr_pass.cc)
+                        if op.bidirectional_infer:
+                            cur_outs = [shapes.get((node, i))
+                                        for i in range(n_out)]
+                            filled, out_shapes = op.infer_shape(
+                                in_shapes, attrs, cur_outs)
+                        else:
+                            filled, out_shapes = op.infer_shape(
+                                in_shapes, attrs)
                     except Exception:
                         filled = None
                 elif all(s is not None for s in in_shapes):

@@ -164,3 +164,28 @@ def test_module_bn_aux_state_sync():
     _, auxs = mod.get_params()
     assert set(auxs.keys()) == {"bn_moving_mean", "bn_moving_var"}
     assert np.abs(auxs["bn_moving_mean"].asnumpy()).sum() > 0
+
+
+def test_kvstore_optimizer_states_roundtrip(tmp_path):
+    """update_on_kvstore mode: save/load must restore the kvstore updater's
+    str-keyed state dict (regression: a heuristic misread it as a fused
+    momentum file and skipped the restore)."""
+    import os
+    net = _softmax_mlp()
+    X = np.random.RandomState(0).rand(32, 10).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    contexts = [mx.cpu(0), mx.cpu(1)]
+    mod = mx.mod.Module(net, context=contexts)
+    mod.fit(it, num_epoch=1, kvstore="device",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert mod._update_on_kvstore
+    fname = os.path.join(str(tmp_path), "opt.states")
+    mod.save_optimizer_states(fname)
+    states_before = mod._kvstore._updater.get_states()
+    mod.load_optimizer_states(fname)
+    assert mod._kvstore._updater.get_states() == states_before
+    # and the restored state is non-trivial (momentum exists after a step)
+    import pickle
+    assert pickle.loads(states_before)

@@ -1,0 +1,148 @@
+"""Sub-namespace parity: nd/sym.{linalg,random,contrib,image}, libinfo,
+contrib.tensorboard, kvstore_server (ref: python/mxnet/ndarray/{linalg,
+random,contrib,image}.py, symbol twins, kvstore_server.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_nd_linalg_namespace():
+    a = mx.nd.array(np.eye(3, dtype=np.float32) * 4)
+    L = mx.nd.linalg.potrf(a)
+    assert np.allclose(L.asnumpy(), np.eye(3) * 2)
+    b = mx.nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    g = mx.nd.linalg.gemm2(b, b, transpose_b=True)
+    assert np.allclose(g.asnumpy(), b.asnumpy() @ b.asnumpy().T, atol=1e-5)
+
+
+def test_nd_random_namespace():
+    mx.random.seed(7)
+    u = mx.nd.random.uniform(1.0, 2.0, shape=(50,))
+    un = u.asnumpy()
+    assert un.min() >= 1.0 and un.max() < 2.0
+    n = mx.nd.random.normal(0.0, 1.0, shape=(10, 10))
+    assert n.shape == (10, 10)
+    # tensor-parameter dispatch (ref _sample_* path)
+    nt = mx.nd.random.normal(mx.nd.zeros((3,)), mx.nd.ones((3,)), shape=(4,))
+    assert nt.shape == (3, 4)
+    r = mx.nd.random.randint(0, 5, shape=(100,))
+    rn = r.asnumpy()
+    assert rn.min() >= 0 and rn.max() < 5
+    p = mx.nd.random.poisson(3.0, shape=(8,))
+    assert p.shape == (8,)
+    e = mx.nd.random.exponential(2.0, shape=(8,))
+    assert (e.asnumpy() >= 0).all()
+    m = mx.nd.random.multinomial(mx.nd.array([[0.0, 1.0], [1.0, 0.0]]))
+    assert list(m.asnumpy()) == [1, 0]
+    s = mx.nd.random.shuffle(mx.nd.arange(10))
+    assert sorted(s.asnumpy().tolist()) == list(range(10))
+
+
+def test_mx_random_reexport():
+    # ref: python/mxnet/random.py does `from .ndarray.random import *`
+    mx.random.seed(3)
+    a = mx.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(3)
+    b = mx.random.uniform(shape=(4,)).asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_nd_contrib_namespace():
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 8).astype(np.float32))
+    f = mx.nd.contrib.fft(x)
+    assert f.shape == (2, 16)
+    # ref ifft is unnormalized (cuFFT semantics): divide by N to roundtrip
+    back = mx.nd.contrib.ifft(f) / 8
+    assert np.allclose(back.asnumpy(), x.asnumpy(), atol=1e-4)
+
+
+def test_nd_image_ops():
+    img = mx.nd.array(np.random.RandomState(0).randint(
+        0, 255, (4, 6, 3)).astype(np.uint8))
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 4, 6)
+    assert t.asnumpy().max() <= 1.0
+    norm = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    assert norm.shape == (3, 4, 6)
+    assert np.allclose(norm.asnumpy(), (t.asnumpy() - 0.5) / 0.2, atol=1e-5)
+    fimg = img.astype("float32")
+    f = mx.nd.image.flip_left_right(fimg)
+    assert np.allclose(f.asnumpy(), fimg.asnumpy()[:, ::-1])
+    f2 = mx.nd.image.flip_top_bottom(fimg)
+    assert np.allclose(f2.asnumpy(), fimg.asnumpy()[::-1])
+    # random aug ops execute and preserve shape
+    for fn, args in [
+        (mx.nd.image.random_flip_left_right, ()),
+        (mx.nd.image.random_brightness, (0.5, 1.5)),
+        (mx.nd.image.random_contrast, (0.5, 1.5)),
+        (mx.nd.image.random_saturation, (0.5, 1.5)),
+        (mx.nd.image.random_hue, (-0.1, 0.1)),
+        (mx.nd.image.random_lighting, ()),
+    ]:
+        out = fn(fimg, *args)
+        assert out.shape == fimg.shape
+    cj = mx.nd.image.random_color_jitter(fimg, 0.1, 0.1, 0.1, 0.1)
+    assert cj.shape == fimg.shape
+    # fractional alpha must actually shift pixels (pShape would truncate to 0)
+    lit = mx.nd.image.adjust_lighting(fimg, alpha=(0.9, 0.9, 0.9))
+    assert not np.allclose(lit.asnumpy(), fimg.asnumpy())
+
+
+def test_random_mixed_params_rejected():
+    with pytest.raises(ValueError):
+        mx.nd.random.normal(mx.nd.zeros((3,)), 1.0)
+    with pytest.raises(ValueError):
+        mx.sym.random.uniform(mx.sym.var("lo"), 1.0)
+
+
+def test_sym_namespaces():
+    x = mx.sym.var("x")
+    y = mx.sym.linalg.gemm2(x, x, transpose_b=True)
+    data = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    exe = y.bind(mx.cpu(), {"x": mx.nd.array(data)})
+    out = exe.forward()[0].asnumpy()
+    assert np.allclose(out, data @ data.T, atol=1e-5)
+
+    r = mx.sym.random.uniform(shape=(3, 3))
+    exe = r.bind(mx.cpu(), {})
+    out = exe.forward()[0]
+    assert out.shape == (3, 3)
+
+    img = mx.sym.var("img")
+    t = mx.sym.image.to_tensor(img)
+    exe = t.bind(mx.cpu(), {"img": mx.nd.ones((4, 4, 3))})
+    assert exe.forward()[0].shape == (3, 4, 4)
+
+
+def test_libinfo():
+    assert mx.libinfo.__version__
+    feats = mx.libinfo.features()
+    assert feats["DIST_KVSTORE"] and feats["PALLAS"]
+    assert isinstance(mx.libinfo.find_lib_path(), list)
+
+
+def test_tensorboard_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    cb = LogMetricsCallback(str(tmp_path), prefix="train")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0, 1])],
+                  [mx.nd.array([[0.9, 0.1], [0.1, 0.9]])])
+
+    class Param:
+        eval_metric = metric
+
+    cb(Param())
+    cb(Param())
+    assert cb.step == 2
+
+
+def test_kvstore_server_roles(monkeypatch):
+    from mxnet_tpu import kvstore_server
+
+    # worker role: bootstrap is a no-op
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    kvstore_server._init_kvstore_server_module()
+    srv = kvstore_server.KVStoreServer()
+    assert srv._controller(0, "") is None

@@ -73,10 +73,11 @@ def test_fused_rnn_shapes_bidirectional():
 def test_gluon_lstm_layer_trains():
     from mxnet_tpu import autograd
     from mxnet_tpu.gluon import rnn, Trainer
+    mx.random.seed(11)
     net = rnn.LSTM(8, num_layers=1)
     net.initialize()
-    x = mx.nd.array(np.random.rand(6, 4, 5).astype(np.float32))
-    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    x = mx.nd.array(np.random.RandomState(11).rand(6, 4, 5).astype(np.float32))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
     losses = []
     for _ in range(5):
         with autograd.record():
@@ -151,3 +152,22 @@ def test_bucket_sentence_iter():
         keys.add(batch.bucket_key)
         n += 1
     assert n >= 3
+
+
+def test_legacy_cell_unroll_simple_bind():
+    """Legacy symbolic unroll: begin_state zeros (batch 0) must resolve
+    through bidirectional shape inference at bind (regression: the h-state
+    zeros feeding h2h FullyConnected previously stayed (0, H) and crashed
+    the jitted forward)."""
+    cell = mx.rnn.LSTMCell(10)
+    out, _ = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    exe = out.simple_bind(mx.cpu(), data=(4, 3, 8))
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = rng.normal(0, 0.1, v.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.rand(4, 3, 8).astype(np.float32)
+    o = exe.forward()[0]
+    assert o.shape == (4, 3, 10)
+    assert np.isfinite(o.asnumpy()).all()
