@@ -15,9 +15,20 @@ import mxnet_tpu as mx
 
 
 def get_symbol(network, num_layers, image_shape):
+    from mxnet_tpu import models
     if network == "resnet":
-        from mxnet_tpu.models import resnet
-        return resnet.get_symbol(1000, num_layers, image_shape)
+        return models.resnet.get_symbol(1000, num_layers, image_shape)
+    if network == "alexnet":
+        return models.alexnet.get_symbol(1000)
+    if network == "vgg":
+        # the CLI's num_layers default (50) is resnet-oriented; fall back
+        # to the benchmark's VGG-16 unless a valid VGG depth was given
+        depth = num_layers if num_layers in (11, 13, 16, 19) else 16
+        return models.vgg.get_symbol(1000, num_layers=depth)
+    if network in ("inception-bn", "inception_bn"):
+        return models.inception_bn.get_symbol(1000)
+    if network in ("inception-v3", "inception_v3"):
+        return models.inception_v3.get_symbol(1000)  # use 3,299,299 input
     # gluon zoo models: compose into a Symbol for the bind path
     from mxnet_tpu.gluon.model_zoo import vision
     net = vision.get_model(network)

@@ -6,5 +6,9 @@ scripts (train_mnist.py / train_imagenet.py style) work unchanged.
 from . import resnet  # noqa: F401
 from . import lenet  # noqa: F401
 from . import mlp  # noqa: F401
+from . import alexnet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import inception_bn  # noqa: F401
+from . import inception_v3  # noqa: F401
 
 get_symbol = resnet.get_symbol

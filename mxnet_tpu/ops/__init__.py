@@ -12,3 +12,4 @@ from . import rnn_op  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import extra  # noqa: F401
 from . import image_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

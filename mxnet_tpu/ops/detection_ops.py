@@ -1,0 +1,467 @@
+"""Detection-era contrib ops: proposals, PSROI pooling, deformable conv,
+count_sketch.
+
+Reference: src/operator/contrib/{proposal,multi_proposal,psroi_pooling,
+deformable_convolution,deformable_psroi_pooling,count_sketch}.cc — hand
+CUDA kernels there.  TPU translation notes:
+- proposal NMS runs as a fixed-trip lax.fori_loop with a vectorized
+  suppression row per step (no dynamic shapes; scores of dropped boxes are
+  masked to -inf instead of compacting the tensor).
+- deformable conv is bilinear-sampled im2col followed by one big matmul,
+  so the FLOPs land on the MXU; the gathers are XLA gathers.
+- PSROI pooling variants are masked-mean / bilinear-sample reductions
+  vmapped over ROIs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, pInt, pFloat, pBool, pShape, pFloatTuple
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (ref: count_sketch-inl.h — hashed random projection)
+# ---------------------------------------------------------------------------
+
+def _count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros(data.shape[:-1] + (int(out_dim),), data.dtype)
+    return out.at[..., idx].add(data * sign)
+
+
+register("_contrib_count_sketch", _count_sketch,
+         input_names=("data", "h", "s"),
+         params={"out_dim": (pInt, 1),
+                 "processing_batch_size": (pInt, 32)},
+         doc="Count-sketch random projection (hash h, signs s).")
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (ref: proposal-inl.h, multi_proposal-inl.h)
+# ---------------------------------------------------------------------------
+
+def _gen_anchors(base_size, scales, ratios):
+    """Standard RPN anchor enumeration (ratios then scales)."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for sc in scales:
+            aw, ah = ws * sc, hs * sc
+            anchors.append([cx - 0.5 * (aw - 1), cy - 0.5 * (ah - 1),
+                            cx + 0.5 * (aw - 1), cy + 0.5 * (ah - 1)])
+    return np.array(anchors, np.float32)  # (A, 4)
+
+
+def _bbox_decode(anchors, deltas):
+    """Apply (dx,dy,dw,dh) deltas to anchor boxes."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1.0)
+    cy = anchors[:, 1] + 0.5 * (h - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(dw) * w
+    ph = jnp.exp(dh) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], axis=1)
+
+
+def _box_ious(box, boxes):
+    """IOU of one box vs a set (vectorized row for the NMS loop)."""
+    ix1 = jnp.maximum(box[0], boxes[:, 0])
+    iy1 = jnp.maximum(box[1], boxes[:, 1])
+    ix2 = jnp.minimum(box[2], boxes[:, 2])
+    iy2 = jnp.minimum(box[3], boxes[:, 3])
+    iw = jnp.maximum(0.0, ix2 - ix1 + 1.0)
+    ih = jnp.maximum(0.0, iy2 - iy1 + 1.0)
+    inter = iw * ih
+    a1 = (box[2] - box[0] + 1.0) * (box[3] - box[1] + 1.0)
+    a2 = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+    return inter / (a1 + a2 - inter)
+
+
+def _nms_keep(boxes, scores, thresh):
+    """Greedy NMS over score-sorted boxes; returns keep mask (sorted order)."""
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        ious = _box_ious(boxes[i], boxes)
+        # suppress lower-scored (later) boxes overlapping box i, if box i kept
+        drop = (ious > thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~drop
+
+    return lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def _proposal_single(scores, deltas, im_info, anchors, feature_stride,
+                     pre_nms, post_nms, thresh, min_size, output_score):
+    """One image.  scores (A,H,W) fg scores; deltas (4A,H,W)."""
+    A = anchors.shape[0]
+    H, W = scores.shape[-2:]
+    # full anchor field (H, W, A, 4)
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)            # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)      # (H, W, 4)
+    all_anchors = anchors[None, None] + shifts[:, :, None]   # (H,W,A,4)
+    all_anchors = all_anchors.reshape(-1, 4)
+    flat_scores = scores.transpose(1, 2, 0).reshape(-1)       # (H*W*A,)
+    flat_deltas = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+
+    boxes = _bbox_decode(all_anchors, flat_deltas)
+    # clip to image
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0, im_info[1] - 1.0),
+        jnp.clip(boxes[:, 1], 0, im_info[0] - 1.0),
+        jnp.clip(boxes[:, 2], 0, im_info[1] - 1.0),
+        jnp.clip(boxes[:, 3], 0, im_info[0] - 1.0)], axis=1)
+    # min-size filter (scaled by im scale like the reference)
+    ms = min_size * im_info[2]
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    valid = (ws >= ms) & (hs >= ms)
+    flat_scores = jnp.where(valid, flat_scores, -jnp.inf)
+
+    pre_nms = min(int(pre_nms), boxes.shape[0])
+    top_scores, order = lax.top_k(flat_scores, pre_nms)
+    top_boxes = boxes[order]
+    keep = _nms_keep(top_boxes, top_scores, thresh)
+    keep = keep & jnp.isfinite(top_scores)
+    # stable gather of kept boxes into post_nms slots; kept boxes ranked
+    # beyond post_nms scatter into a discard slot so they can't clobber
+    # slot post_nms-1
+    kept_rank = jnp.cumsum(keep) - 1                    # rank among kept
+    slot_src = jnp.full((post_nms + 1,), -1, jnp.int32)
+    idxs = jnp.arange(pre_nms)
+    slot_idx = jnp.where(keep & (kept_rank < post_nms), kept_rank, post_nms)
+    slot_src = slot_src.at[slot_idx].max(
+        jnp.where(keep, idxs, -1).astype(jnp.int32))[:post_nms]
+    n_kept = jnp.minimum(jnp.sum(keep), post_nms)
+    # slots beyond n_kept: repeat the last kept slot so the output stays
+    # score-sorted (the reference pads with sampled boxes)
+    last = jnp.clip(n_kept - 1, 0, post_nms - 1)
+    slot_src = jnp.where(jnp.arange(post_nms) < n_kept, slot_src,
+                         slot_src[last])
+    out_boxes = top_boxes[slot_src]
+    out_scores = top_scores[slot_src]
+    return out_boxes, out_scores
+
+
+def _proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
+              ratios=(0.5, 1, 2), feature_stride=16,
+              rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+              threshold=0.7, rpn_min_size=16, output_score=False,
+              iou_loss=False):
+    if iou_loss:
+        raise NotImplementedError(
+            "Proposal iou_loss=True (corner-correction decode) is not "
+            "implemented; boxes would be silently wrong")
+    anchors = jnp.asarray(_gen_anchors(feature_stride, scales, ratios))
+    A = anchors.shape[0]
+    scores = cls_prob[0, A:]          # fg scores (A, H, W)
+    boxes, bscores = _proposal_single(
+        scores, bbox_pred[0], im_info[0], anchors, float(feature_stride),
+        rpn_pre_nms_top_n, int(rpn_post_nms_top_n), float(threshold),
+        float(rpn_min_size), output_score)
+    rois = jnp.concatenate(
+        [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+    if output_score:
+        return rois, bscores[:, None]
+    return rois
+
+
+def _prop_nout(attrs):
+    return 2 if attrs.get("output_score") else 1
+
+
+_PROP_PARAMS = {
+    "scales": (pFloatTuple, (4, 8, 16, 32)),
+    "ratios": (pFloatTuple, (0.5, 1, 2)),
+    "feature_stride": (pInt, 16), "rpn_pre_nms_top_n": (pInt, 6000),
+    "rpn_post_nms_top_n": (pInt, 300), "threshold": (pFloat, 0.7),
+    "rpn_min_size": (pInt, 16), "output_score": (pBool, False),
+    "iou_loss": (pBool, False),
+}
+
+register("_contrib_Proposal", _proposal,
+         input_names=("cls_prob", "bbox_pred", "im_info"),
+         num_outputs=_prop_nout, params=_PROP_PARAMS,
+         aliases=("Proposal",),
+         doc="RPN proposal generation (anchors + bbox decode + NMS).")
+
+
+def _multi_proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
+                    ratios=(0.5, 1, 2), feature_stride=16,
+                    rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+                    threshold=0.7, rpn_min_size=16, output_score=False,
+                    iou_loss=False):
+    if iou_loss:
+        raise NotImplementedError(
+            "MultiProposal iou_loss=True (corner-correction decode) is not "
+            "implemented; boxes would be silently wrong")
+    anchors = jnp.asarray(_gen_anchors(feature_stride, scales, ratios))
+    A = anchors.shape[0]
+
+    def one(scores, deltas, info):
+        return _proposal_single(
+            scores, deltas, info, anchors, float(feature_stride),
+            rpn_pre_nms_top_n, int(rpn_post_nms_top_n), float(threshold),
+            float(rpn_min_size), output_score)
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, A:], bbox_pred, im_info)
+    N, P = boxes.shape[:2]
+    batch_ids = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), P)[:, None]
+    rois = jnp.concatenate([batch_ids, boxes.reshape(N * P, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(N * P, 1)
+    return rois
+
+
+register("_contrib_MultiProposal", _multi_proposal,
+         input_names=("cls_prob", "bbox_pred", "im_info"),
+         num_outputs=_prop_nout, params=_PROP_PARAMS,
+         aliases=("MultiProposal",),
+         doc="Batched RPN proposal generation.")
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (ref: psroi_pooling-inl.h — position-sensitive ROI pooling)
+# ---------------------------------------------------------------------------
+
+def _psroi_pool(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=1,
+                group_size=0):
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    C = int(output_dim)
+    N, _, H, W = data.shape
+    rows = jnp.arange(H, dtype=jnp.float32)
+    cols = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds roi corners then scales
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / p
+        bin_w = rw / p
+        img = data[b]                                     # (C*g*g, H, W)
+
+        def one_cell(ph, pw):
+            hstart = jnp.floor(y1 + ph * bin_h)
+            wstart = jnp.floor(x1 + pw * bin_w)
+            hend = jnp.ceil(y1 + (ph + 1) * bin_h)
+            wend = jnp.ceil(x1 + (pw + 1) * bin_w)
+            hstart = jnp.clip(hstart, 0, H)
+            hend = jnp.clip(hend, 0, H)
+            wstart = jnp.clip(wstart, 0, W)
+            wend = jnp.clip(wend, 0, W)
+            rmask = (rows >= hstart) & (rows < hend)
+            cmask = (cols >= wstart) & (cols < wend)
+            mask = rmask[:, None] & cmask[None, :]
+            area = jnp.maximum(jnp.sum(mask), 1)
+            # position-sensitive channel block for this cell
+            gh = jnp.clip((ph * g) // p, 0, g - 1)
+            gw = jnp.clip((pw * g) // p, 0, g - 1)
+            chans = jnp.arange(C) * g * g + gh * g + gw
+            block = img[chans]                            # (C, H, W)
+            s = jnp.sum(block * mask[None], axis=(1, 2))
+            empty = (hend <= hstart) | (wend <= wstart)
+            return jnp.where(empty, 0.0, s / area)
+
+        cells = jnp.stack([
+            jnp.stack([one_cell(ph, pw) for pw in range(p)], axis=-1)
+            for ph in range(p)], axis=-2)                 # (C, p, p)
+        return cells
+
+    return jax.vmap(one_roi)(rois)
+
+
+register("_contrib_PSROIPooling", _psroi_pool,
+         input_names=("data", "rois"),
+         params={"spatial_scale": (pFloat, 1.0), "output_dim": (pInt, 1),
+                 "pooled_size": (pInt, 1), "group_size": (pInt, 0)},
+         aliases=("PSROIPooling",),
+         doc="Position-sensitive ROI pooling (R-FCN).")
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (ref: deformable_convolution-inl.h)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, y, x):
+    """img (C, H, W); y/x arbitrary same-shaped float grids -> (C, *y.shape).
+    Zero padding outside."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    flat = img.reshape(C, H * W)
+
+    def tap(yy, xx):
+        ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = flat[:, (yi * W + xi).reshape(-1)].reshape((C,) + yy.shape)
+        return v * ok.astype(img.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    return (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+            + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+
+
+def _deformable_convolution(data, offset, weight, *rest, kernel=(1, 1),
+                            stride=None, dilate=None, pad=None, num_filter=1,
+                            num_group=1, num_deformable_group=1, no_bias=False,
+                            workspace=1024, layout=None):
+    kh, kw = int(kernel[0]), int(kernel[1])
+    stride = stride or (1, 1)
+    dilate = dilate or (1, 1)
+    pad = pad or (0, 0)
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    N, C, H, W = data.shape
+    F = int(num_filter)
+    G = int(num_group)
+    DG = int(num_deformable_group)
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None, None, None] + \
+        (jnp.arange(kh) * dh)[None, None, :, None]        # (Ho,1,kh,1)
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :, None, None] + \
+        (jnp.arange(kw) * dw)[None, None, None, :]        # (1,Wo,1,kw)
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(jnp.float32)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(jnp.float32)
+
+    def one_image(img, off):
+        # off (2*DG*kh*kw, Ho, Wo) -> (DG, kh, kw, 2, Ho, Wo)
+        off = off.reshape(DG, kh * kw, 2, Ho, Wo)
+        off_y = off[:, :, 0].reshape(DG, kh, kw, Ho, Wo)
+        off_x = off[:, :, 1].reshape(DG, kh, kw, Ho, Wo)
+
+        cols = []
+        cpg = C // DG                                     # channels per dg
+        for dg in range(DG):
+            y = base_y.transpose(2, 3, 0, 1) + off_y[dg]  # (kh,kw,Ho,Wo)
+            x = base_x.transpose(2, 3, 0, 1) + off_x[dg]
+            sub = img[dg * cpg:(dg + 1) * cpg]
+            cols.append(_bilinear_gather(sub, y, x))      # (cpg,kh,kw,Ho,Wo)
+        return jnp.concatenate(cols, axis=0)              # (C,kh,kw,Ho,Wo)
+
+    col = jax.vmap(one_image)(data, offset)               # (N,C,kh,kw,Ho,Wo)
+    pt = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
+    cg = C // G
+    fg = F // G
+    colg = col.reshape(N, G, cg, kh, kw, Ho, Wo)
+    wg = weight.reshape(G, fg, cg, kh, kw)
+    out = jnp.einsum("ngcijhw,gfcij->ngfhw", colg, wg,
+                     preferred_element_type=pt)
+    out = out.reshape(N, F, Ho, Wo)
+    if pt:
+        out = out.astype(data.dtype)
+    if not no_bias:
+        out = out + rest[0].reshape(1, F, 1, 1)
+    return out
+
+
+register("_contrib_DeformableConvolution", _deformable_convolution,
+         input_names=("data", "offset", "weight", "bias"),
+         params={"kernel": (pShape, (1, 1)), "stride": (pShape, None),
+                 "dilate": (pShape, None), "pad": (pShape, None),
+                 "num_filter": (pInt, 1), "num_group": (pInt, 1),
+                 "num_deformable_group": (pInt, 1), "no_bias": (pBool, False),
+                 "workspace": (pInt, 1024), "layout": (lambda v: v, None)},
+         aliases=("DeformableConvolution",),
+         doc="Deformable convolution v1: bilinear-sampled im2col + matmul.")
+
+
+# ---------------------------------------------------------------------------
+# Deformable PSROI pooling (ref: deformable_psroi_pooling-inl.h)
+# ---------------------------------------------------------------------------
+
+def _deformable_psroi_pool(data, rois, *trans_opt, spatial_scale=1.0,
+                           output_dim=1, group_size=1, pooled_size=1,
+                           part_size=0, sample_per_part=1, trans_std=0.0,
+                           no_trans=False):
+    g = int(group_size)
+    p = int(pooled_size)
+    part = int(part_size) or p
+    sp = int(sample_per_part)
+    C = int(output_dim)
+    N, _, H, W = data.shape
+    trans = None if (no_trans or not trans_opt) else trans_opt[0]
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / p
+        bin_w = rw / p
+        sub_h = bin_h / sp
+        sub_w = bin_w / sp
+        img = data[b]
+
+        def one_cell(ph, pw):
+            # learned offset for this bin (class-agnostic: trans chan 0/1)
+            if tr is None:
+                oy = ox = jnp.float32(0)
+            else:
+                pph = jnp.clip((ph * part) // p, 0, part - 1)
+                ppw = jnp.clip((pw * part) // p, 0, part - 1)
+                oy = tr[0, pph, ppw] * trans_std * rh
+                ox = tr[1, pph, ppw] * trans_std * rw
+            ys = y1 + ph * bin_h + oy + (jnp.arange(sp) + 0.5) * sub_h
+            xs = x1 + pw * bin_w + ox + (jnp.arange(sp) + 0.5) * sub_w
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            gh = jnp.clip((ph * g) // p, 0, g - 1)
+            gw = jnp.clip((pw * g) // p, 0, g - 1)
+            chans = jnp.arange(C) * g * g + gh * g + gw
+            block = img[chans]
+            vals = _bilinear_gather(block, yy, xx)        # (C, sp, sp)
+            return jnp.mean(vals, axis=(1, 2))
+
+        return jnp.stack([
+            jnp.stack([one_cell(ph, pw) for pw in range(p)], axis=-1)
+            for ph in range(p)], axis=-2)                 # (C, p, p)
+
+    if trans is None:
+        return jax.vmap(lambda r: one_roi(r, None))(rois)
+    # trans (R, 2*num_cls, part, part); class-agnostic pooling uses cls 0
+    tr = trans[:, :2]
+    return jax.vmap(one_roi)(rois, tr)
+
+
+register("_contrib_DeformablePSROIPooling", _deformable_psroi_pool,
+         input_names=("data", "rois", "trans"),
+         params={"spatial_scale": (pFloat, 1.0), "output_dim": (pInt, 1),
+                 "group_size": (pInt, 1), "pooled_size": (pInt, 1),
+                 "part_size": (pInt, 0), "sample_per_part": (pInt, 1),
+                 "trans_std": (pFloat, 0.0), "no_trans": (pBool, False)},
+         aliases=("DeformablePSROIPooling",),
+         doc="Deformable position-sensitive ROI pooling (sampled bins with "
+             "learned offsets).")

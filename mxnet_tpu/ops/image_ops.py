@@ -16,18 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register, pFloat
-from ..base import str_to_attr
-
-
-def pFloatTuple(v):
-    """Float-tuple attr (mean/std/alpha) — pShape would int-truncate."""
-    if isinstance(v, str):
-        v = str_to_attr(v)
-    if isinstance(v, (int, float)):
-        return (float(v),)
-    return tuple(float(x) for x in v)
-
+from .registry import register, pFloat, pFloatTuple
 
 # Rec. 601 luma weights — same constants the reference uses for its
 # grayscale blend (image_random-inl.h RGB2Gray coefficients).

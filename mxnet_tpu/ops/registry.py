@@ -57,6 +57,16 @@ def pAny(v):
     return str_to_attr(v) if isinstance(v, str) else v
 
 
+def pFloatTuple(v):
+    """Float-tuple attr (means/stds/scales/ratios) — pShape would
+    int-truncate fractional entries."""
+    if isinstance(v, str):
+        v = str_to_attr(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
 class Op:
     """A registered operator.
 

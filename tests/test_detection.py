@@ -1,0 +1,195 @@
+"""Detection-era contrib ops (ref: src/operator/contrib/{proposal,
+psroi_pooling,deformable_convolution,deformable_psroi_pooling,
+count_sketch}.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_count_sketch_matches_naive():
+    rng = np.random.RandomState(0)
+    N, D, K = 3, 10, 6
+    data = rng.rand(N, D).astype(np.float32)
+    h = rng.randint(0, K, (1, D)).astype(np.float32)
+    s = (rng.randint(0, 2, (1, D)) * 2 - 1).astype(np.float32)
+    out = mx.nd.contrib.count_sketch(
+        mx.nd.array(data), mx.nd.array(h), mx.nd.array(s), out_dim=K)
+    ref = np.zeros((N, K), np.float32)
+    for i in range(D):
+        ref[:, int(h[0, i])] += s[0, i] * data[:, i]
+    assert np.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def _proposal_inputs(rng, N=1, A=3, H=4, W=4):
+    # A anchors = 1 scale x 3 ratios
+    cls_prob = rng.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.rand(N, 4 * A, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.tile(np.array([[64.0, 64.0, 1.0]], np.float32), (N, 1))
+    return cls_prob, bbox_pred, im_info
+
+
+def test_proposal_basic():
+    rng = np.random.RandomState(0)
+    cls_prob, bbox_pred, im_info = _proposal_inputs(rng)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:, 0] == 0).all()                      # batch index
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 63).all()   # clipped
+    assert (r[:, 2] >= 0).all() and (r[:, 4] <= 63).all()
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+def test_proposal_output_score_sorted():
+    rng = np.random.RandomState(1)
+    cls_prob, bbox_pred, im_info = _proposal_inputs(rng)
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=6, threshold=0.7,
+        rpn_min_size=4, output_score=True)
+    s = scores.asnumpy().ravel()
+    assert (np.diff(s) <= 1e-6).all()                # descending scores
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(2)
+    cls_prob, bbox_pred, im_info = _proposal_inputs(rng, N=2)
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=5, threshold=0.7,
+        rpn_min_size=4)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:5, 0] == 0).all() and (r[5:, 0] == 1).all()
+
+
+def test_psroi_pooling_constant():
+    # constant feature map -> every pooled cell equals that constant
+    C, g, p = 2, 2, 2
+    data = np.full((1, C * g * g, 8, 8), 3.5, np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=1.0, output_dim=C, pooled_size=p, group_size=g)
+    assert out.shape == (1, C, p, p)
+    assert np.allclose(out.asnumpy(), 3.5, atol=1e-5)
+
+
+def test_psroi_pooling_position_sensitive():
+    # each position-sensitive channel filled with its own value: output cell
+    # (i,j) of class c must read channel c*g*g + i*g + j
+    C, g = 1, 2
+    data = np.zeros((1, C * g * g, 4, 4), np.float32)
+    for k in range(g * g):
+        data[0, k] = k + 1
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=1.0, output_dim=C, pooled_size=g, group_size=g)
+    assert np.allclose(out.asnumpy()[0, 0], [[1, 2], [3, 4]], atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    N, C, H, W, F = 2, 3, 6, 6, 4
+    kh = kw = 3
+    data = rng.rand(N, C, H, W).astype(np.float32)
+    weight = rng.rand(F, C, kh, kw).astype(np.float32) * 0.1
+    bias = rng.rand(F).astype(np.float32)
+    Ho = Wo = 6  # pad 1 stride 1
+    offset = np.zeros((N, 2 * kh * kw, Ho, Wo), np.float32)
+    out_def = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(offset), mx.nd.array(weight),
+        mx.nd.array(bias), kernel=(3, 3), pad=(1, 1), num_filter=F)
+    out_ref = mx.nd.Convolution(
+        mx.nd.array(data), mx.nd.array(weight), mx.nd.array(bias),
+        kernel=(3, 3), pad=(1, 1), num_filter=F)
+    assert np.allclose(out_def.asnumpy(), out_ref.asnumpy(), atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    # offset of exactly (0, +1) on every tap == convolving data shifted left
+    rng = np.random.RandomState(3)
+    N, C, H, W, F = 1, 2, 5, 5, 2
+    data = rng.rand(N, C, H, W).astype(np.float32)
+    weight = rng.rand(F, C, 1, 1).astype(np.float32)
+    offset = np.zeros((N, 2, H, W), np.float32)
+    offset[:, 1] = 1.0                               # x offset +1
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(offset), mx.nd.array(weight),
+        kernel=(1, 1), num_filter=F, no_bias=True)
+    shifted = np.zeros_like(data)
+    shifted[:, :, :, :-1] = data[:, :, :, 1:]        # sample at x+1
+    ref = np.einsum("nchw,fc->nfhw", shifted, weight[:, :, 0, 0])
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_deformable_psroi_pooling_constant():
+    C, g, p = 2, 2, 2
+    data = np.full((1, C * g * g, 8, 8), 2.25, np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=1.0, output_dim=C, group_size=g, pooled_size=p,
+        sample_per_part=2, no_trans=True)
+    assert out.shape == (1, C, p, p)
+    assert np.allclose(out.asnumpy(), 2.25, atol=1e-4)
+
+
+def test_deformable_psroi_pooling_trans_shifts():
+    # a large learned offset moves the sampled bin into a different region
+    C, g, p = 1, 1, 1
+    data = np.zeros((1, 1, 8, 8), np.float32)
+    data[0, 0, :, 4:] = 1.0                          # right half ones
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)   # left half roi
+    no_shift = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=1.0, output_dim=C, group_size=g, pooled_size=p,
+        sample_per_part=2, no_trans=True)
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    trans[0, 1, 0, 0] = 1.0                          # x shift = rw*trans_std
+    shifted = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=C, group_size=g, pooled_size=p,
+        sample_per_part=2, trans_std=1.0)
+    assert no_shift.asnumpy().max() < 0.5
+    assert shifted.asnumpy().max() > no_shift.asnumpy().max()
+
+
+def test_proposal_more_kept_than_post_nms():
+    """When NMS keeps more boxes than post_nms slots, output must be the
+    top-post_nms kept set in score order (regression: the last slot used to
+    receive the globally worst survivor)."""
+    rng = np.random.RandomState(4)
+    # near-zero deltas + spread anchors => essentially no NMS suppression
+    cls_prob, bbox_pred, im_info = _proposal_inputs(rng, A=1, H=6, W=6)
+    bbox_pred *= 0.0
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        scales=(1,), ratios=(1,), feature_stride=16,
+        rpn_pre_nms_top_n=36, rpn_post_nms_top_n=4, threshold=0.99,
+        rpn_min_size=0, output_score=True)
+    s = scores.asnumpy().ravel()
+    assert (np.diff(s) <= 1e-6).all()
+    # the 4 scores must be the 4 best foreground scores overall
+    A = 1
+    fg = cls_prob[0, A:].transpose(1, 2, 0).ravel()
+    top4 = np.sort(fg)[::-1][:4]
+    assert np.allclose(np.sort(s)[::-1], top4, atol=1e-6)
+
+
+def test_proposal_iou_loss_rejected():
+    rng = np.random.RandomState(0)
+    cls_prob, bbox_pred, im_info = _proposal_inputs(rng)
+    with pytest.raises(Exception):
+        mx.nd.contrib.Proposal(
+            mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+            mx.nd.array(im_info), scales=(8,), ratios=(0.5, 1, 2),
+            iou_loss=True)

@@ -81,3 +81,36 @@ def test_mobilenet_small_forward():
 def test_get_model_rejects_unknown():
     with pytest.raises(ValueError):
         vision.get_model("resnet9000")
+
+
+def test_baseline_symbol_families_forward():
+    """The four remaining BASELINE.md scoring families build and infer;
+    alexnet and inception-v3 also run a jitted forward (vgg/inception-bn
+    forwards are skipped — XLA-CPU compiles of those graphs take minutes
+    and add no extra coverage over their shape inference + the gluon zoo
+    forward tests).  Ref symbol factories: example/image-classification/
+    symbols/{alexnet,vgg,inception-bn,inception-v3}.py."""
+    from mxnet_tpu.models import alexnet, vgg, inception_bn, inception_v3
+
+    # every family: graph builds and shape inference closes
+    for sym, shape in [
+        (vgg.get_symbol(num_classes=7, num_layers=16), (1, 3, 224, 224)),
+        (inception_bn.get_symbol(num_classes=7), (1, 3, 224, 224)),
+    ]:
+        args, outs, aux = sym.infer_shape(data=shape)
+        assert outs[0] == (1, 7)
+
+    rng = np.random.RandomState(0)
+    for sym, shape in [
+        (alexnet.get_symbol(num_classes=7), (1, 3, 224, 224)),
+        (inception_v3.get_symbol(num_classes=7), (1, 3, 139, 139)),
+    ]:
+        exe = sym.simple_bind(mx.cpu(), grad_req="null", data=shape)
+        for name, arr in exe.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+        exe.arg_dict["data"][:] = rng.rand(*shape).astype(np.float32)
+        out = exe.forward(is_train=False)[0].asnumpy()
+        assert out.shape == (1, 7)
+        assert np.isfinite(out).all()
+        assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)  # softmax head
