@@ -1,0 +1,74 @@
+/*
+ * Pure-C deployment demo for the C predict ABI (parity target:
+ * example/image-classification/predict-cpp using include/mxnet/
+ * c_predict_api.h).
+ *
+ * Build (links the embedded-Python runtime):
+ *   gcc predict_demo.c -I../../include \
+ *       -L<dir of libmxnet_tpu_cpredict.so> -lmxnet_tpu_cpredict \
+ *       $(python3-config --embed --ldflags) -o predict_demo
+ *
+ * Usage: ./predict_demo model-symbol.json model-0000.params
+ * Feeds a zero batch of shape (1, 3, 224, 224) and prints the top output.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { perror(path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) { perror("read"); exit(1); }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s symbol.json params\n", argv[0]);
+    return 1;
+  }
+  long json_size, param_size;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 4};
+  mx_uint shape[] = {1, 3, 224, 224};
+  PredictorHandle h = NULL;
+  if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, keys, indptr,
+                   shape, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint n_in = shape[0] * shape[1] * shape[2] * shape[3];
+  mx_float *input = (mx_float *)calloc(n_in, sizeof(mx_float));
+  if (MXPredSetInput(h, "data", input, n_in) != 0 ||
+      MXPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint *oshape, ondim;
+  MXPredGetOutputShape(h, 0, &oshape, &ondim);
+  mx_uint n_out = 1;
+  for (mx_uint i = 0; i < ondim; ++i) n_out *= oshape[i];
+  mx_float *out = (mx_float *)malloc(n_out * sizeof(mx_float));
+  MXPredGetOutput(h, 0, out, n_out);
+
+  mx_uint best = 0;
+  for (mx_uint i = 1; i < n_out; ++i)
+    if (out[i] > out[best]) best = i;
+  printf("argmax=%u p=%f (out size %u)\n", best, out[best], n_out);
+
+  MXPredFree(h);
+  free(json); free(params); free(input); free(out);
+  return 0;
+}

@@ -1,0 +1,80 @@
+/*
+ * C predict ABI (capability parity with include/mxnet/c_predict_api.h —
+ * MXPredCreate/SetInput/Forward/GetOutput/Free — the reference's minimal
+ * inference surface consumed by cpp-package, amalgamation and JNI builds).
+ *
+ * Implementation (src/c_predict_api.cc) embeds the Python runtime and
+ * drives mxnet_tpu.predict.Predictor, whose forward is one jitted XLA
+ * computation; the ABI below is plain C so any language with a C FFI can
+ * deploy a trained checkpoint.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Return the message of the last error raised on this thread ("" if none).
+ * The pointer stays valid until the next failing call on the thread. */
+const char *MXGetLastError();
+
+/* Create a predictor from a symbol JSON string and a parameter blob
+ * (the bytes of a prefix-0000.params file).
+ *  dev_type: 1 = cpu, 2 = tpu; dev_id selects the chip.
+ *  input_keys/input_shape_*: named input shapes in the same CSR-style
+ *  layout as the reference (indptr has num_input+1 entries).
+ * Returns 0 on success, -1 on failure (see MXGetLastError). */
+int MXPredCreate(const char *symbol_json_str,
+                 const void *param_bytes,
+                 int param_size,
+                 int dev_type, int dev_id,
+                 mx_uint num_input_nodes,
+                 const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data,
+                 PredictorHandle *out);
+
+/* Get the shape of an output. *shape_data stays valid until the next call
+ * on this predictor. */
+int MXPredGetOutputShape(PredictorHandle handle,
+                         mx_uint index,
+                         mx_uint **shape_data,
+                         mx_uint *shape_ndim);
+
+/* Copy input data (row-major float32, size = product of the shape given at
+ * create/reshape time) into the named input. */
+int MXPredSetInput(PredictorHandle handle,
+                   const char *key,
+                   const mx_float *data,
+                   mx_uint size);
+
+/* Run the forward pass. */
+int MXPredForward(PredictorHandle handle);
+
+/* Copy output `index` into user memory (row-major float32). */
+int MXPredGetOutput(PredictorHandle handle,
+                    mx_uint index,
+                    mx_float *data,
+                    mx_uint size);
+
+/* Re-bind the predictor for new input shapes (same layout as create). */
+int MXPredReshape(PredictorHandle handle,
+                  mx_uint num_input_nodes,
+                  const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  PredictorHandle *out);
+
+/* Release the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -218,7 +218,19 @@ class Executor:
                                                 bool(is_train), tap=tap)
             new_aux = [new_aux[n] for n in self._prog.aux_names]
         else:
-            outs, new_aux = self._fwd_jit(arg_vals, aux_vals, keys, bool(is_train))
+            from . import profiler as _profiler
+            if _profiler.is_running():
+                # symbolic-mode span: one event per jitted graph execution
+                # (ref: kOnlySymbolic profiler mode, profiler.h:94-121)
+                with _profiler.record_span(
+                        "executor_forward", category="symbolic",
+                        dev=str(self._ctx)):
+                    outs, new_aux = self._fwd_jit(
+                        arg_vals, aux_vals, keys, bool(is_train))
+                    jax.block_until_ready(outs)
+            else:
+                outs, new_aux = self._fwd_jit(
+                    arg_vals, aux_vals, keys, bool(is_train))
         if is_train:
             for n, v in zip(self._prog.aux_names, new_aux):
                 self.aux_dict[n]._h.array = v

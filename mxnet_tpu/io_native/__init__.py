@@ -244,3 +244,66 @@ class NativeEngine:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# C predict ABI (src/c_predict_api.cc) — separate .so because it embeds the
+# CPython runtime (include/mxnet_tpu/c_predict_api.h is the public header)
+# ---------------------------------------------------------------------------
+
+_CPREDICT_PATH = os.path.join(os.path.dirname(__file__),
+                              "libmxnet_tpu_cpredict.so")
+_cpredict_lib = None
+_cpredict_tried = False
+
+
+def get_cpredict_lib():
+    """Load (building if needed) the C predict ABI library; None if the
+    toolchain or Python headers are unavailable.  Python-symbol references
+    stay undefined in the .so and resolve from the host process (the
+    interpreter when ctypes-loaded, or -lpython for a pure-C embedder)."""
+    global _cpredict_lib, _cpredict_tried
+    with _lock:
+        if _cpredict_lib is not None or _cpredict_tried:
+            return _cpredict_lib
+        _cpredict_tried = True
+        try:
+            import sysconfig
+            src = os.path.join(_SRC_DIR, "c_predict_api.cc")
+            inc = os.path.join(_SRC_DIR, "..", "include")
+            if not os.path.exists(_CPREDICT_PATH) or (
+                    os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(
+                        _CPREDICT_PATH)):
+                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                       "-I" + sysconfig.get_paths()["include"], "-I" + inc,
+                       "-o", _CPREDICT_PATH, src]
+                subprocess.run(cmd, check=True, capture_output=True)
+            lib = ctypes.PyDLL(_CPREDICT_PATH)  # C ABI re-enters Python: keep GIL
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            f32p = ctypes.POINTER(ctypes.c_float)
+            lib.MXGetLastError.restype = ctypes.c_char_p
+            lib.MXPredCreate.restype = ctypes.c_int
+            lib.MXPredCreate.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+                ctypes.POINTER(ctypes.c_void_p)]
+            lib.MXPredSetInput.restype = ctypes.c_int
+            lib.MXPredSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           f32p, ctypes.c_uint32]
+            lib.MXPredForward.restype = ctypes.c_int
+            lib.MXPredForward.argtypes = [ctypes.c_void_p]
+            lib.MXPredGetOutputShape.restype = ctypes.c_int
+            lib.MXPredGetOutputShape.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(u32p), u32p]
+            lib.MXPredGetOutput.restype = ctypes.c_int
+            lib.MXPredGetOutput.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                            f32p, ctypes.c_uint32]
+            lib.MXPredFree.restype = ctypes.c_int
+            lib.MXPredFree.argtypes = [ctypes.c_void_p]
+            _cpredict_lib = lib
+        except Exception:
+            _cpredict_lib = None
+        return _cpredict_lib

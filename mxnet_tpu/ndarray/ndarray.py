@@ -618,26 +618,36 @@ def save(fname, data):
 
 def load(fname):
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic != _NDAR_MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
-        n = struct.unpack("<q", f.read(8))[0]
-        names, arrays = [], []
-        for _ in range(n):
-            ln = struct.unpack("<q", f.read(8))[0]
-            names.append(f.read(ln).decode())
-            ld = struct.unpack("<q", f.read(8))[0]
-            dt = f.read(ld).decode()
-            ndim = struct.unpack("<q", f.read(8))[0]
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-            lb = struct.unpack("<q", f.read(8))[0]
-            buf = f.read(lb)
-            if dt == "bfloat16":
-                npa = np.frombuffer(buf, np.float32).reshape(shape)
-                arrays.append(array(npa, dtype="bfloat16"))
-            else:
-                npa = np.frombuffer(buf, np_dtype(dt)).reshape(shape)
-                arrays.append(array(npa, dtype=dt))
+        return _load_stream(f, fname)
+
+
+def loads(data):
+    """Parse a save()-format blob from bytes (MXPredCreate's param blob)."""
+    import io
+    return _load_stream(io.BytesIO(data), "<bytes>")
+
+
+def _load_stream(f, fname):
+    magic = f.read(8)
+    if magic != _NDAR_MAGIC:
+        raise MXNetError("invalid NDArray file %s" % fname)
+    n = struct.unpack("<q", f.read(8))[0]
+    names, arrays = [], []
+    for _ in range(n):
+        ln = struct.unpack("<q", f.read(8))[0]
+        names.append(f.read(ln).decode())
+        ld = struct.unpack("<q", f.read(8))[0]
+        dt = f.read(ld).decode()
+        ndim = struct.unpack("<q", f.read(8))[0]
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+        lb = struct.unpack("<q", f.read(8))[0]
+        buf = f.read(lb)
+        if dt == "bfloat16":
+            npa = np.frombuffer(buf, np.float32).reshape(shape)
+            arrays.append(array(npa, dtype="bfloat16"))
+        else:
+            npa = np.frombuffer(buf, np_dtype(dt)).reshape(shape)
+            arrays.append(array(npa, dtype=dt))
     if any(names):
         return dict(zip(names, arrays))
     return arrays

@@ -442,8 +442,17 @@ def _deformable_psroi_pool(data, rois, *trans_opt, spatial_scale=1.0,
             gw = jnp.clip((pw * g) // p, 0, g - 1)
             chans = jnp.arange(C) * g * g + gh * g + gw
             block = img[chans]
-            vals = _bilinear_gather(block, yy, xx)        # (C, sp, sp)
-            return jnp.mean(vals, axis=(1, 2))
+            # reference semantics: samples within half a pixel of the border
+            # clamp to it, farther ones are skipped; the mean runs over the
+            # valid count only (deformable_psroi_pooling.cu sample loop)
+            valid = ((yy > -0.5) & (yy < H - 0.5)
+                     & (xx > -0.5) & (xx < W - 0.5))
+            yc = jnp.clip(yy, 0.0, H - 1.0)
+            xc = jnp.clip(xx, 0.0, W - 1.0)
+            vals = _bilinear_gather(block, yc, xc)        # (C, sp, sp)
+            vals = vals * valid[None].astype(vals.dtype)
+            count = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(vals, axis=(1, 2)) / count
 
         return jnp.stack([
             jnp.stack([one_cell(ph, pw) for pw in range(p)], axis=-1)

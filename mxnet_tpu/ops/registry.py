@@ -12,6 +12,7 @@ read/write var queues.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import numpy as np
@@ -237,7 +238,19 @@ def apply_op(op, inputs, attrs):
                 a if d is None or d == first_dev
                 else jax.device_put(a, first_dev)
                 for a, d in zip(inputs, input_devs)]
-    out = fn(*inputs)
+    from .. import profiler as _profiler
+    if _profiler.is_running() and _profiler.op_spans_enabled():
+        # accurate per-op spans require blocking on the result, like the
+        # reference's worker-thread timing hook (threaded_engine.h:326-338);
+        # profiling trades the async pipelining away, same as there
+        t0 = time.time() * 1e6
+        out = fn(*inputs)
+        jax.block_until_ready(out)
+        dev = "%s" % (inputs[0].devices() if inputs else "host",)
+        _profiler.record_event(op.name, t0, time.time() * 1e6,
+                               category="operator", dev=dev)
+    else:
+        out = fn(*inputs)
     if not isinstance(out, (tuple, list)):
         out = (out,)
     return tuple(out)

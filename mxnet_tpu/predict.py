@@ -30,6 +30,10 @@ class Predictor:
                 self._symbol = sym_load_json(f.read())
         if isinstance(param_bytes_or_file, (dict,)):
             params = param_bytes_or_file
+        elif isinstance(param_bytes_or_file, (bytes, bytearray)):
+            # MXPredCreate hands the raw .params blob (c_predict_api path)
+            from .ndarray.ndarray import loads as nd_loads
+            params = nd_loads(bytes(param_bytes_or_file))
         else:
             params = nd_load(param_bytes_or_file)
         arg_params = {k[4:]: v for k, v in params.items()
@@ -76,6 +80,25 @@ class Predictor:
         """MXPredReshape: re-bind with new shapes (re-jit per signature)."""
         self._exe = self._exe.reshape(**input_shapes)
         return self
+
+    # -- raw-buffer entry points for the C ABI (src/c_predict_api.cc) -------
+
+    def set_input_bytes(self, name, buf):
+        """MXPredSetInput from a raw float32 buffer (C ABI marshalling)."""
+        if name not in self._exe.arg_dict:
+            raise MXNetError("unknown input %r" % name)
+        shape = self._exe.arg_dict[name].shape
+        data = np.frombuffer(buf, np.float32).reshape(shape)
+        self.set_input(name, data)
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape."""
+        return tuple(self._exe.outputs[index].shape)
+
+    def get_output_bytes(self, index=0):
+        """MXPredGetOutput as raw float32 bytes (C ABI marshalling)."""
+        return np.ascontiguousarray(
+            self._exe.outputs[index].asnumpy().astype(np.float32)).tobytes()
 
 
 def load_checkpoint_predictor(prefix, epoch, input_shapes, ctx=None):

@@ -35,6 +35,13 @@ def is_running():
     return _state["running"]
 
 
+def op_spans_enabled():
+    """Per-op imperative spans record only in 'all' mode (ref: kAllOperator
+    vs kOnlySymbolic, profiler.h:94-121) — they block on each op result for
+    accurate timing, so symbolic mode leaves the async pipeline intact."""
+    return _state["mode"] in ("all", "all_operator")
+
+
 def record_event(name, start_us, end_us, category="operator", dev="cpu/0",
                  tid=0):
     if not _state["running"]:

@@ -1,0 +1,217 @@
+// C predict ABI implementation (capability parity target:
+// src/c_api/c_predict_api.cc — MXPredCreate/SetInput/Forward/GetOutput).
+//
+// The reference's predict ABI fronts its C++ executor directly; here the
+// inference engine is a jitted XLA computation owned by the Python-side
+// Predictor (mxnet_tpu/predict.py), so this layer embeds the CPython
+// runtime and marshals C buffers <-> numpy.  Any C/C++/FFI host gets real
+// C linkage for deployment without carrying a Python API dependency in its
+// own code.
+//
+// Threading: every entry point acquires the GIL via PyGILState_Ensure, so
+// the ABI is callable from arbitrary host threads (the reference's engine
+// gave the same guarantee).  If Python is not yet initialized in the
+// process (pure-C host), the first call initializes it.
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+}
+
+namespace {
+
+thread_local std::string last_error;
+
+struct PredictorObj {
+  PyObject *py;                       // mxnet_tpu.predict.Predictor
+  std::vector<mx_uint> shape_buf;     // backing store for GetOutputShape
+};
+
+void set_err_from_python() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptb = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptb);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptb);
+  last_error = "python error";
+  if (pvalue) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s) {
+      last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptb);
+}
+
+// ensure the interpreter exists and return a GIL guard
+class GIL {
+ public:
+  GIL() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the initializing thread now holds, so other host
+      // threads' PyGILState_Ensure can acquire it between our calls
+      PyEval_SaveThread();
+    }
+    state_ = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject *shapes_dict(mx_uint num, const char **keys,
+                      const mx_uint *indptr, const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(data[j]));
+    }
+    PyDict_SetItemString(d, keys[i], t);
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  GIL gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (!mod) { set_err_from_python(); return -1; }
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (!cls) { set_err_from_python(); return -1; }
+
+  PyObject *json = PyUnicode_FromString(symbol_json_str);
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  const char *dev = dev_type == 2 ? "tpu" : "cpu";
+  PyObject *args = Py_BuildValue("(OOO)", json, blob, shapes);
+  PyObject *kwargs = Py_BuildValue("{s:s,s:i}", "dev_type", dev,
+                                   "dev_id", dev_id);
+  PyObject *inst = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(cls);
+  Py_DECREF(json);
+  Py_DECREF(blob);
+  Py_DECREF(shapes);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!inst) { set_err_from_python(); return -1; }
+  auto *p = new PredictorObj{inst, {}};
+  *out = p;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  // hand the buffer over as bytes; set_input reshapes to the bound shape
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  PyObject *r = PyObject_CallMethod(p->py, "set_input_bytes", "sO", key, buf);
+  Py_DECREF(buf);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "forward", nullptr);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "get_output_shape", "I", index);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_ssize_t n = PySequence_Size(r);
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    p->shape_buf[i] = static_cast<mx_uint>(PyLong_AsUnsignedLong(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "get_output_bytes", "I", index);
+  if (!r) { set_err_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_err_from_python();
+    return -1;
+  }
+  if (static_cast<mx_uint>(len / sizeof(mx_float)) != size) {
+    last_error = "MXPredGetOutput: size mismatch (want " +
+                 std::to_string(size) + " floats, output has " +
+                 std::to_string(len / sizeof(mx_float)) + ")";
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
+                  const char **input_keys, const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle *out) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject *r = PyObject_CallMethod(p->py, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  *out = handle;  // in-place rebind, same handle (reference returns new)
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GIL gil;
+  auto *p = static_cast<PredictorObj *>(handle);
+  Py_XDECREF(p->py);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

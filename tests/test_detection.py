@@ -193,3 +193,20 @@ def test_proposal_iou_loss_rejected():
             mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
             mx.nd.array(im_info), scales=(8,), ratios=(0.5, 1, 2),
             iou_loss=True)
+
+
+def test_deformable_psroi_pooling_edge_count():
+    """Samples outside the feature map are skipped, not zero-averaged: an
+    edge ROI over a constant map must still pool the constant (regression:
+    zero-padding out-of-bounds samples diluted edge bins)."""
+    data = np.full((1, 1, 4, 4), 5.0, np.float32)
+    # roi hanging half off the left/top border
+    rois = np.array([[0, -2, -2, 2, 2]], np.float32)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=2,
+        sample_per_part=4, no_trans=True)
+    o = out.asnumpy()
+    # every bin with at least one in-bounds sample reads exactly 5.0
+    assert np.allclose(o[o != 0], 5.0, atol=1e-4)
+    assert (o != 0).any()

@@ -221,3 +221,30 @@ def test_kvstore_list_keys():
     kv.pull([1, 2], out=outs)
     assert_almost_equal(outs[0].asnumpy(), [1, 1])
     assert_almost_equal(outs[1].asnumpy(), [0, 0])
+
+
+def test_profiler_records_op_and_symbolic_spans(tmp_path):
+    """Profiler parity (ref: src/engine/profiler.cc DumpProfile — Chrome
+    trace JSON; modes kOnlySymbolic/kAllOperator)."""
+    import json
+    import os
+    from mxnet_tpu import profiler
+
+    fname = os.path.join(str(tmp_path), "profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    a = mx.nd.ones((4, 4))
+    b = (a * 2 + 1).asnumpy()
+    # symbolic span
+    s = mx.sym.FullyConnected(mx.sym.var("x"), num_hidden=2)
+    exe = s.simple_bind(mx.cpu(), x=(2, 3))
+    exe.forward()
+    profiler.profiler_set_state("stop")
+    with open(fname) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any("mul" in n or "plus" in n or "_mul_scalar" in n for n in names), names
+    assert "executor_forward" in names
+    # begin/end pairs per event
+    phases = [e["ph"] for e in trace["traceEvents"]]
+    assert phases.count("B") == phases.count("E")
