@@ -55,12 +55,18 @@ class Context:
 
     # -- mapping onto jax devices --------------------------------------------
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete jax.Device.
+
+        Always a process-LOCAL device: under multi-process jax.distributed,
+        jax.devices() lists every process's devices and placing data on a
+        remote one is an error — a Context names a device of THIS worker
+        (matching the reference, where ctx always meant a local device)."""
         if self.device_typeid in (1, 3, 5):
-            return jax.devices("cpu")[self.device_id % len(jax.devices("cpu"))]
+            cpus = jax.local_devices(backend="cpu")
+            return cpus[self.device_id % len(cpus)]
         # tpu / gpu: use the default (accelerator) backend; alias gpu->tpu so
         # reference scripts that say mx.gpu(0) run unchanged on TPU machines.
-        devs = jax.devices()
+        devs = jax.local_devices()
         if devs[0].platform == "cpu":
             # pure-CPU environment (tests): accelerator contexts map onto the
             # virtual cpu devices so multi-device code paths stay exercised.
@@ -109,7 +115,7 @@ def current_context():
 
 
 def num_gpus():
-    devs = jax.devices()
+    devs = jax.local_devices()  # devices THIS worker can address
     return 0 if devs[0].platform == "cpu" else len(devs)
 
 

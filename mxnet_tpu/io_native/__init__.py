@@ -301,6 +301,11 @@ def get_cpredict_lib():
             lib.MXPredGetOutput.restype = ctypes.c_int
             lib.MXPredGetOutput.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                             f32p, ctypes.c_uint32]
+            lib.MXPredReshape.restype = ctypes.c_int
+            lib.MXPredReshape.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+                ctypes.POINTER(ctypes.c_void_p)]
             lib.MXPredFree.restype = ctypes.c_int
             lib.MXPredFree.argtypes = [ctypes.c_void_p]
             _cpredict_lib = lib

@@ -13,6 +13,12 @@ dist_sync == dist_device_sync here (no CPU staging hop to remove);
 dist_async is documented sync-equivalent (SURVEY.md §7 hard-part 5) —
 on ICI the straggler problem async mode solved does not exist.
 
+Backend discovery: on a real pod the default backend spans all processes;
+in the localhost test topology (§4.6's "multi-process on one host"
+pattern) the default backend may be a single-chip tunnel while the CPU
+backend carries the cross-process view — `_dist_devices` picks whichever
+platform actually sees more than one process.
+
 Env compatibility: honors DMLC_NUM_WORKER/DMLC_WORKER_ID when
 jax.distributed is not initialized (e.g. under the reference's launcher),
 so `tools/launch.py`-style scripts still see rank/size.
@@ -31,20 +37,64 @@ from . import KVStore, _key_value
 from .gradient_compression import GradientCompression
 
 
+def _global_state():
+    from jax._src import distributed
+    return distributed.global_state
+
+
+def _dist_devices():
+    """ONE device per process from a backend that spans every process, or
+    None when this is a single-process job.  Prefers the default backend
+    (real pods), falls back to cpu (localhost multi-process topology).
+    One-per-process keeps the allreduce a process-sharded sum regardless
+    of how many chips each host contributes."""
+    if _global_state().num_processes in (None, 0, 1):
+        return None
+    for platform in (None, "cpu"):
+        try:
+            devs = jax.devices(platform) if platform else jax.devices()
+        except Exception:
+            continue
+        by_proc = {}
+        for d in sorted(devs, key=lambda d: (d.process_index, d.id)):
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) > 1:
+            return [by_proc[p] for p in sorted(by_proc)]
+    return None
+
+
 class DistKVStore(KVStore):
     def __init__(self, name="dist_sync"):
         super().__init__(name)
         self._gc = None
         self._barrier_count = 0
+        self._psum_cache = {}
+        self._devs = None
+        self._devs_resolved = False
+        # localhost topology: cross-process CPU collectives need gloo,
+        # selected before the cpu client is first created
+        gs = _global_state()
+        if gs.num_processes and gs.num_processes > 1:
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # already created or unavailable: discovery decides
 
     @property
     def rank(self):
+        gs = _global_state()
+        if gs.num_processes and gs.num_processes > 1:
+            return int(gs.process_id)
         if jax.process_count() > 1:
             return jax.process_index()
         return int(os.environ.get("DMLC_WORKER_ID", 0))
 
     @property
     def num_workers(self):
+        gs = _global_state()
+        if gs.num_processes and gs.num_processes > 1:
+            return int(gs.num_processes)
         if jax.process_count() > 1:
             return jax.process_count()
         return int(os.environ.get("DMLC_NUM_WORKER", 1))
@@ -56,13 +106,47 @@ class DistKVStore(KVStore):
             raise MXNetError("unsupported compression type %r" % ctype)
         self._gc = GradientCompression(**params)
 
+    def _spanning_devices(self):
+        """Memoized cross-process device list — the topology is fixed
+        after jax.distributed init, so discover it once."""
+        if not self._devs_resolved:
+            self._devs = _dist_devices()
+            self._devs_resolved = True
+        return self._devs
+
+    def _psum_fn(self, devs):
+        key = tuple(d.id for d in devs)
+        cached = self._psum_cache.get(key)
+        if cached is None:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            mesh = Mesh(np.array(devs), ("host",))
+            fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                         out_shardings=NamedSharding(mesh, P()))
+            cached = (fn, mesh)
+            self._psum_cache[key] = cached
+        return cached
+
     def _allreduce_across_hosts(self, arr):
-        """Sum a host-local array across all processes (DCN collective)."""
-        if jax.process_count() <= 1:
+        """Sum a host-local array across all processes.  SPMD over the
+        cross-process backend: every worker contributes its shard of a
+        process-sharded global array, one jitted sum reduces it, XLA lowers
+        the exchange to DCN collectives.  All workers must push the same
+        keys in the same order — the reference's sync-mode contract."""
+        devs = self._spanning_devices()
+        if devs is None:
             return arr
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(arr)
-        return jnp.sum(gathered, axis=0)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        client = devs[0].client
+        my_proc = client.process_index()
+        local = [d for d in devs if d.process_index == my_proc][0]
+        fn, mesh = self._psum_fn(devs)
+        shard = jax.device_put(np.asarray(arr)[None], local)
+        garr = jax.make_array_from_single_device_arrays(
+            (len(devs),) + tuple(arr.shape),
+            NamedSharding(mesh, P("host")), [shard])
+        out = fn(garr)
+        res = np.asarray(out.addressable_shards[0].data)
+        return jnp.asarray(res)
 
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
@@ -86,7 +170,6 @@ class DistKVStore(KVStore):
 
     def barrier(self):
         self._barrier_count += 1
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(
-                "kvstore_barrier_%d" % self._barrier_count)
+        # a scalar allreduce is a barrier: nobody leaves before all arrive
+        # (no-op when single-process — _allreduce handles that)
+        self._allreduce_across_hosts(jnp.zeros((1,), jnp.float32))

@@ -445,8 +445,8 @@ def _deformable_psroi_pool(data, rois, *trans_opt, spatial_scale=1.0,
             # reference semantics: samples within half a pixel of the border
             # clamp to it, farther ones are skipped; the mean runs over the
             # valid count only (deformable_psroi_pooling.cu sample loop)
-            valid = ((yy > -0.5) & (yy < H - 0.5)
-                     & (xx > -0.5) & (xx < W - 0.5))
+            valid = ((yy >= -0.5) & (yy <= H - 0.5)
+                     & (xx >= -0.5) & (xx <= W - 0.5))
             yc = jnp.clip(yy, 0.0, H - 1.0)
             xc = jnp.clip(xx, 0.0, W - 1.0)
             vals = _bilinear_gather(block, yc, xc)        # (C, sp, sp)

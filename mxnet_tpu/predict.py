@@ -81,6 +81,24 @@ class Predictor:
         self._exe = self._exe.reshape(**input_shapes)
         return self
 
+    def reshaped(self, input_shapes):
+        """A NEW predictor bound to `input_shapes`, sharing this one's
+        weights; this predictor keeps working with its old shapes (the
+        reference MXPredReshape contract — old and new handles are
+        independent and both must be freed)."""
+        new = object.__new__(Predictor)
+        new._symbol = self._symbol
+        new._ctx = self._ctx
+        shape_kwargs = dict(input_shapes)
+        new._exe = new._symbol.simple_bind(new._ctx, grad_req="null",
+                                           **shape_kwargs)
+        arg_params = {k: v for k, v in self._exe.arg_dict.items()
+                      if k not in self._input_names}
+        new._exe.copy_params_from(arg_params, dict(self._exe.aux_dict),
+                                  allow_extra_params=True)
+        new._input_names = set(shape_kwargs)
+        return new
+
     # -- raw-buffer entry points for the C ABI (src/c_predict_api.cc) -------
 
     def set_input_bytes(self, name, buf):

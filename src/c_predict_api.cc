@@ -16,6 +16,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -42,7 +43,12 @@ void set_err_from_python() {
   if (pvalue) {
     PyObject *s = PyObject_Str(pvalue);
     if (s) {
-      last_error = PyUnicode_AsUTF8(s);
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) {
+        last_error = msg;
+      } else {
+        PyErr_Clear();  // unencodable message: keep the generic text
+      }
       Py_DECREF(s);
     }
   }
@@ -52,15 +58,21 @@ void set_err_from_python() {
 }
 
 // ensure the interpreter exists and return a GIL guard
+std::once_flag py_init_once;
+
 class GIL {
  public:
   GIL() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL the initializing thread now holds, so other host
-      // threads' PyGILState_Ensure can acquire it between our calls
-      PyEval_SaveThread();
-    }
+    // call_once: two host threads making their first ABI call concurrently
+    // must not both bootstrap the interpreter
+    std::call_once(py_init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // release the GIL the initializing thread now holds, so other host
+        // threads' PyGILState_Ensure can acquire it between our calls
+        PyEval_SaveThread();
+      }
+    });
     state_ = PyGILState_Ensure();
   }
   ~GIL() { PyGILState_Release(state_); }
@@ -198,11 +210,13 @@ int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
                                  input_shape_indptr, input_shape_data);
-  PyObject *r = PyObject_CallMethod(p->py, "reshape", "O", shapes);
+  // `reshaped` returns a NEW predictor sharing the weights — the old
+  // handle stays valid with its old shapes and both handles must be
+  // freed, matching the reference contract
+  PyObject *r = PyObject_CallMethod(p->py, "reshaped", "O", shapes);
   Py_DECREF(shapes);
   if (!r) { set_err_from_python(); return -1; }
-  Py_DECREF(r);
-  *out = handle;  // in-place rebind, same handle (reference returns new)
+  *out = new PredictorObj{r, {}};
   return 0;
 }
 

@@ -1,0 +1,87 @@
+"""Multi-process distributed kvstore test — the reference's whole
+multi-node CI story is "fork scheduler+servers+workers as processes on one
+host" (tools/launch.py --launcher local running
+tests/nightly/dist_sync_kvstore.py, SURVEY.md §4.6).  The TPU-native
+equivalent forks N jax.distributed processes on localhost and checks
+dist_sync push/pull semantics across them over the collective backend.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1], num_processes=int(sys.argv[2]),
+    process_id=int(sys.argv[3]))
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank, size = kv.rank, kv.num_workers
+assert size == int(sys.argv[2]), size
+
+kv.init("w", mx.nd.zeros((3,)))
+# each worker pushes rank+1: sync semantics => everyone pulls sum
+kv.push("w", mx.nd.ones((3,)) * (rank + 1))
+out = mx.nd.zeros((3,))
+kv.pull("w", out=out)
+expect = sum(r + 1 for r in range(size))
+assert np.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
+
+kv.barrier()
+
+# string keys and a second round (state carries across pushes)
+kv.init("emb", mx.nd.ones((2, 2)))
+kv.push("emb", mx.nd.ones((2, 2)) * rank)
+out2 = mx.nd.zeros((2, 2))
+kv.pull("emb", out=out2)
+assert np.allclose(out2.asnumpy(), sum(range(size))), out2.asnumpy()
+
+print("WORKER_OK rank=%d size=%d pulled=%s" % (rank, size,
+                                               out.asnumpy()[0]))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("nproc,local_devices", [(2, 1), (2, 4)])
+def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
+    """local_devices > 1 exercises the pod-like topology: several chips per
+    host, allreduce still counts each process's contribution once."""
+    addr = "127.0.0.1:%d" % _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    if local_devices > 1:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                            % local_devices)
+    procs = []
+    for rank in range(nproc):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER, addr, str(nproc), str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode())
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out)
+        assert "WORKER_OK" in out, out

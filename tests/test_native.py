@@ -223,3 +223,53 @@ def test_c_predict_abi_error_reporting(tmp_path):
                           shape, ctypes.byref(handle))
     assert rc == -1
     assert lib.MXGetLastError()  # non-empty message
+
+
+def test_c_predict_abi_reshape(tmp_path):
+    """MXPredReshape returns a NEW independent handle (reference contract:
+    old handle keeps its shapes, both handles freed separately)."""
+    import ctypes
+    import os
+    from mxnet_tpu.io_native import get_cpredict_lib
+
+    lib = get_cpredict_lib()
+    if lib is None:
+        pytest.skip("C predict library unavailable (no toolchain)")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=3, name="fc"), name="softmax")
+    rng = np.random.RandomState(0)
+    params = {"arg:fc_weight": mx.nd.array(rng.rand(3, 4).astype(np.float32)),
+              "arg:fc_bias": mx.nd.array(rng.rand(3).astype(np.float32))}
+    pfile = os.path.join(str(tmp_path), "net-0000.params")
+    mx.nd.save(pfile, params)
+    blob = open(pfile, "rb").read()
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape = (ctypes.c_uint32 * 2)(2, 4)
+    h = ctypes.c_void_p()
+    assert lib.MXPredCreate(net.tojson().encode(), blob, len(blob), 1, 0, 1,
+                            keys, indptr, shape, ctypes.byref(h)) == 0
+
+    shape2 = (ctypes.c_uint32 * 2)(5, 4)
+    h2 = ctypes.c_void_p()
+    assert lib.MXPredReshape(h, 1, keys, indptr, shape2,
+                             ctypes.byref(h2)) == 0, lib.MXGetLastError()
+    assert h2.value != h.value
+
+    def run(handle, batch):
+        x = rng.rand(batch, 4).astype(np.float32)
+        assert lib.MXPredSetInput(
+            handle, b"data",
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size) == 0
+        assert lib.MXPredForward(handle) == 0
+        sdata = ctypes.POINTER(ctypes.c_uint32)()
+        ndim = ctypes.c_uint32()
+        assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                        ctypes.byref(ndim)) == 0
+        return tuple(sdata[i] for i in range(ndim.value))
+
+    assert run(h2, 5) == (5, 3)
+    assert run(h, 2) == (2, 3)   # old handle still bound to old shapes
+    assert lib.MXPredFree(h) == 0
+    assert lib.MXPredFree(h2) == 0
