@@ -49,6 +49,9 @@ from . import test_utils
 from . import visualization
 from .visualization import plot_network
 from . import rnn
+from . import attribute
+from . import name
+from . import elastic
 from . import libinfo
 from . import contrib
 from . import kvstore_server

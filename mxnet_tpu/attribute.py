@@ -1,0 +1,11 @@
+"""Attribute scoping (ref: python/mxnet/attribute.py — AttrScope).
+
+The implementation lives with Symbol (symbol/symbol.py) because attrs are
+a symbol-graph concept here; this module keeps the reference import path
+`mx.attribute.AttrScope` working.
+"""
+from __future__ import annotations
+
+from .symbol.symbol import AttrScope  # noqa: F401
+
+current = AttrScope

@@ -67,7 +67,6 @@ class DistKVStore(KVStore):
     def __init__(self, name="dist_sync"):
         super().__init__(name)
         self._gc = None
-        self._barrier_count = 0
         self._psum_cache = {}
         self._devs = None
         self._devs_resolved = False
@@ -80,6 +79,20 @@ class DistKVStore(KVStore):
                                   "gloo")
             except Exception:
                 pass  # already created or unavailable: discovery decides
+            # rendezvous before the first collective: workers reach this
+            # point with minutes of skew (import + jit compile), far beyond
+            # gloo's ~30s peer-connect window; the coordination-service
+            # barrier absorbs the skew with an explicit timeout
+            try:
+                gs.client.wait_at_barrier("mxnet_tpu_kvstore_init", 180_000)
+            except Exception:
+                logger_warned = getattr(self, "_rendezvous_warned", False)
+                if not logger_warned:
+                    from ..base import _logger
+                    _logger.warning(
+                        "kvstore init rendezvous failed; first collective "
+                        "may race peer startup")
+                    self._rendezvous_warned = True
 
     @property
     def rank(self):
@@ -108,10 +121,22 @@ class DistKVStore(KVStore):
 
     def _spanning_devices(self):
         """Memoized cross-process device list — the topology is fixed
-        after jax.distributed init, so discover it once."""
+        after jax.distributed init, so discover it once.  A multi-process
+        job that cannot find a spanning backend is a hard error: silently
+        skipping the allreduce would let each worker train on only its own
+        gradients and diverge."""
         if not self._devs_resolved:
             self._devs = _dist_devices()
             self._devs_resolved = True
+            gs = _global_state()
+            if self._devs is None and gs.num_processes \
+                    and gs.num_processes > 1:
+                raise MXNetError(
+                    "dist kvstore: %d processes connected but no jax "
+                    "backend spans them (cpu collectives need gloo selected "
+                    "before the cpu client is first created — create the "
+                    "kvstore before touching jax devices)"
+                    % gs.num_processes)
         return self._devs
 
     def _psum_fn(self, devs):
@@ -169,7 +194,6 @@ class DistKVStore(KVStore):
                 merged.copyto(stored)
 
     def barrier(self):
-        self._barrier_count += 1
         # a scalar allreduce is a barrier: nobody leaves before all arrive
         # (no-op when single-process — _allreduce handles that)
         self._allreduce_across_hosts(jnp.zeros((1,), jnp.float32))

@@ -1,0 +1,19 @@
+"""Automatic naming (ref: python/mxnet/name.py — NameManager, Prefix).
+
+Implementation lives with Symbol; this module keeps the reference import
+path `mx.name.NameManager` working.
+"""
+from __future__ import annotations
+
+from .symbol.symbol import NameManager  # noqa: F401
+
+
+class Prefix(NameManager):
+    """NameManager that prepends a prefix to every auto-generated name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

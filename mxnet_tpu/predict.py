@@ -92,8 +92,13 @@ class Predictor:
         shape_kwargs = dict(input_shapes)
         new._exe = new._symbol.simple_bind(new._ctx, grad_req="null",
                                            **shape_kwargs)
+        # copy only weights whose shape survives the re-bind: inputs and
+        # batch-shaped extras (e.g. a loss head's label arg) take the NEW
+        # binding's shapes
         arg_params = {k: v for k, v in self._exe.arg_dict.items()
-                      if k not in self._input_names}
+                      if k not in self._input_names
+                      and k in new._exe.arg_dict
+                      and tuple(new._exe.arg_dict[k].shape) == tuple(v.shape)}
         new._exe.copy_params_from(arg_params, dict(self._exe.aux_dict),
                                   allow_extra_params=True)
         new._input_names = set(shape_kwargs)

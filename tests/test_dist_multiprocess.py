@@ -74,14 +74,26 @@ def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
     outs = []
+    deadline = 240
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=deadline)
+            outs.append(out.decode())
         except subprocess.TimeoutExpired:
+            # a worker hanging in a collective means its peer died: kill
+            # everyone and surface every worker's partial output so the
+            # real assertion failure isn't lost
             for q in procs:
                 q.kill()
-            raise
-        outs.append(out.decode())
+            for q in procs:
+                try:
+                    leftover, _ = q.communicate(timeout=10)
+                    outs.append(leftover.decode())
+                except Exception:
+                    outs.append("<no output captured>")
+            raise AssertionError(
+                "worker timed out; all worker outputs:\n" +
+                "\n=====\n".join(outs))
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out)
         assert "WORKER_OK" in out, out
