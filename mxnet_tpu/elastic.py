@@ -98,6 +98,9 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         cbs.append(_save_states)
     if cb is not None:
         cbs.extend(cb if isinstance(cb, (list, tuple)) else [cb])
+    # force_init when resuming: the checkpoint is authoritative even if
+    # this module object already holds (mid-crash) initialized params
+    fit_kwargs.setdefault("force_init", start > 0)
     module.fit(train_data, eval_data=eval_data,
                arg_params=arg_params, aux_params=aux_params,
                begin_epoch=start, num_epoch=num_epoch,

@@ -504,3 +504,68 @@ class LibSVMIter(DataIter):
                  batch_size=1, **kwargs):
         raise MXNetError("LibSVMIter requires sparse NDArray support "
                          "(mxnet_tpu.ndarray.sparse)")
+
+
+class DevicePrefetchIter(DataIter):
+    """Upload batches to the device ahead of consumption.
+
+    The reference overlaps host->device copies with compute via dedicated
+    copy-lane engine threads (FnProperty::kCopyFromCPU, SURVEY.md §2.1);
+    here jax's async dispatch gives the overlap for free once the
+    `device_put` for batch N+1 is ISSUED while step N runs — this wrapper
+    issues it one batch early, so a training loop sees device-resident
+    data and the transfer rides under the previous step's compute.
+    """
+
+    def __init__(self, base_iter, ctx=None):
+        super().__init__()
+        from .context import current_context
+        from .ndarray import NDArray
+        import jax as _jax
+        self._base = base_iter
+        self._ctx = ctx or current_context()
+        self._dev = self._ctx.jax_device()
+        self._jax = _jax
+        self._NDArray = NDArray
+        self._pending = None
+        self.batch_size = getattr(base_iter, "batch_size", None)
+
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def reset(self):
+        self._base.reset()
+        self._pending = None
+
+    def _upload(self, batch):
+        def put(arrs):
+            if not arrs:
+                return arrs
+            return [self._NDArray(
+                self._jax.device_put(a._h.array, self._dev))
+                for a in arrs]
+
+        return DataBatch(data=put(batch.data), label=put(batch.label or []),
+                         pad=batch.pad, index=batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def next(self):
+        if self._pending is None:
+            try:
+                self._pending = self._upload(self._base.next())
+            except StopIteration:
+                raise
+        out = self._pending
+        # issue the NEXT upload now — it overlaps the caller's compute on
+        # the batch being returned
+        try:
+            self._pending = self._upload(self._base.next())
+        except StopIteration:
+            self._pending = None
+        return out

@@ -36,6 +36,8 @@ from ..ndarray import NDArray
 from . import KVStore, _key_value
 from .gradient_compression import GradientCompression
 
+_rendezvoused = False
+
 
 def _global_state():
     from jax._src import distributed
@@ -81,18 +83,21 @@ class DistKVStore(KVStore):
                 pass  # already created or unavailable: discovery decides
             # rendezvous before the first collective: workers reach this
             # point with minutes of skew (import + jit compile), far beyond
-            # gloo's ~30s peer-connect window; the coordination-service
-            # barrier absorbs the skew with an explicit timeout
-            try:
-                gs.client.wait_at_barrier("mxnet_tpu_kvstore_init", 180_000)
-            except Exception:
-                logger_warned = getattr(self, "_rendezvous_warned", False)
-                if not logger_warned:
+            # gloo's ~30s peer-connect window.  Only the FIRST store per
+            # process synchronizes — later creations are past import skew,
+            # and ranks may legitimately create different numbers of stores
+            # (a fixed id would stall 180s per extra instance).
+            global _rendezvoused
+            if not _rendezvoused:
+                _rendezvoused = True
+                try:
+                    gs.client.wait_at_barrier("mxnet_tpu_kvstore_init",
+                                              180_000)
+                except Exception:
                     from ..base import _logger
                     _logger.warning(
                         "kvstore init rendezvous failed; first collective "
                         "may race peer startup")
-                    self._rendezvous_warned = True
 
     @property
     def rank(self):

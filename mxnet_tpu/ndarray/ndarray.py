@@ -11,6 +11,7 @@ are preserved on immutable device buffers.
 """
 from __future__ import annotations
 
+import os
 import struct
 
 import jax
@@ -595,7 +596,10 @@ def save(fname, data):
     else:
         names = [""] * len(data)
         arrays = list(data)
-    with open(fname, "wb") as f:
+    # atomic: write to temp + rename so a crash mid-save never leaves a
+    # truncated .params file for elastic resume to trip over
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(_NDAR_MAGIC)
         f.write(struct.pack("<q", len(arrays)))
         for name, nd in zip(names, arrays):
@@ -614,6 +618,7 @@ def save(fname, data):
             buf = npa.tobytes()
             f.write(struct.pack("<q", len(buf)))
             f.write(buf)
+    os.replace(tmp, fname)
 
 
 def load(fname):

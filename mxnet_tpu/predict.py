@@ -57,6 +57,9 @@ class Predictor:
         self._exe.copy_params_from(arg_params, aux_params,
                                    allow_extra_params=True)
         self._input_names = set(shape_kwargs)
+        # which args are real weights (came from the param blob) vs
+        # data-like extras (labels) — reshape treats them differently
+        self._param_names = set(arg_params) | set(aux_params)
 
     def set_input(self, name, data):
         """MXPredSetInput."""
@@ -92,16 +95,26 @@ class Predictor:
         shape_kwargs = dict(input_shapes)
         new._exe = new._symbol.simple_bind(new._ctx, grad_req="null",
                                            **shape_kwargs)
-        # copy only weights whose shape survives the re-bind: inputs and
-        # batch-shaped extras (e.g. a loss head's label arg) take the NEW
-        # binding's shapes
-        arg_params = {k: v for k, v in self._exe.arg_dict.items()
-                      if k not in self._input_names
-                      and k in new._exe.arg_dict
-                      and tuple(new._exe.arg_dict[k].shape) == tuple(v.shape)}
+        # weights must survive the re-bind shape-identically — a changed
+        # weight shape (e.g. Flatten->FC fed a different spatial size)
+        # cannot be silently zero-filled (ref MXPredReshape raises too);
+        # data-like extras (labels) legitimately take the NEW batch shapes
+        arg_params = {}
+        for k, v in self._exe.arg_dict.items():
+            if k in self._input_names or k not in new._exe.arg_dict:
+                continue
+            new_shape = tuple(new._exe.arg_dict[k].shape)
+            if k in self._param_names and new_shape != tuple(v.shape):
+                raise MXNetError(
+                    "MXPredReshape: weight %r changes shape %s -> %s under "
+                    "the new input shapes; only batch-size changes are "
+                    "reshapable" % (k, tuple(v.shape), new_shape))
+            if new_shape == tuple(v.shape):
+                arg_params[k] = v
         new._exe.copy_params_from(arg_params, dict(self._exe.aux_dict),
                                   allow_extra_params=True)
         new._input_names = set(shape_kwargs)
+        new._param_names = set(self._param_names)
         return new
 
     # -- raw-buffer entry points for the C ABI (src/c_predict_api.cc) -------

@@ -15,6 +15,7 @@ JSON layout mirrors nnvm::SaveJSON ({"nodes": [...], "arg_nodes": [...],
 from __future__ import annotations
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -552,8 +553,13 @@ class Symbol:
                           indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # write-to-temp + rename: a crash mid-save must never leave a
+        # truncated file where a checkpoint is expected (elastic resume
+        # picks the newest file by name)
+        tmp = fname + ".tmp"
+        with open(tmp, "w") as f:
             f.write(self.tojson())
+        os.replace(tmp, fname)
 
     # -- evaluation ----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,

@@ -248,3 +248,20 @@ def test_profiler_records_op_and_symbolic_spans(tmp_path):
     # begin/end pairs per event
     phases = [e["ph"] for e in trace["traceEvents"]]
     assert phases.count("B") == phases.count("E")
+
+
+def test_device_prefetch_iter():
+    """DevicePrefetchIter yields the same batches, device-resident (the
+    copy-lane overlap analog, SURVEY.md §2.1 FnProperty)."""
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    base = mx.io.NDArrayIter(X, y, batch_size=5)
+    it = mx.io.DevicePrefetchIter(base, ctx=mx.cpu())
+    seen = []
+    for epoch in range(2):
+        it.reset()
+        for batch in it:
+            assert batch.data[0].shape == (5, 4)
+            seen.append(batch.data[0].asnumpy()[0, 0])
+        assert it.provide_data == base.provide_data
+    assert seen == [0.0, 20.0, 0.0, 20.0]
