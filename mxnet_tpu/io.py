@@ -490,9 +490,78 @@ class MXDataIter(DataIter):
                          "mxnet_tpu.io_native")
 
 
-def ImageRecordIter(**kwargs):
-    from .io_native import ImageRecordIter as _impl
-    return _impl(**kwargs)
+def _build_rec_index(path_imgrec, path_idx):
+    """Scan a bare .rec once and write a key\toffset index so shuffling and
+    num_parts sharding work without a pre-built .idx (the reference's
+    chunk-shuffle reads bare .rec files too)."""
+    from . import recordio as _rio
+    reader = _rio.MXRecordIO(path_imgrec, "r")
+    with open(path_idx, "w") as f:
+        i = 0
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            f.write("%d\t%d\n" % (i, pos))
+            i += 1
+    reader.close()
+
+
+def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
+                    batch_size=1, label_width=1, shuffle=False,
+                    resize=0, rand_crop=False, rand_mirror=False,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=0.0, std_g=0.0, std_b=0.0,
+                    brightness=0.0, contrast=0.0, saturation=0.0,
+                    pca_noise=0.0, num_parts=1, part_index=0,
+                    data_name="data", label_name="softmax_label",
+                    seed=None, **kwargs):
+    """Image pipeline over packed .rec files (ref: ImageRecordIter2,
+    src/io/iter_image_recordio_2.cc — the reference's C++ decode/augment/
+    batch pipeline with its flat kwargs surface).  Decode runs through
+    cv2 on the host; records stream through the native recordio reader
+    with threaded prefetch (src/recordio.cc) when built.
+
+    Unrecognized reference knobs are accepted and ignored (the reference
+    has ~40; the load-bearing ones are mapped)."""
+    import numpy as np
+    from .image import CreateAugmenter, ImageIter
+
+    if data_shape is None:
+        raise MXNetError("ImageRecordIter requires data_shape")
+    data_shape = tuple(int(x) for x in data_shape)
+    if seed is not None:
+        # augmenters draw from the global RNGs (same as the reference's
+        # per-process default seeding)
+        import random as _pyrandom
+        _pyrandom.seed(int(seed))
+        np.random.seed(int(seed) & 0x7FFFFFFF)
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if std_r or std_g or std_b:
+        std = np.array([std_r or 1.0, std_g or 1.0, std_b or 1.0],
+                       np.float32)
+    if mean is not None and std is None:
+        std = np.array([1.0, 1.0, 1.0], np.float32)
+    if std is not None and mean is None:
+        mean = np.array([0.0, 0.0, 0.0], np.float32)  # std-only: still divide
+    if (shuffle or num_parts > 1) and path_imgrec and not path_imgidx:
+        # shuffling/sharding needs random access; build the index once
+        path_imgidx = path_imgrec + ".autoidx"
+        if not os.path.exists(path_imgidx):
+            _build_rec_index(path_imgrec, path_imgidx)
+    aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                          rand_mirror=rand_mirror, mean=mean, std=std,
+                          brightness=brightness, contrast=contrast,
+                          saturation=saturation, pca_noise=pca_noise)
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     label_width=label_width, path_imgrec=path_imgrec,
+                     path_imgidx=path_imgidx, shuffle=shuffle,
+                     part_index=part_index, num_parts=num_parts,
+                     aug_list=aug, data_name=data_name,
+                     label_name=label_name)
 
 
 def ImageRecordIter_v1(**kwargs):

@@ -111,7 +111,17 @@ class Predictor:
                     "reshapable" % (k, tuple(v.shape), new_shape))
             if new_shape == tuple(v.shape):
                 arg_params[k] = v
-        new._exe.copy_params_from(arg_params, dict(self._exe.aux_dict),
+        aux_params = {}
+        for k, v in self._exe.aux_dict.items():
+            new_shape = tuple(new._exe.aux_dict[k].shape) \
+                if k in new._exe.aux_dict else None
+            if new_shape is not None and new_shape != tuple(v.shape):
+                raise MXNetError(
+                    "MXPredReshape: aux state %r changes shape %s -> %s "
+                    "under the new input shapes; only batch-size changes "
+                    "are reshapable" % (k, tuple(v.shape), new_shape))
+            aux_params[k] = v
+        new._exe.copy_params_from(arg_params, aux_params,
                                   allow_extra_params=True)
         new._input_names = set(shape_kwargs)
         new._param_names = set(self._param_names)
