@@ -111,29 +111,40 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     if not use_pallas:
         return _reference_attention(q, k, v, causal, scale)
 
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    n_q = sq // block_q
-    n_kv = sk // block_k
 
     # layout: fold heads into batch, [BH, S, D]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
+    # dispatch through a jitted-callable cache: tracing a pallas_call is
+    # hundreds of ms of host work, so eager per-call tracing would swamp
+    # the kernel (measured 680 ms/call untraced vs 0.02 ms cached)
+    fn = _flash_jitted(b, h, sq, sk, d, str(jnp.dtype(q.dtype)), causal,
+                       float(scale), block_q, block_k, interpret)
+    out = fn(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.lru_cache(maxsize=512)
+def _flash_jitted(b, h, sq, sk, d, dtype, causal, scale, block_q, block_k,
+                  interpret):
+    n_q = sq // block_q
+    n_kv = sk // block_k
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, n_kv_blocks=n_kv)
 
-    # the framework enables jax x64 globally (float64 NDArray API parity);
-    # Mosaic rejects 64-bit types, so trace the kernel under 32-bit rules
-    with jax.enable_x64(False):
-        out = _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv,
-                          block_q, block_k, q.dtype, interpret)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    def run(qf, kf, vf):
+        # the framework enables jax x64 globally (float64 NDArray API
+        # parity); Mosaic rejects 64-bit types, so trace under 32-bit rules
+        with jax.enable_x64(False):
+            return _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv,
+                               block_q, block_k, jnp.dtype(dtype), interpret)
+
+    return jax.jit(run)
 
 
 def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
