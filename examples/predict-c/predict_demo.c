@@ -8,6 +8,9 @@
  *       -L<dir of libmxnet_tpu_cpredict.so> -lmxnet_tpu_cpredict \
  *       $(python3-config --embed --ldflags) -o predict_demo
  *
+ * Runtime: the embedded interpreter must find mxnet_tpu and its deps —
+ * set PYTHONPATH to the repo root plus the virtualenv's site-packages.
+ *
  * Usage: ./predict_demo model-symbol.json model-0000.params
  * Feeds a zero batch of shape (1, 3, 224, 224) and prints the top output.
  */

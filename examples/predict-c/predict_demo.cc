@@ -1,0 +1,59 @@
+/*
+ * C++ frontend demo (parity target: cpp-package examples).  Loads a
+ * checkpoint through include/mxnet_tpu/predictor.hpp and classifies a
+ * batch.  Build:
+ *   g++ -std=c++17 predict_demo.cc -I../../include \
+ *       -L<dir of libmxnet_tpu_cpredict.so> -lmxnet_tpu_cpredict \
+ *       -Wl,-rpath,<same dir> $(python3-config --embed --ldflags) \
+ *       -o predict_demo
+ * Runtime: the embedded interpreter must find mxnet_tpu and its deps —
+ * set PYTHONPATH to the repo root plus the virtualenv's site-packages.
+ *
+ * Usage: ./predict_demo symbol.json params N C [H W]
+ */
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <vector>
+
+#include "mxnet_tpu/predictor.hpp"
+
+static std::string slurp(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) { std::cerr << "cannot open " << path << "\n"; exit(1); }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    std::cerr << "usage: " << argv[0] << " symbol.json params N C [H W]\n";
+    return 1;
+  }
+  std::vector<mx_uint> shape;
+  for (int i = 3; i < argc; ++i) {
+    shape.push_back(static_cast<mx_uint>(std::stoul(argv[i])));
+  }
+  try {
+    mxnet_tpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                              {{"data", shape}});
+    mx_uint n = 1;
+    for (auto d : shape) n *= d;
+    std::vector<mx_float> input(n, 0.5f);
+    pred.set_input("data", input);
+    pred.forward();
+    auto out = pred.output(0);
+    auto oshape = pred.output_shape(0);
+    std::cout << "output shape:";
+    for (auto d : oshape) std::cout << " " << d;
+    mx_uint best = 0;
+    for (mx_uint i = 1; i < out.size(); ++i)
+      if (out[i] > out[best]) best = i;
+    std::cout << "  argmax=" << best << " p=" << out[best] << "\n";
+  } catch (const mxnet_tpu::Error &e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+  return 0;
+}

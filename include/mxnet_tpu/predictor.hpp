@@ -1,0 +1,136 @@
+/*
+ * Header-only C++ frontend over the C predict ABI (capability parity:
+ * cpp-package/include/mxnet-cpp — the reference's header-only C++ layer
+ * over its C API; this one covers the deployment surface).
+ *
+ * RAII + exceptions over MXPred*: load a checkpoint, feed float batches,
+ * read outputs.  Link against libmxnet_tpu_cpredict.so and the embedded
+ * Python runtime (see examples/predict-c/ for the link line).
+ */
+#ifndef MXNET_TPU_PREDICTOR_HPP_
+#define MXNET_TPU_PREDICTOR_HPP_
+
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace mxnet_tpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void check(int rc, const char *op) {
+  if (rc != 0) {
+    throw Error(std::string(op) + ": " + MXGetLastError());
+  }
+}
+
+/* Device selector matching the reference's DeviceType enum. */
+enum class Device : int { kCPU = 1, kTPU = 2 };
+
+class Predictor {
+ public:
+  /* symbol_json: contents of prefix-symbol.json; params: raw bytes of
+   * prefix-%04d.params; input_shapes: {"data": {N, C, H, W}, ...}. */
+  Predictor(const std::string &symbol_json, const std::string &params,
+            const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+            Device dev = Device::kCPU, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      shape_data.insert(shape_data.end(), kv.second.begin(),
+                        kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    check(MXPredCreate(symbol_json.c_str(), params.data(),
+                       static_cast<int>(params.size()),
+                       static_cast<int>(dev), dev_id,
+                       static_cast<mx_uint>(keys.size()), keys.data(),
+                       indptr.data(), shape_data.data(), &handle_),
+          "MXPredCreate");
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor &operator=(Predictor &&other) noexcept {
+    if (this != &other) {
+      free_();
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  ~Predictor() { free_(); }
+
+  void set_input(const std::string &name, const std::vector<mx_float> &data) {
+    check(MXPredSetInput(handle_, name.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())),
+          "MXPredSetInput");
+  }
+
+  void forward() { check(MXPredForward(handle_), "MXPredForward"); }
+
+  std::vector<mx_uint> output_shape(mx_uint index = 0) {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    check(MXPredGetOutputShape(handle_, index, &shape, &ndim),
+          "MXPredGetOutputShape");
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> output(mx_uint index = 0) {
+    auto shape = output_shape(index);
+    mx_uint size = std::accumulate(shape.begin(), shape.end(), mx_uint(1),
+                                   std::multiplies<mx_uint>());
+    std::vector<mx_float> out(size);
+    check(MXPredGetOutput(handle_, index, out.data(), size),
+          "MXPredGetOutput");
+    return out;
+  }
+
+  /* New predictor bound to new input shapes, sharing weights; this
+   * predictor stays valid with its old shapes. */
+  Predictor reshaped(
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      shape_data.insert(shape_data.end(), kv.second.begin(),
+                        kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    PredictorHandle out = nullptr;
+    check(MXPredReshape(handle_, static_cast<mx_uint>(keys.size()),
+                        keys.data(), indptr.data(), shape_data.data(), &out),
+          "MXPredReshape");
+    return Predictor(out);
+  }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+  void free_() {
+    if (handle_ != nullptr) {
+      MXPredFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  /* MXNET_TPU_PREDICTOR_HPP_ */

@@ -273,3 +273,57 @@ def test_c_predict_abi_reshape(tmp_path):
     assert run(h, 2) == (2, 3)   # old handle still bound to old shapes
     assert lib.MXPredFree(h) == 0
     assert lib.MXPredFree(h2) == 0
+
+
+def test_cpp_frontend_compiles_and_runs(tmp_path):
+    """Compile + run the header-only C++ frontend (predictor.hpp) as a real
+    external binary against a saved checkpoint (parity: cpp-package)."""
+    import subprocess
+    import sysconfig
+    from mxnet_tpu.io_native import get_cpredict_lib, _CPREDICT_PATH
+
+    if get_cpredict_lib() is None:
+        pytest.skip("C predict library unavailable")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # checkpoint artifacts
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=4, name="fc"), name="softmax")
+    rng = np.random.RandomState(0)
+    sym_path = os.path.join(str(tmp_path), "m-symbol.json")
+    net.save(sym_path)
+    pfile = os.path.join(str(tmp_path), "m-0000.params")
+    mx.nd.save(pfile, {
+        "arg:fc_weight": mx.nd.array(rng.rand(4, 6).astype(np.float32)),
+        "arg:fc_bias": mx.nd.array(rng.rand(4).astype(np.float32))})
+
+    exe = os.path.join(str(tmp_path), "demo")
+    libdir = os.path.dirname(_CPREDICT_PATH)
+    # derive embed link flags from the RUNNING interpreter (a PATH
+    # python3-config may be absent or belong to a different python)
+    libdir_py = sysconfig.get_config_var("LIBDIR") or ""
+    ldver = sysconfig.get_config_var("LDVERSION") or         sysconfig.get_config_var("VERSION")
+    if not ldver:
+        pytest.skip("cannot determine libpython link name")
+    ldflags = ["-L" + libdir_py, "-lpython" + ldver] +         (sysconfig.get_config_var("LIBS") or "").split() +         (sysconfig.get_config_var("SYSLIBS") or "").split()
+    cmd = ["g++", "-std=c++17",
+           os.path.join(repo, "examples", "predict-c", "predict_demo.cc"),
+           "-I" + os.path.join(repo, "include"),
+           "-I" + sysconfig.get_paths()["include"],
+           "-L" + libdir, "-lmxnet_tpu_cpredict",
+           "-Wl,-rpath," + libdir, "-o", exe] + ldflags
+    build = subprocess.run(cmd, capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    # the embedded interpreter needs the repo + venv on its module path
+    import site
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + site.getsitepackages() + [site.getusersitepackages()]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    run = subprocess.run([exe, sym_path, pfile, "2", "6"],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "output shape: 2 4" in run.stdout, run.stdout
+    assert "argmax=" in run.stdout
