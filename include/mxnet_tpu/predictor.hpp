@@ -10,6 +10,7 @@
 #ifndef MXNET_TPU_PREDICTOR_HPP_
 #define MXNET_TPU_PREDICTOR_HPP_
 
+#include <functional>
 #include <map>
 #include <numeric>
 #include <stdexcept>

@@ -52,6 +52,7 @@ from . import rnn
 from . import attribute
 from . import name
 from . import elastic
+from . import rtc
 from . import libinfo
 from . import contrib
 from . import kvstore_server

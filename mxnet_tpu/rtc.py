@@ -1,0 +1,116 @@
+"""Runtime kernel compilation (ref: include/mxnet/rtc.h + src/common/rtc.cc
+— user-supplied CUDA-C compiled via NVRTC into launchable kernels, exposed
+to Python as mx.rtc.CudaModule).
+
+TPU reinterpretation (SURVEY.md §2.1 RTC row): the runtime compiler is
+XLA, and the source language is jax-flavored Python (optionally Pallas for
+hand-scheduled kernels) instead of CUDA-C.  `CudaModule` executes the
+source in a namespace pre-loaded with jnp/jax/lax/pallas, `get_kernel`
+jit-compiles a named function, and `Kernel.launch` keeps the reference
+call shape — grid/block dims are accepted and ignored because XLA owns
+scheduling (documented, not silently wrong: they never change results).
+
+Example::
+
+    mod = mx.rtc.CudaModule('''
+    def axpy(a, x, y):
+        return a * x + y
+    ''')
+    k = mod.get_kernel("axpy", "float a, float* x, float* y, float* out")
+    k.launch((a, x, y), mx.cpu(), (1,1,1), (1,1,1), outputs=(out,))
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class Kernel:
+    """A compiled kernel (ref: CudaModule::Kernel, rtc.h:39-118)."""
+
+    def __init__(self, fn, name, signature):
+        self._fn = jax.jit(fn)
+        self.name = name
+        self.signature = signature
+
+    def launch(self, args, ctx=None, grid_dims=(1, 1, 1),
+               block_dims=(1, 1, 1), shared_mem=0, outputs=None):
+        """Run the kernel.  grid/block/shared_mem are accepted for call-site
+        parity and ignored — XLA schedules the compiled program.  `ctx`
+        places the results.  Results are written into `outputs` (NDArrays)
+        when given, else returned."""
+        vals = [a._h.array if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*vals)
+        dev = ctx.jax_device() if ctx is not None else None
+
+        def place(arr, dst_nd=None):
+            target = dst_nd._h.array.devices() if dst_nd is not None \
+                else ({dev} if dev is not None else None)
+            if target and arr.devices() != target:
+                arr = jax.device_put(arr, next(iter(target)))
+            return arr
+
+        if outputs is None:
+            if isinstance(out, tuple):
+                return tuple(NDArray(place(o)) for o in out)
+            return NDArray(place(out))
+        outs = out if isinstance(out, tuple) else (out,)
+        if len(outs) != len(outputs):
+            raise MXNetError(
+                "kernel %r produced %d outputs, launch got %d output "
+                "arrays" % (self.name, len(outs), len(outputs)))
+        for dst, src in zip(outputs, outs):
+            if tuple(dst.shape) != tuple(src.shape):
+                raise MXNetError(
+                    "kernel %r output shape %s does not match destination "
+                    "%s" % (self.name, tuple(src.shape), tuple(dst.shape)))
+            if src.dtype != dst._h.array.dtype:
+                src = src.astype(dst._h.array.dtype)
+            dst._h.array = place(src, dst_nd=dst)
+        return outputs
+
+
+class CudaModule:
+    """Runtime-compiled kernel module (ref: mx.rtc.CudaModule).
+
+    `source` is jax-flavored Python: top-level functions over jax arrays.
+    The namespace provides jnp, jax, lax, np and (when available) pallas
+    as pl / pltpu for hand-scheduled TPU kernels.
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        self.source = source
+        self.options = tuple(options)   # accepted for parity; no nvrtc here
+        self.exports = tuple(exports)
+        import numpy as np
+        ns = {"jnp": jnp, "jax": jax, "lax": jax.lax, "np": np}
+        try:
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+            ns["pl"] = pl
+            ns["pltpu"] = pltpu
+        except Exception:
+            pass
+        try:
+            exec(compile(source, "<mx.rtc source>", "exec"), ns)
+        except Exception as e:
+            # the reference surfaces nvrtc compile logs; same idea — any
+            # failure executing the module source is a compile failure
+            raise MXNetError("rtc compilation failed: %s: %s"
+                             % (type(e).__name__, e))
+        self._ns = ns
+
+    def get_kernel(self, name, signature=""):
+        fn = self._ns.get(name)
+        if not callable(fn):
+            raise MXNetError("kernel %r not found in rtc module "
+                             "(defined: %s)" % (
+                                 name,
+                                 [k for k, v in self._ns.items()
+                                  if callable(v) and not k.startswith("_")
+                                  and k not in ("jnp", "jax", "lax", "np",
+                                                "pl", "pltpu")]))
+        return Kernel(fn, name, signature)

@@ -156,3 +156,30 @@ def test_predictor_roundtrip():
                    {"data": (4, 6)})
     p2.forward(data=X[:4])
     np.testing.assert_allclose(p2.get_output(0).asnumpy(), ref, atol=1e-5)
+
+
+def test_rtc_module_kernel():
+    """Runtime kernel compilation (ref: mx.rtc.CudaModule / test_rtc.py —
+    CUDA-C via nvrtc there, jax-flavored source via XLA here)."""
+    mod = mx.rtc.CudaModule('''
+def axpy(a, x, y):
+    return a * x + y
+
+def split_stats(x):
+    return jnp.mean(x), jnp.max(x)
+''')
+    k = mod.get_kernel("axpy", "float a, float* x, float* y")
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    y = mx.nd.ones((6,))
+    out = mx.nd.zeros((6,))
+    k.launch((2.0, x, y), mx.cpu(), (1, 1, 1), (1, 1, 1), outputs=(out,))
+    assert np.allclose(out.asnumpy(), 2 * np.arange(6) + 1)
+    # return-style launch and multi-output
+    k2 = mod.get_kernel("split_stats")
+    mean, mx_ = k2.launch((x,), mx.cpu(), (1, 1, 1), (1, 1, 1))
+    assert np.isclose(float(mean.asnumpy()), 2.5)
+    assert float(mx_.asnumpy()) == 5.0
+    with pytest.raises(Exception):
+        mod.get_kernel("missing")
+    with pytest.raises(Exception):
+        mx.rtc.CudaModule("def broken(:\n  pass")
