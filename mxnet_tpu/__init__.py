@@ -7,6 +7,15 @@ XLA computation per graph instead of a per-op CUDA engine.
 """
 from __future__ import annotations
 
+# launcher bootstrap BEFORE anything can touch the XLA backend: scripts
+# started by tools/launch.py get JAX_COORDINATOR_ADDRESS/NUM_PROCESSES/
+# PROCESS_ID in the environment, and jax.distributed.initialize must run
+# before the first backend-creating call (the reference's analog is the
+# DMLC_* bootstrap at import, python/mxnet/__init__.py -> kvstore_server).
+# base.py imports no XLA-touching modules, so this ordering is safe.
+from .base import maybe_initialize_distributed_from_env as _minit
+_minit()
+
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 

@@ -33,6 +33,27 @@ def check_call(ok, msg=""):
 _logger = logging.getLogger("mxnet_tpu")
 
 
+def maybe_initialize_distributed_from_env():
+    """Bridge the launcher env protocol (tools/launch.py sets
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) to
+    jax.distributed.initialize.  Must run before anything creates an XLA
+    backend; no-op when the vars are absent/partial or already initialized.
+    The single shared implementation — called from package import and from
+    the dist kvstore (whichever comes first)."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if not (addr and nproc and pid) or int(nproc) <= 1:
+        return
+    import jax
+    from jax._src import distributed
+    if distributed.global_state.client is not None:
+        return
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=int(nproc),
+                               process_id=int(pid))
+
+
 def get_env(name, default=None, typ=str):
     """dmlc::GetEnv equivalent: typed environment config (ref: docs/faq/env_var.md)."""
     val = os.environ.get(name)

@@ -44,6 +44,8 @@ def _global_state():
     return distributed.global_state
 
 
+
+
 def _dist_devices():
     """ONE device per process from a backend that spans every process, or
     None when this is a single-process job.  Prefers the default backend
@@ -72,6 +74,9 @@ class DistKVStore(KVStore):
         self._psum_cache = {}
         self._devs = None
         self._devs_resolved = False
+        # launcher env bridge (shared impl; usually already ran at import)
+        from ..base import maybe_initialize_distributed_from_env
+        maybe_initialize_distributed_from_env()
         # localhost topology: cross-process CPU collectives need gloo,
         # selected before the cpu client is first created
         gs = _global_state()
@@ -90,14 +95,26 @@ class DistKVStore(KVStore):
             global _rendezvoused
             if not _rendezvoused:
                 _rendezvoused = True
+                aligned = True
                 try:
                     gs.client.wait_at_barrier("mxnet_tpu_kvstore_init",
                                               180_000)
                 except Exception:
+                    aligned = False
                     from ..base import _logger
                     _logger.warning(
                         "kvstore init rendezvous failed; first collective "
                         "may race peer startup")
+                # establish the collective context NOW, while workers are
+                # aligned: the first gloo context handshake has a ~30s
+                # window, and a large graph compiling on one worker before
+                # its first collective can exceed it under load — a tiny
+                # warm-up collective compiles in ~1s and later collectives
+                # reuse the context.  Skipped when rendezvous failed: the
+                # peers aren't aligned, so the handshake would hang here
+                # instead of at the app's first (possibly later) collective.
+                if aligned:
+                    self.barrier()
 
     @property
     def rank(self):

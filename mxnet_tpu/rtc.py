@@ -102,8 +102,12 @@ class CudaModule:
             raise MXNetError("rtc compilation failed: %s: %s"
                              % (type(e).__name__, e))
         self._ns = ns
+        self._kernels = {}  # name -> Kernel (shared jit cache per module)
 
     def get_kernel(self, name, signature=""):
+        cached = self._kernels.get(name)
+        if cached is not None:
+            return cached
         fn = self._ns.get(name)
         if not callable(fn):
             raise MXNetError("kernel %r not found in rtc module "
@@ -113,4 +117,6 @@ class CudaModule:
                                   if callable(v) and not k.startswith("_")
                                   and k not in ("jnp", "jax", "lax", "np",
                                                 "pl", "pltpu")]))
-        return Kernel(fn, name, signature)
+        kernel = Kernel(fn, name, signature)
+        self._kernels[name] = kernel
+        return kernel

@@ -97,3 +97,42 @@ def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out)
         assert "WORKER_OK" in out, out
+
+
+_LAUNCH_WORKER = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+kv.init("w", mx.nd.zeros((3,)))
+kv.push("w", mx.nd.ones((3,)) * (kv.rank + 1))
+out = mx.nd.zeros((3,))
+kv.pull("w", out=out)
+expect = sum(r + 1 for r in range(kv.num_workers))
+assert np.allclose(out.asnumpy(), expect), out.asnumpy()
+print("LAUNCHED_OK rank=%d/%d" % (kv.rank, kv.num_workers), flush=True)
+"""
+
+
+def test_tools_launch_local(tmp_path):
+    """`tools/launch.py -n 2 python worker.py` runs a dist_sync job with a
+    zero-config worker script (ref: tools/launch.py --launcher local, the
+    dmlc-tracker CI pattern, SURVEY.md §4.6): the launcher provides the
+    coordinator env, the package bootstraps jax.distributed at import."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(str(tmp_path), "worker.py")
+    with open(script, "w") as f:
+        f.write(_LAUNCH_WORKER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--port", str(_free_port()), "--",
+         sys.executable, script],
+        capture_output=True, text=True, timeout=280, env=env, cwd=repo)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out
+    assert out.count("LAUNCHED_OK") == 2, out
