@@ -388,8 +388,29 @@ class MNISTIter(DataIter):
                  flat=False, seed=0, silent=False, num_parts=1, part_index=0,
                  **kwargs):
         super().__init__(batch_size)
-        self._images = self._read_images(image)
-        self._labels = self._read_labels(label)
+
+        def _present(p):
+            return os.path.exists(p) or os.path.exists(p + ".gz")
+
+        if _present(image) and _present(label):
+            self._images = self._read_images(image)
+            self._labels = self._read_labels(label)
+        else:
+            # zero-egress fallback: the reference downloads MNIST on
+            # demand; without network, synthesize data in the same
+            # format/shapes so train_mnist-style scripts stay runnable.
+            # The warning is a correctness diagnostic (training runs on
+            # noise!), so it ignores `silent` — that flag only suppresses
+            # dataset chatter in the reference.
+            from .base import _logger
+            _logger.warning(
+                "MNIST files not found (%s / %s); using SYNTHETIC random "
+                "data — accuracy will be chance-level", image, label)
+            from .test_utils import get_mnist
+            data = get_mnist()
+            split = "train" if "train" in os.path.basename(image) else "test"
+            self._images = data["%s_data" % split][:, 0]
+            self._labels = data["%s_label" % split]
         if num_parts > 1:
             n = self._images.shape[0] // num_parts
             s = part_index * n

@@ -95,12 +95,10 @@ class DistKVStore(KVStore):
             global _rendezvoused
             if not _rendezvoused:
                 _rendezvoused = True
-                aligned = True
                 try:
                     gs.client.wait_at_barrier("mxnet_tpu_kvstore_init",
                                               180_000)
                 except Exception:
-                    aligned = False
                     from ..base import _logger
                     _logger.warning(
                         "kvstore init rendezvous failed; first collective "
@@ -110,11 +108,13 @@ class DistKVStore(KVStore):
                 # window, and a large graph compiling on one worker before
                 # its first collective can exceed it under load — a tiny
                 # warm-up collective compiles in ~1s and later collectives
-                # reuse the context.  Skipped when rendezvous failed: the
-                # peers aren't aligned, so the handshake would hang here
-                # instead of at the app's first (possibly later) collective.
-                if aligned:
-                    self.barrier()
+                # reuse the context.  Runs UNCONDITIONALLY: collectives
+                # pair by order across ranks, so gating it on the local
+                # rendezvous outcome could pair one rank's first real push
+                # with its peers' warm-up barrier; if peers truly diverged,
+                # gloo's own handshake timeout raises here rather than
+                # corrupting a later reduction.
+                self.barrier()
 
     @property
     def rank(self):

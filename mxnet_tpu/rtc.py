@@ -105,7 +105,7 @@ class CudaModule:
         self._kernels = {}  # name -> Kernel (shared jit cache per module)
 
     def get_kernel(self, name, signature=""):
-        cached = self._kernels.get(name)
+        cached = self._kernels.get((name, signature))
         if cached is not None:
             return cached
         fn = self._ns.get(name)
@@ -118,5 +118,5 @@ class CudaModule:
                                   and k not in ("jnp", "jax", "lax", "np",
                                                 "pl", "pltpu")]))
         kernel = Kernel(fn, name, signature)
-        self._kernels[name] = kernel
+        self._kernels[(name, signature)] = kernel
         return kernel

@@ -128,11 +128,19 @@ def test_tools_launch_local(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    run = subprocess.run(
+    # own process group so a timeout can kill the launcher AND its workers
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(repo, "tools", "launch.py"),
          "-n", "2", "--port", str(_free_port()), "--",
          sys.executable, script],
-        capture_output=True, text=True, timeout=280, env=env, cwd=repo)
-    out = run.stdout + run.stderr
-    assert run.returncode == 0, out
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, _ = proc.communicate(timeout=10)
+        raise AssertionError("launcher timed out; output:\n" + out)
+    assert proc.returncode == 0, out
     assert out.count("LAUNCHED_OK") == 2, out
