@@ -47,6 +47,23 @@ def _find(root, names):
         "egress — place the dataset files there manually" % (names, root))
 
 
+def _synthetic_fallback(shape_hw, channels, n_train, n_test, train,
+                        what, root, num_classes=10):
+    """Zero-egress fallback: the reference auto-downloads; here, when the
+    files are absent, synthesize uint8 images + labels in the real format
+    with a loud diagnostic (training on noise is chance-level)."""
+    from ....base import _logger
+    _logger.warning(
+        "%s files not found under %s; using SYNTHETIC random data — "
+        "accuracy will be chance-level", what, root)
+    rng = np.random.RandomState(42 if train else 43)
+    n = n_train if train else n_test
+    h, w = shape_hw
+    data = rng.randint(0, 256, (n, h, w, channels)).astype(np.uint8)
+    label = rng.randint(0, num_classes, n).astype(np.int32)
+    return data, label
+
+
 class MNIST(_DownloadedDataset):
     """MNIST from idx-ubyte files (ref: vision.py:MNIST)."""
 
@@ -64,10 +81,23 @@ class MNIST(_DownloadedDataset):
 
     def _get_data(self):
         img_name, lbl_name = self._files[self._train]
-        img_path = _find(self._root, [img_name, img_name + ".gz"])
-        lbl_path = _find(self._root, [lbl_name, lbl_name + ".gz"])
-        data = _read_idx_images(img_path)
-        label = _read_idx_labels(lbl_path)
+        present = [n for n in (img_name, lbl_name)
+                   if os.path.exists(os.path.join(self._root, n))
+                   or os.path.exists(os.path.join(self._root, n + ".gz"))]
+        if len(present) == 1:
+            # a PARTIAL dataset is a user mistake, not a missing download —
+            # keep the actionable error instead of silently using noise
+            raise FileNotFoundError(
+                "found %s but not its counterpart under %s; place both "
+                "files there" % (present[0], self._root))
+        if present:
+            img_path = _find(self._root, [img_name, img_name + ".gz"])
+            lbl_path = _find(self._root, [lbl_name, lbl_name + ".gz"])
+            data = _read_idx_images(img_path)
+            label = _read_idx_labels(lbl_path)
+        else:
+            data, label = _synthetic_fallback(
+                (28, 28), 1, 2048, 512, self._train, self._base, self._root)
         self._data = nd_array(data, dtype=np.uint8)
         self._label = label
 
@@ -96,18 +126,36 @@ class CIFAR10(_DownloadedDataset):
         data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
         return data, np.asarray(d[self._label_key], np.int32)
 
+    # synthetic-fallback class count (CIFAR100 overrides)
+    _num_classes = 10
+
     def _get_data(self):
         names = self._train_batches if self._train else self._test_batches
         base = self._root
         if os.path.isdir(os.path.join(base, self._prefix)):
             base = os.path.join(base, self._prefix)
-        datas, labels = [], []
-        for name in names:
-            d, l = self._read_batch(_find(base, [name]))
-            datas.append(d)
-            labels.append(l)
-        self._data = nd_array(np.concatenate(datas), dtype=np.uint8)
-        self._label = np.concatenate(labels)
+        present = [n for n in names
+                   if os.path.exists(os.path.join(base, n))]
+        if present and len(present) < len(names):
+            # partial dataset: user mistake — keep the actionable error
+            missing = sorted(set(names) - set(present))
+            raise FileNotFoundError(
+                "found %s but missing %s under %s; place all batch files "
+                "there" % (present, missing, base))
+        if present:
+            datas, labels = [], []
+            for name in names:
+                d, l = self._read_batch(_find(base, [name]))
+                datas.append(d)
+                labels.append(l)
+            data = np.concatenate(datas)
+            label = np.concatenate(labels)
+        else:
+            data, label = _synthetic_fallback(
+                (32, 32), 3, 2048, 512, self._train, self._prefix,
+                self._root, num_classes=self._num_classes)
+        self._data = nd_array(data, dtype=np.uint8)
+        self._label = label
 
 
 class CIFAR100(CIFAR10):
@@ -118,6 +166,7 @@ class CIFAR100(CIFAR10):
     def __init__(self, root=None, fine_label=True, train=True,
                  transform=None):
         self._label_key = b"fine_labels" if fine_label else b"coarse_labels"
+        self._num_classes = 100 if fine_label else 20
         root = root or os.path.join(os.path.expanduser("~"), ".mxnet",
                                     "datasets", "cifar100")
         super().__init__(root=root, train=train, transform=transform)

@@ -98,3 +98,28 @@ def test_augmenters():
     arr = out.asnumpy() if isinstance(out, mx.nd.NDArray) else out
     assert arr.shape == (16, 16, 3)
     assert np.isfinite(arr).all()
+
+
+def test_vision_dataset_synthetic_fallback(tmp_path):
+    """Missing datasets synthesize data loudly; PARTIAL datasets raise an
+    actionable error; CIFAR100 fallback labels span its real class count."""
+    from mxnet_tpu.gluon.data.vision import CIFAR10, CIFAR100, MNIST
+
+    ds = CIFAR10(root=str(tmp_path / "none"), train=False)
+    assert len(ds) == 512
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+
+    c100 = CIFAR100(root=str(tmp_path / "none2"), train=True)
+    labels = {int(c100[i][1]) for i in range(0, 2048, 7)}
+    assert max(labels) > 9  # 100-class fallback, not 10
+
+    m = MNIST(root=str(tmp_path / "none3"), train=True)
+    assert m[0][0].shape == (28, 28, 1)
+
+    # partial dataset: actionable error, not silent noise
+    part = tmp_path / "partial"
+    part.mkdir()
+    (part / "train-images-idx3-ubyte").write_bytes(b"")
+    with pytest.raises(FileNotFoundError, match="counterpart"):
+        MNIST(root=str(part), train=True)
