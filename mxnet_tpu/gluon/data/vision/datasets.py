@@ -1,9 +1,11 @@
 """Vision datasets (parity: python/mxnet/gluon/data/vision.py).
 
 This environment has no network egress, so datasets read the standard file
-formats from a local root (default ~/.mxnet/datasets/<name>) and raise a
-clear error when absent — the reference's auto-download becomes
-place-the-files-here.
+formats from a local root (default ~/.mxnet/datasets/<name>).  When the
+root holds NO files, they substitute synthetic data with a loud
+chance-level warning (keeping example scripts runnable); a PARTIAL
+dataset — some files present, some missing — raises an actionable error,
+since that is a copy mistake rather than a missing download.
 """
 from __future__ import annotations
 
@@ -49,19 +51,11 @@ def _find(root, names):
 
 def _synthetic_fallback(shape_hw, channels, n_train, n_test, train,
                         what, root, num_classes=10):
-    """Zero-egress fallback: the reference auto-downloads; here, when the
-    files are absent, synthesize uint8 images + labels in the real format
-    with a loud diagnostic (training on noise is chance-level)."""
-    from ....base import _logger
-    _logger.warning(
-        "%s files not found under %s; using SYNTHETIC random data — "
-        "accuracy will be chance-level", what, root)
-    rng = np.random.RandomState(42 if train else 43)
-    n = n_train if train else n_test
-    h, w = shape_hw
-    data = rng.randint(0, 256, (n, h, w, channels)).astype(np.uint8)
-    label = rng.randint(0, num_classes, n).astype(np.int32)
-    return data, label
+    from ....test_utils import synthetic_image_dataset
+    return synthetic_image_dataset(
+        shape_hw, channels, n_train if train else n_test,
+        num_classes=num_classes, seed=42 if train else 43,
+        what=what, root=root)
 
 
 class MNIST(_DownloadedDataset):

@@ -395,22 +395,25 @@ class MNISTIter(DataIter):
         if _present(image) and _present(label):
             self._images = self._read_images(image)
             self._labels = self._read_labels(label)
+        elif _present(image) or _present(label):
+            # partial dataset: a copy mistake, not a missing download
+            raise MXNetError(
+                "MNIST files partially present (%s / %s); place both "
+                "files there" % (image, label))
         else:
             # zero-egress fallback: the reference downloads MNIST on
             # demand; without network, synthesize data in the same
             # format/shapes so train_mnist-style scripts stay runnable.
-            # The warning is a correctness diagnostic (training runs on
-            # noise!), so it ignores `silent` — that flag only suppresses
-            # dataset chatter in the reference.
-            from .base import _logger
-            _logger.warning(
-                "MNIST files not found (%s / %s); using SYNTHETIC random "
-                "data — accuracy will be chance-level", image, label)
-            from .test_utils import get_mnist
-            data = get_mnist()
-            split = "train" if "train" in os.path.basename(image) else "test"
-            self._images = data["%s_data" % split][:, 0]
-            self._labels = data["%s_label" % split]
+            # The loud warning lives in the shared helper and ignores
+            # `silent` — that flag only suppresses dataset chatter.
+            from .test_utils import synthetic_image_dataset
+            train = "train" in os.path.basename(image)
+            data, labels = synthetic_image_dataset(
+                (28, 28), 1, 2048 if train else 512,
+                seed=42 if train else 43, what="mnist",
+                root=os.path.dirname(image) or ".")
+            self._images = data[:, :, :, 0].astype(np.float32) / 255.0
+            self._labels = labels.astype(np.float32)
         if num_parts > 1:
             n = self._images.shape[0] // num_parts
             s = part_index * n

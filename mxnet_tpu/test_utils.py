@@ -425,6 +425,23 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
     return gt
 
 
+def synthetic_image_dataset(shape_hw, channels, n, num_classes=10, seed=42,
+                            what="dataset", root="<unset>"):
+    """Canonical zero-egress dataset fallback: uint8 images + int labels in
+    the real file format's shapes, announced with a LOUD warning (training
+    on noise is chance-level).  Single source for MNISTIter, the gluon
+    vision datasets, and get_mnist — sizes/seeds/warning live here only."""
+    from .base import _logger
+    _logger.warning(
+        "%s files not found under %s; using SYNTHETIC random data — "
+        "accuracy will be chance-level", what, root)
+    rng = np.random.RandomState(seed)
+    h, w = shape_hw
+    data = rng.randint(0, 256, (n, h, w, channels)).astype(np.uint8)
+    label = rng.randint(0, num_classes, n).astype(np.int32)
+    return data, label
+
+
 def get_mnist(path=None):
     """Synthetic MNIST-format data when the real dataset is unavailable
     (zero-egress environment); shapes and dtypes match the real one."""

@@ -1,0 +1,108 @@
+// Native engine concurrency stress, built for ThreadSanitizer.
+//
+// The reference stresses its threaded engine from many pusher threads
+// (tests/cpp/engine/threaded_engine_test.cc) but ships no sanitizer CI;
+// SURVEY.md §5.2 commits this framework to real TSAN coverage for its
+// fresh C++.  This binary hammers the engine's three ordering contracts —
+// writer exclusivity, reader concurrency, wait_for_all quiescence — from
+// multiple host threads; any data race aborts under
+// TSAN_OPTIONS=halt_on_error=1.
+//
+// Build (see tests/test_native.py::test_engine_tsan_stress):
+//   g++ -std=c++17 -fsanitize=thread -O1 -pthread \
+//       src/engine.cc tests/cpp/engine_stress.cc -o engine_stress
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void *engine_create(int num_workers);
+void engine_destroy(void *e);
+int64_t engine_new_var(void *e);
+void engine_push(void *e, void (*fn)(void *), void *arg,
+                 const int64_t *reads, int n_reads, const int64_t *writes,
+                 int n_writes);
+void engine_wait_for_var(void *e, int64_t var);
+void engine_wait_for_all(void *e);
+}
+
+namespace {
+
+// shared counters: exclusively-written under the engine's write deps, so
+// plain (non-atomic) access is intentional — TSAN proves the engine
+// serializes them
+int64_t counters[4] = {0, 0, 0, 0};
+std::atomic<int64_t> reader_sum{0};
+
+struct Task {
+  int idx;
+};
+
+void writer_fn(void *arg) {
+  auto *t = static_cast<Task *>(arg);
+  counters[t->idx] += 1;  // must be serialized per var by the engine
+  delete t;
+}
+
+void reader_fn(void *arg) {
+  auto *t = static_cast<Task *>(arg);
+  // concurrent readers of the same var are allowed; the value must be
+  // stable while readers run (no writer interleaves)
+  reader_sum.fetch_add(counters[t->idx], std::memory_order_relaxed);
+  delete t;
+}
+
+}  // namespace
+
+int main() {
+  void *eng = engine_create(4);
+  int64_t vars[4];
+  for (int i = 0; i < 4; ++i) vars[i] = engine_new_var(eng);
+
+  constexpr int kThreads = 8;
+  constexpr int kOpsPerThread = 500;
+  std::vector<std::thread> pushers;
+  for (int t = 0; t < kThreads; ++t) {
+    pushers.emplace_back([eng, &vars, t] {
+      for (int i = 0; i < kOpsPerThread; ++i) {
+        int v = (t + i) % 4;
+        int64_t wlist[1] = {vars[v]};
+        int64_t rlist[1] = {vars[(v + 1) % 4]};
+        if (i % 3 == 0) {
+          // pure reader: read-dep on the var it loads
+          engine_push(eng, reader_fn, new Task{(v + 1) % 4}, rlist, 1,
+                      nullptr, 0);
+        } else {
+          engine_push(eng, writer_fn, new Task{v}, rlist, 1, wlist, 1);
+        }
+      }
+    });
+  }
+  for (auto &th : pushers) th.join();
+  engine_wait_for_all(eng);
+
+  // every writer ran exactly once, serialized: totals must match pushes
+  int64_t total = 0;
+  for (int i = 0; i < 4; ++i) total += counters[i];
+  int64_t expected = 0;
+  for (int t = 0; t < kThreads; ++t) {
+    for (int i = 0; i < kOpsPerThread; ++i) {
+      if (i % 3 != 0) ++expected;
+    }
+  }
+  if (total != expected) {
+    std::fprintf(stderr, "lost updates: got %lld want %lld\n",
+                 static_cast<long long>(total),
+                 static_cast<long long>(expected));
+    return 2;
+  }
+  engine_destroy(eng);
+  std::printf("ENGINE_TSAN_STRESS_OK total=%lld readers=%lld\n",
+              static_cast<long long>(total),
+              static_cast<long long>(reader_sum.load()));
+  return 0;
+}
