@@ -446,12 +446,68 @@ def _invoke(op_name, inputs, attrs, out=None):
         nattrs[op.key_var_num_args] = len(inputs)
     if op.takes_train_flag:
         nattrs["_train"] = ag.is_training()
+    # sparse dispatch (FComputeEx analog / storage fallback, ref:
+    # imperative_utils.h dispatch-mode selection + exec_utils.h fallback)
+    stypes = [getattr(i, "stype", "default") for i in inputs]
+    if any(s != "default" for s in stypes):
+        outs = NotImplemented
+        # the Ex path is taken only when the storage-type combination
+        # matches the op's declared pattern — the reference's FComputeEx
+        # dispatch checks the full stype tuple the same way; an impl may
+        # also decline (NotImplemented) after inspecting attrs
+        # (e.g. lazy_update=False wants dense weight-decay semantics)
+        if op.sparse_impl is not None and (
+                op.sparse_pattern is None
+                or tuple(stypes) == tuple(op.sparse_pattern[:len(stypes)])):
+            outs = op.sparse_impl(inputs, nattrs)
+        if outs is NotImplemented:
+            # storage fallback: densify read-only sparse inputs; a MUTATED
+            # sparse input would silently lose its update, so that's an
+            # error rather than a wrong answer
+            for idx in op.mutate_map:
+                if idx < len(inputs) and stypes[idx] != "default":
+                    raise MXNetError(
+                        "%s: input %d is %s storage and would be mutated; "
+                        "no sparse implementation applies"
+                        % (op.name, idx, inputs[idx].stype))
+            _warn_storage_fallback(op.name)
+            inputs = [i.todense() if s != "default" else i
+                      for i, s in zip(inputs, stypes)]
+            return _invoke_dense(op, inputs, nattrs, ctx_attr, out)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        # sparse-path ops (optimizer updates) are not differentiable
+        # through the tape; record=False keeps them off it explicitly
+        return _finish_invoke(op, nattrs, inputs, outs, ctx_attr, out,
+                              key=None, record=False)
+    return _invoke_dense(op, inputs, nattrs, ctx_attr, out)
+
+
+_STORAGE_FALLBACK_WARNED = set()
+
+
+def _warn_storage_fallback(name):
+    if name not in _STORAGE_FALLBACK_WARNED:
+        _STORAGE_FALLBACK_WARNED.add(name)
+        from ..base import _logger
+        _logger.info("op %s has no sparse implementation; falling back to "
+                     "dense storage (ref: storage fallback)", name)
+
+
+def _invoke_dense(op, inputs, nattrs, ctx_attr, out):
     raw = [i._h.array for i in inputs]
     key = None
     if op.needs_rng:
         key = _random.next_key()
         raw = [key] + raw
     outs = apply_op(op, raw, nattrs)
+    return _finish_invoke(op, nattrs, inputs, outs, ctx_attr, out,
+                          key=key, record=True)
+
+
+def _finish_invoke(op, nattrs, inputs, outs, ctx_attr, out, key, record):
+    """Shared tail of both dispatch paths: split visible outputs from state
+    outputs, rebind mutated handles, tape-record, honor out=."""
     n_vis = op.str_outputs(nattrs)
     vis, extra = list(outs[:n_vis]), outs[n_vis:]
     # state updates (optimizer mom/var, BatchNorm moving stats)
@@ -462,7 +518,7 @@ def _invoke(op_name, inputs, attrs, out=None):
         dev = _parse_ctx_attr(ctx_attr).jax_device()
         vis = [jax.device_put(v, dev) for v in vis]
     out_nds = [NDArray(v) for v in vis]
-    if ag.is_recording():
+    if record and ag.is_recording():
         ag.record_op(op, nattrs, inputs, [i._h.array for i in inputs],
                      out_nds, key)
     if out is not None:

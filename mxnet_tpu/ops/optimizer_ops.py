@@ -31,7 +31,28 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight - lr * (g + wd * weight)
 
 
+def _rsp_grad(inputs, grad_idx=1):
+    """(rows, grad_rows) of a row_sparse gradient input."""
+    g = inputs[grad_idx]
+    return (g.indices._h.array.astype(jnp.int32), g.data._h.array)
+
+
+def _sgd_update_sparse(inputs, attrs):
+    """Lazy row_sparse SGD (ref: sgd_update FComputeEx,
+    optimizer_op-inl.h SGDUpdateRspImpl): only rows present in the
+    gradient are touched — the embedding-training fast path."""
+    if not attrs.get("lazy_update", True):
+        return NotImplemented  # dense semantics requested: fall back
+    w = inputs[0]._h.array
+    rows, g = _rsp_grad(inputs)
+    g = _clip(g * attrs["rescale_grad"], attrs["clip_gradient"])
+    wr = w[rows]
+    return w.at[rows].set(wr - attrs["lr"] * (g + attrs["wd"] * wr))
+
+
 register("sgd_update", _sgd_update, num_inputs=2,
+         sparse_impl=_sgd_update_sparse,
+         sparse_pattern=("default", "row_sparse"),
          params=dict(_COMMON, lazy_update=(pBool, True)))
 
 
@@ -42,7 +63,25 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight + new_mom, new_mom
 
 
+def _sgd_mom_update_sparse(inputs, attrs):
+    """Lazy row_sparse momentum SGD: momentum decays/updates only at rows
+    present in the gradient (reference lazy_update=True semantics)."""
+    if not attrs.get("lazy_update", True):
+        return NotImplemented
+    w = inputs[0]._h.array
+    mom = inputs[2]._h.array
+    rows, g = _rsp_grad(inputs)
+    g = _clip(g * attrs["rescale_grad"], attrs["clip_gradient"])
+    wr = w[rows]
+    new_mom_rows = attrs["momentum"] * mom[rows] \
+        - attrs["lr"] * (g + attrs["wd"] * wr)
+    return (w.at[rows].set(wr + new_mom_rows),
+            mom.at[rows].set(new_mom_rows))
+
+
 register("sgd_mom_update", _sgd_mom_update, num_inputs=3, mutate_map=(2,),
+         sparse_impl=_sgd_mom_update_sparse,
+         sparse_pattern=("default", "row_sparse", "default"),
          params=dict(_COMMON, momentum=(pFloat, 0.0), lazy_update=(pBool, True)))
 
 
@@ -80,7 +119,30 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return new_w, new_mean, new_var
 
 
+def _adam_update_sparse(inputs, attrs):
+    """Lazy row_sparse Adam (ref: AdamUpdateRspImpl): moments update only
+    at gradient rows."""
+    if not attrs.get("lazy_update", True):
+        return NotImplemented
+    w = inputs[0]._h.array
+    mean = inputs[2]._h.array
+    var = inputs[3]._h.array
+    rows, g = _rsp_grad(inputs)
+    wr = w[rows]
+    g = _clip(g * attrs["rescale_grad"], attrs["clip_gradient"]) \
+        + attrs["wd"] * wr
+    new_mean_r = attrs["beta1"] * mean[rows] + (1 - attrs["beta1"]) * g
+    new_var_r = attrs["beta2"] * var[rows] \
+        + (1 - attrs["beta2"]) * jnp.square(g)
+    new_w_r = wr - attrs["lr"] * new_mean_r \
+        / (jnp.sqrt(new_var_r) + attrs["epsilon"])
+    return (w.at[rows].set(new_w_r), mean.at[rows].set(new_mean_r),
+            var.at[rows].set(new_var_r))
+
+
 register("adam_update", _adam_update, num_inputs=4, mutate_map=(2, 3),
+         sparse_impl=_adam_update_sparse,
+         sparse_pattern=("default", "row_sparse", "default", "default"),
          params=dict(_COMMON, lr=(pFloat, 0.001), beta1=(pFloat, 0.9),
                      beta2=(pFloat, 0.999), epsilon=(pFloat, 1e-8),
                      lazy_update=(pBool, True)))

@@ -87,6 +87,7 @@ class Op:
                  infer_shape=None, infer_type=None, needs_rng=False,
                  mutate_map=(), input_names=None, aux_names=(),
                  takes_train_flag=False, bidirectional_infer=False,
+                 sparse_impl=None, sparse_pattern=None,
                  key_var_num_args=None, aliases=(), doc=""):
         self.name = name
         self.impl = impl
@@ -100,6 +101,15 @@ class Op:
         # infer_shape additionally accepts current output shapes as a third
         # argument for backward out->in inference (declared, not introspected)
         self.bidirectional_infer = bidirectional_infer
+        # FComputeEx analog (op_attr_types.h:FComputeEx): called with the
+        # NDArray-level inputs (so it can reach .indices/.data of sparse
+        # storage) when any input is sparse; returns raw arrays like impl.
+        # Ops without one fall back to densified inputs (the reference's
+        # storage-fallback path, src/common/exec_utils.h).
+        self.sparse_impl = sparse_impl
+        # declared stype tuple the sparse_impl handles, e.g.
+        # ("default", "row_sparse", "default"); None = impl checks itself
+        self.sparse_pattern = sparse_pattern
         self.needs_rng = needs_rng
         # trailing impl outputs (beyond the visible num_outputs) rebind these
         # input indices — in-place state updates (optimizer mom, BatchNorm

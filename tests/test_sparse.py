@@ -80,3 +80,63 @@ def test_cast_storage():
         assert s.stype == stype
         back = sparse.cast_storage(s, "default")
         np.testing.assert_allclose(back.asnumpy(), d)
+
+
+def test_sparse_optimizer_updates():
+    """row_sparse gradients drive lazy optimizer updates (ref: FComputeEx
+    SGDUpdateRspImpl/AdamUpdateRspImpl — only gradient rows are touched)."""
+    def rsp(rows, vals, shape):
+        data = np.zeros((len(rows),) + shape[1:], np.float32) + vals
+        return mx.nd.sparse.row_sparse_array((data, rows), shape=shape)
+
+    # sgd: untouched rows keep their value even with wd > 0 (lazy)
+    w = mx.nd.ones((4, 3))
+    g = rsp([0, 2], 1.0, (4, 3))
+    new_w = mx.nd.sgd_update(w, g, lr=0.1, wd=0.1)
+    out = new_w.asnumpy()
+    assert np.allclose(out[[1, 3]], 1.0)                  # untouched
+    assert np.allclose(out[[0, 2]], 1 - 0.1 * (1 + 0.1))  # updated
+
+    # momentum: state changes only at gradient rows
+    w = mx.nd.ones((4, 3))
+    mom = mx.nd.zeros((4, 3))
+    new_w = mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert np.allclose(mom.asnumpy()[[1, 3]], 0.0)
+    assert np.allclose(mom.asnumpy()[[0, 2]], -0.1)
+    assert np.allclose(new_w.asnumpy()[[0, 2]], 0.9)
+
+    # adam: moments update only at rows; dense result matches dense math
+    w = mx.nd.ones((4, 3))
+    mean = mx.nd.zeros((4, 3))
+    var = mx.nd.zeros((4, 3))
+    new_w = mx.nd.adam_update(w, g, mean, var, lr=0.01)
+    assert np.allclose(mean.asnumpy()[[1, 3]], 0.0)
+    assert (np.abs(mean.asnumpy()[[0, 2]]) > 0).all()
+    assert np.allclose(new_w.asnumpy()[[1, 3]], 1.0)
+
+
+def test_sparse_storage_fallback():
+    """Ops without a sparse implementation densify read-only sparse inputs
+    (ref: storage fallback, exec_utils.h); mutated sparse inputs raise."""
+    g = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), [0, 2]), shape=(4, 3))
+    assert float(mx.nd.sum(g).asnumpy()) == 6.0
+    r = mx.nd.elemwise_add(g, g)
+    assert r.shape == (4, 3)
+    assert float(r.asnumpy()[0, 0]) == 2.0
+
+
+def test_sparse_optimizer_dense_semantics_on_lazy_false():
+    """lazy_update=False requests reference dense semantics: ALL rows decay
+    every step (the sparse impl declines and the grad densifies)."""
+    def rsp(rows, shape):
+        data = np.ones((len(rows),) + shape[1:], np.float32)
+        return mx.nd.sparse.row_sparse_array((data, rows), shape=shape)
+
+    w = mx.nd.ones((4, 3))
+    g = rsp([0, 2], (4, 3))
+    new_w = mx.nd.sgd_update(w, g, lr=0.1, wd=0.1, lazy_update=False)
+    out = new_w.asnumpy()
+    # rows WITHOUT gradient still decay under dense semantics
+    assert np.allclose(out[[1, 3]], 1 - 0.1 * 0.1)
+    assert np.allclose(out[[0, 2]], 1 - 0.1 * (1 + 0.1))
