@@ -199,10 +199,16 @@ class Executor:
                 raise MXNetError("unknown argument %r" % k)
             dst = self.arg_dict[k]
             src = v._h.array if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
-            dst._h.array = src.astype(dst._h.array.dtype) \
-                if src.dtype != dst._h.array.dtype else src
-        arg_vals = [self.arg_dict[n]._h.array for n in self._prog.arg_names]
-        aux_vals = [self.aux_dict[n]._h.array for n in self._prog.aux_names]
+            if src.dtype != dst._h.array.dtype:
+                src = src.astype(dst._h.array.dtype)
+            dev = next(iter(dst._h.array.devices()), None)
+            if dev is not None and src.devices() != {dev}:
+                src = jax.device_put(src, dev)  # keep group2ctx placement
+            dst._h.array = src
+        arg_vals = self._gather([self.arg_dict[n]._h.array
+                                 for n in self._prog.arg_names])
+        aux_vals = self._gather([self.aux_dict[n]._h.array
+                                 for n in self._prog.aux_names])
         keys = tuple(_random.next_key() for _ in range(self._n_keys))
         self._last_keys = keys
 
@@ -233,7 +239,11 @@ class Executor:
                     arg_vals, aux_vals, keys, bool(is_train))
         if is_train:
             for n, v in zip(self._prog.aux_names, new_aux):
-                self.aux_dict[n]._h.array = v
+                buf = self.aux_dict[n]
+                dev = next(iter(buf._h.array.devices()), None)
+                if dev is not None and v.devices() != {dev}:
+                    v = jax.device_put(v, dev)  # aux stays on its group ctx
+                buf._h.array = v
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
@@ -250,17 +260,32 @@ class Executor:
                           for g, o in zip(out_grads, self.outputs)]
         if not self._grad_names:
             return
-        arg_vals = [self.arg_dict[n]._h.array for n in self._prog.arg_names]
-        aux_vals = [self.aux_dict[n]._h.array for n in self._prog.aux_names]
+        arg_vals = self._gather([self.arg_dict[n]._h.array
+                                 for n in self._prog.arg_names])
+        aux_vals = self._gather([self.aux_dict[n]._h.array
+                                 for n in self._prog.aux_names])
         keys = self._last_keys or tuple(_random.next_key()
                                         for _ in range(self._n_keys))
         grads = self._bwd_jit(arg_vals, aux_vals, keys, head_grads)
         for n, g in zip(self._grad_names, grads):
             buf = self.grad_dict[n]
+            dev = next(iter(buf._h.array.devices()), None)
+            if dev is not None and g.devices() != {dev}:
+                g = jax.device_put(g, dev)  # grads stay on their group ctx
             if self._grad_req[n] == "add":
                 buf._h.array = buf._h.array + g.astype(buf._h.array.dtype)
             else:
                 buf._h.array = g.astype(buf._h.array.dtype)
+
+    def _gather(self, vals):
+        """Cross-device copy to the executor's device (ref: the
+        _CrossDeviceCopy nodes PlaceDevice inserts, graph_executor.cc:406):
+        group2ctx places arg STORAGE on per-group devices; the jitted
+        program computes on the bind ctx, so inputs gather here.  No-op in
+        the single-device common case."""
+        dev = self._ctx.jax_device()
+        return [v if v.devices() == {dev} else jax.device_put(v, dev)
+                for v in vals]
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
@@ -299,13 +324,34 @@ class Executor:
 
     # -- binding classmethods -------------------------------------------------
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     group2ctx=None):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
         type_dict = dict(type_dict or {})
         arg_types, _, aux_types = symbol.infer_type(**{
             k: v for k, v in type_dict.items()})
+        # manual model parallelism (ref: ctx_group attr + PlaceDevice,
+        # graph_executor.cc:406): arg STORAGE follows its group's device;
+        # compute stays one XLA program (per-op placement is the
+        # compiler's job here — real multi-device compute lives in
+        # mxnet_tpu.parallel), so this preserves the observable contract
+        # scripts rely on: each group's params live on its device.
+        ctx_of = {}
+        if group2ctx:
+            for node in symbol._topo():
+                grp = node.attrs.get("__ctx_group__") \
+                    or node.attrs.get("ctx_group")
+                if not grp or grp not in group2ctx:
+                    continue
+                if node.is_var:
+                    ctx_of[node.name] = group2ctx[grp]
+                else:
+                    # an op's auto-created weights belong to its group
+                    for src, _ in node.inputs:
+                        if src.is_var:
+                            ctx_of.setdefault(src.name, group2ctx[grp])
         arg_dict, grad_dict, aux_dict = {}, {}, {}
         if isinstance(grad_req, str):
             req_of = {n: grad_req for n in arg_names}
@@ -315,12 +361,13 @@ class Executor:
             req_of = {n: grad_req.get(n, "null") for n in arg_names}
         for name, shape, dt in zip(arg_names, arg_shapes, arg_types):
             dt = np_dtype(type_dict.get(name, dt or np.float32))
-            arg_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+            a_ctx = ctx_of.get(name, ctx)
+            arg_dict[name] = nd_zeros(shape, a_ctx, dtype=dt)
             if req_of.get(name, "null") != "null":
-                grad_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+                grad_dict[name] = nd_zeros(shape, a_ctx, dtype=dt)
         for name, shape, dt in zip(aux_names, aux_shapes, aux_types):
             dt = np_dtype(type_dict.get(name, dt or np.float32))
-            aux_dict[name] = nd_zeros(shape, ctx, dtype=dt)
+            aux_dict[name] = nd_zeros(shape, ctx_of.get(name, ctx), dtype=dt)
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req_of)
 
     @staticmethod

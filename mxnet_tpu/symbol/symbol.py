@@ -567,7 +567,7 @@ class Symbol:
                     shared_buffer=None, **kwargs):
         from ..executor import Executor
         return Executor._simple_bind(self, ctx or current_context(), grad_req,
-                                     type_dict, kwargs)
+                                     type_dict, kwargs, group2ctx=group2ctx)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

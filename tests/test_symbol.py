@@ -125,3 +125,38 @@ def test_infer_shape_error():
     # partial succeeds
     arg_shapes, out_shapes, _ = net.infer_shape_partial()
     assert out_shapes[0] is None
+
+
+def test_group2ctx_model_parallel_placement():
+    """Manual model parallelism (ref: ctx_group attr + PlaceDevice,
+    SURVEY.md §2.5.3): each group's params/grads live on its device; the
+    jitted program gathers at the bind ctx (the _CrossDeviceCopy analog)."""
+    import jax
+    cpus = jax.local_devices(backend="cpu")
+    if len(cpus) < 3:
+        pytest.skip("needs 3 virtual devices")
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                  name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        b = mx.sym.FullyConnected(a, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(b, name="softmax")
+    exe = net.simple_bind(mx.cpu(0), data=(2, 3),
+                          group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    assert exe.arg_dict["fc1_weight"]._h.array.devices() == {cpus[1]}
+    assert exe.arg_dict["fc2_weight"]._h.array.devices() == {cpus[2]}
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = rng.rand(*v.shape).astype(np.float32) * 0.1
+    exe.arg_dict["data"][:] = rng.rand(2, 3).astype(np.float32)
+    out = exe.forward(is_train=True)[0]
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
+    exe.backward()
+    assert exe.grad_dict["fc1_weight"]._h.array.devices() == {cpus[1]}
+    # numerics match a single-device bind
+    exe2 = net.simple_bind(mx.cpu(0), data=(2, 3))
+    for k in exe.arg_dict:
+        exe.arg_dict[k].copyto(exe2.arg_dict[k])
+    out2 = exe2.forward(is_train=True)[0]
+    assert np.allclose(out.asnumpy(), out2.asnumpy(), atol=1e-6)
