@@ -26,6 +26,13 @@ from .ndarray.ndarray import _Handle
 from . import random as _random
 
 
+def _to_device(arr, dev):
+    """Move `arr` to `dev` unless already there (single shared impl for
+    every cross-device placement site in this file)."""
+    return arr if arr.devices() == {dev} else jax.device_put(arr, dev)
+
+
+
 class _Program:
     """Compiled form of a symbol graph: closures + metadata."""
 
@@ -201,10 +208,8 @@ class Executor:
             src = v._h.array if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
             if src.dtype != dst._h.array.dtype:
                 src = src.astype(dst._h.array.dtype)
-            dev = next(iter(dst._h.array.devices()), None)
-            if dev is not None and src.devices() != {dev}:
-                src = jax.device_put(src, dev)  # keep group2ctx placement
-            dst._h.array = src
+            # keep group2ctx placement
+            dst._h.array = _to_device(src, next(iter(dst._h.array.devices())))
         arg_vals = self._gather([self.arg_dict[n]._h.array
                                  for n in self._prog.arg_names])
         aux_vals = self._gather([self.aux_dict[n]._h.array
@@ -240,10 +245,8 @@ class Executor:
         if is_train:
             for n, v in zip(self._prog.aux_names, new_aux):
                 buf = self.aux_dict[n]
-                dev = next(iter(buf._h.array.devices()), None)
-                if dev is not None and v.devices() != {dev}:
-                    v = jax.device_put(v, dev)  # aux stays on its group ctx
-                buf._h.array = v
+                # aux stays on its group ctx
+                buf._h.array = _to_device(v, next(iter(buf._h.array.devices())))
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
@@ -266,12 +269,13 @@ class Executor:
                                  for n in self._prog.aux_names])
         keys = self._last_keys or tuple(_random.next_key()
                                         for _ in range(self._n_keys))
+        head_grads = self._gather(head_grads)  # user grads may live on a
+        # group device; the jitted backward computes on the bind ctx
         grads = self._bwd_jit(arg_vals, aux_vals, keys, head_grads)
         for n, g in zip(self._grad_names, grads):
             buf = self.grad_dict[n]
-            dev = next(iter(buf._h.array.devices()), None)
-            if dev is not None and g.devices() != {dev}:
-                g = jax.device_put(g, dev)  # grads stay on their group ctx
+            # grads stay on their group ctx
+            g = _to_device(g, next(iter(buf._h.array.devices())))
             if self._grad_req[n] == "add":
                 buf._h.array = buf._h.array + g.astype(buf._h.array.dtype)
             else:
@@ -284,8 +288,7 @@ class Executor:
         program computes on the bind ctx, so inputs gather here.  No-op in
         the single-device common case."""
         dev = self._ctx.jax_device()
-        return [v if v.devices() == {dev} else jax.device_put(v, dev)
-                for v in vals]
+        return [_to_device(v, dev) for v in vals]
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
@@ -313,9 +316,12 @@ class Executor:
                 if name in self.grad_dict:
                     new_grads[name] = self.grad_dict[name]
             else:
-                new_args[name] = nd_zeros(shape, self._ctx, dtype=cur.dtype)
+                # reallocate on the OLD buffer's device so per-arg
+                # group2ctx placement survives the reshape
+                new_args[name] = nd_zeros(shape, cur.context, dtype=cur.dtype)
                 if name in self.grad_dict and self.grad_dict[name] is not None:
-                    new_grads[name] = nd_zeros(shape, self._ctx, dtype=cur.dtype)
+                    new_grads[name] = nd_zeros(shape, cur.context,
+                                               dtype=cur.dtype)
         new_aux = {}
         for name, shape in zip(self._prog.aux_names, aux_shapes):
             new_aux[name] = self.aux_dict[name]

@@ -123,3 +123,20 @@ def test_vision_dataset_synthetic_fallback(tmp_path):
     (part / "train-images-idx3-ubyte").write_bytes(b"")
     with pytest.raises(FileNotFoundError, match="counterpart"):
         MNIST(root=str(part), train=True)
+
+
+def test_dataloader_multiprocess_workers():
+    """DataLoader with worker processes (ref: gluon/data/dataloader.py
+    multiprocessing workers + shared-memory NDArray pickling)."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = np.arange(80, dtype=np.float32).reshape(20, 4)
+    y = np.arange(20, dtype=np.float32)
+    ds = ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    dl = DataLoader(ds, batch_size=5, shuffle=True, num_workers=2)
+    seen = []
+    for xb, yb in dl:
+        assert xb.shape == (5, 4)
+        seen.extend(yb.asnumpy().tolist())
+    assert sorted(seen) == list(range(20))
+    assert sum(1 for _ in dl) == 4   # reusable across epochs
