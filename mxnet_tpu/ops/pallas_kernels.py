@@ -32,8 +32,14 @@ def _reference_attention(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  causal, scale, block_q, block_k, n_kv_blocks):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  causal, scale, block_q, block_k, n_kv_blocks,
+                  emit_lse):
+    if emit_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref = None
+        m_ref, l_ref, acc_ref = rest
     """One (q-block, kv-block) grid step.  Grid = (BH, n_q, n_kv) with the
     kv dimension innermost; m/l/acc scratch persists across kv steps of the
     same q block (standard flash-attention accumulation)."""
@@ -89,6 +95,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:][:, :1]
         l = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        if emit_lse:
+            # per-row log-sum-exp residual for the custom backward.
+            # Lane-broadcast [block_q, 128]: Mosaic requires the last two
+            # block dims be 8/128-divisible, which rules out a compact
+            # (1, block_q) layout; the 128x write only happens on the
+            # DIFFERENTIATED forward (inference skips lse entirely)
+            lse = m_ref[:][:, :1] + jnp.log(l)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
@@ -122,36 +136,132 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     # dispatch through a jitted-callable cache: tracing a pallas_call is
     # hundreds of ms of host work, so eager per-call tracing would swamp
     # the kernel (measured 680 ms/call untraced vs 0.02 ms cached)
-    fn = _flash_jitted(b, h, sq, sk, d, str(jnp.dtype(q.dtype)), causal,
-                       float(scale), block_q, block_k, interpret)
-    out = fn(qf, kf, vf)
+    out = _flash_vjp_wrapped(qf, kf, vf,
+                             (b, h, sq, sk, d, str(jnp.dtype(q.dtype)),
+                              causal, float(scale), block_q, block_k,
+                              interpret))
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_vjp_wrapped(qf, kf, vf, meta):
+    """Differentiable flash attention over [BH, S, D] operands: forward is
+    the Pallas kernel, backward is the standard flash backward computed
+    blockwise over q tiles from the saved row log-sum-exp (memory
+    O(block*S), no S^2 materialization — matching the kernel's point).
+    The undifferentiated primal skips the lse output entirely."""
+    out, _ = _flash_jitted(*meta, with_lse=False)(qf, kf, vf)
+    return out
+
+
+def _flash_vjp_fwd(qf, kf, vf, meta):
+    out, lse = _flash_jitted(*meta, with_lse=True)(qf, kf, vf)
+    return out, (qf, kf, vf, out, lse[:, :, 0])
+
+
+def _flash_vjp_bwd(meta, res, d_out):
+    b, h, sq, sk, d, dtype, causal, scale, block_q, block_k, interpret = meta
+    qf, kf, vf, out, lse = res
+    fn = _flash_bwd_jitted(sq, sk, causal, scale, min(block_q, sq))
+    dq, dk, dv = fn(qf, kf, vf, out, lse, d_out)
+    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype))
+
+
+_flash_vjp_wrapped.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.lru_cache(maxsize=512)
+def _flash_bwd_jitted(sq, sk, causal, scale, block_q):
+    n_q = sq // block_q
+
+    def bwd(qf, kf, vf, out, lse, d_out):
+        # D_i = rowsum(dO_i * O_i), in f32: it enters ds by cancellation
+        # against dp, so bf16 rounding here would amplify
+        D = jnp.sum(d_out.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                 # [BH, Sq]
+
+        def one_q_block(i):
+            s = i * block_q
+            qb = jax.lax.dynamic_slice_in_dim(qf, s, block_q, 1)
+            dob = jax.lax.dynamic_slice_in_dim(d_out, s, block_q, 1)
+            lseb = jax.lax.dynamic_slice_in_dim(lse, s, block_q, 1)
+            Db = jax.lax.dynamic_slice_in_dim(D, s, block_q, 1)
+            sij = jnp.einsum("bqd,bkd->bqk", qb, kf,
+                             preferred_element_type=jnp.float32) * scale
+            if causal:
+                rows = s + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, sk), 0)
+                cols = jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, sk), 1)
+                sij = jnp.where(rows >= cols, sij, _NEG_INF)
+            p = jnp.exp(sij - lseb[..., None])               # [BH, bq, Sk]
+            dp = jnp.einsum("bqd,bkd->bqk", dob, vf,
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - Db[..., None])
+            dqb = jnp.einsum("bqk,bkd->bqd", ds, kf,
+                             preferred_element_type=jnp.float32) * scale
+            dkb = jnp.einsum("bqk,bqd->bkd", ds, qb,
+                             preferred_element_type=jnp.float32) * scale
+            dvb = jnp.einsum("bqk,bqd->bkd", p, dob,
+                             preferred_element_type=jnp.float32)
+            return dqb, dkb, dvb
+
+        # accumulate dk/dv in the loop carry so only ONE full-size
+        # buffer per gradient exists (lax.map would stack n_q of them)
+        bh = qf.shape[0]
+        dkv_shape = (bh,) + kf.shape[1:]
+
+        def body(i, carry):
+            dq_acc, dk_acc, dv_acc = carry
+            dqb, dkb, dvb = one_q_block(i)
+            dq_acc = jax.lax.dynamic_update_slice_in_dim(
+                dq_acc, dqb, i * block_q, 1)
+            return dq_acc, dk_acc + dkb, dv_acc + dvb
+
+        dq, dk, dv = jax.lax.fori_loop(
+            0, n_q, body,
+            (jnp.zeros(qf.shape, jnp.float32),
+             jnp.zeros(dkv_shape, jnp.float32),
+             jnp.zeros(dkv_shape, jnp.float32)))
+        return dq, dk, dv
+
+    return jax.jit(bwd)
 
 
 @functools.lru_cache(maxsize=512)
 def _flash_jitted(b, h, sq, sk, d, dtype, causal, scale, block_q, block_k,
-                  interpret):
+                  interpret, with_lse=False):
     n_q = sq // block_q
     n_kv = sk // block_k
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, n_kv_blocks=n_kv)
+        block_k=block_k, n_kv_blocks=n_kv, emit_lse=with_lse)
 
     def run(qf, kf, vf):
         # the framework enables jax x64 globally (float64 NDArray API
         # parity); Mosaic rejects 64-bit types, so trace under 32-bit rules
         with jax.enable_x64(False):
-            return _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv,
-                               block_q, block_k, jnp.dtype(dtype), interpret)
+            return _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q,
+                               n_kv, block_q, block_k,
+                               jnp.dtype(dtype), interpret, with_lse)
 
     return jax.jit(run)
 
 
 def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
-                block_k, dtype, interpret):
+                block_k, dtype, interpret, with_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    return pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_kv),
         in_specs=[
@@ -159,9 +269,8 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -171,3 +280,4 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         **({"interpret": interpret} if interpret is not None else {}),
     )(qf, kf, vf)
+    return res if with_lse else (res[0], None)
