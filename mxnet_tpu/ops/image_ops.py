@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register, pFloat, pFloatTuple
 
@@ -185,10 +186,12 @@ register("_image_random_color_jitter", _random_color_jitter, num_inputs=1,
 
 # PCA lighting constants: ImageNet eigenvalues/vectors (the same public
 # AlexNet-paper constants the reference's docs use for adjust_lighting).
-_EIGVAL = jnp.array([55.46, 4.794, 1.148], jnp.float32)
-_EIGVEC = jnp.array([[-0.5675, 0.7192, 0.4009],
-                     [-0.5808, -0.0045, -0.8140],
-                     [-0.5836, -0.6948, 0.4203]], jnp.float32)
+# Host numpy, not jnp: a module-level jnp.array would allocate on the default
+# backend at import time (which may not even be usable under the driver).
+_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                    [-0.5808, -0.0045, -0.8140],
+                    [-0.5836, -0.6948, 0.4203]], np.float32)
 
 
 def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import batch_sharding, replicated_sharding, shard_params_rule
@@ -39,8 +40,12 @@ class ShardedTrainStep:
         self.params = {
             name: jax.device_put(p, param_sharding[name])
             for name, p in params.items()}
+        # Build momentum zeros from host numpy, not jnp.zeros_like: an eager
+        # jnp call would allocate on the *default* backend (which may not be
+        # the mesh's backend, or may not even be usable) before re-placement.
         self.momentum_buf = {
-            name: jax.device_put(jnp.zeros_like(p), param_sharding[name])
+            name: jax.device_put(np.zeros(p.shape, p.dtype),
+                                 param_sharding[name])
             for name, p in self.params.items()}
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
