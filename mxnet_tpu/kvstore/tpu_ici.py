@@ -1,41 +1,94 @@
 """kvstore='tpu_ici': gradient reduction over the device mesh (north star).
 
 Replaces KVStoreNCCL (src/kvstore/kvstore_nccl.h:62 — ncclReduce/ncclBcast
-per key) and the CommDevice P2P scatter (comm.h:485).  Push/pull keep the
-MXNet API, but the reduce is one jitted XLA computation summing the
-per-device copies — XLA lowers it to all-reduce over ICI links when the
-inputs live on different chips, with no per-key NCCL launches and no merge
-buffers to manage.
+per key) and the CommDevice P2P scatter (comm.h:485) with XLA collectives:
 
-Beyond API parity, `push_pull` fuses push+pull into a single computation
-(the fast path Module/Trainer use), and `allreduce_sharded` reduces arrays
-already laid out over a Mesh inside a larger jitted step.
+- `push` assembles the per-device gradient copies into ONE global array
+  sharded over a 1-D device mesh (zero-copy: each copy becomes a shard in
+  place) and runs a single jitted sum whose output sharding is *replicated*
+  — XLA lowers that to an all-reduce riding ICI on TPU.  No copy is ever
+  gathered through a single device's HBM.
+- `pull` of a reduced key hands each device its local replica shard — no
+  transfer at all.
+- `push_pull` is therefore one collective dispatch end to end, matching the
+  reference's NCCL fast path (`_update_params_on_kvstore_nccl`,
+  python/mxnet/model.py:106) where gradients are all-reduced and the
+  optimizer runs replicated on every device.
+
+Like the reference's NCCL store, tpu_ici selects update_on_kvstore=False
+(model.py:_create_kvstore): the optimizer runs per device on identical
+reduced gradients, so weights stay bit-identical replicas without a
+broadcast step.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray import NDArray
 from . import KVStore, _key_value, _updater_key
 
 
-@jax.jit
-def _sum_arrays(arrays):
-    acc = arrays[0]
-    for a in arrays[1:]:
-        acc = acc + a
-    return acc
+@functools.lru_cache(maxsize=None)
+def _kv_mesh(devices):
+    """1-D mesh over the devices holding a key's gradient copies."""
+    return Mesh(np.array(devices), ("kv",))
 
 
-def _reduce_to_first(arrays):
-    """Sum per-device copies: gather onto the first array's device, then one
-    jitted tree-sum (XLA lowers the transfers to ICI copies on TPU)."""
+@functools.lru_cache(maxsize=None)
+def _reduce_fn(mesh):
+    """The collective: sum over the device-sharded leading axis, replicated
+    output.  SPMD lowers shard-axis-sum → replicated to one all-reduce."""
+    return jax.jit(
+        lambda stacked: jnp.sum(stacked, axis=0),
+        in_shardings=NamedSharding(mesh, P("kv")),
+        out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _sum_jit(n):
+    return jax.jit(lambda *xs: functools.reduce(lambda a, b: a + b, xs))
+
+
+def _tree_sum(arrays):
     dev = list(arrays[0].devices())[0]
     moved = [a if list(a.devices())[0] == dev else jax.device_put(a, dev)
              for a in arrays]
-    return _sum_arrays(moved)
+    return _sum_jit(len(moved))(*moved)
+
+
+def allreduce_arrays(arrays):
+    """All-reduce a list of same-shaped jax arrays living on distinct
+    devices.  Returns the summed value replicated across those devices
+    (every device's shard is addressable locally).  Falls back to a plain
+    tree-sum when the copies do not sit on distinct devices (nothing to
+    collectivize)."""
+    devs = tuple(sorted((list(a.devices())[0] for a in arrays),
+                        key=lambda d: d.id))
+    by_dev = {list(a.devices())[0]: a for a in arrays}
+    if len(by_dev) != len(arrays):
+        return _tree_sum(arrays)
+    # each per-device copy becomes one shard of a global [n, ...] array, in
+    # place: the reshape runs on the copy's own device
+    shards = [by_dev[d].reshape((1,) + tuple(by_dev[d].shape)) for d in devs]
+    mesh = _kv_mesh(devs)
+    stacked = jax.make_array_from_single_device_arrays(
+        (len(arrays),) + tuple(arrays[0].shape),
+        NamedSharding(mesh, P("kv")), shards)
+    return _reduce_fn(mesh)(stacked)
+
+
+def _local_shard(garray, device):
+    """The addressable replica of `garray` on `device`, or None."""
+    for s in garray.addressable_shards:
+        if s.device == device:
+            return s.data
+    return None
 
 
 class TpuIciKVStore(KVStore):
@@ -63,13 +116,57 @@ class TpuIciKVStore(KVStore):
             return vals
         if len(vals) == 1:
             return vals[0]
-        arrays = [v._h.array for v in vals]
-        return NDArray(_reduce_to_first(arrays))
+        if any(type(v) is not NDArray for v in vals):
+            # sparse / exotic storage: the dense collective does not apply
+            return super()._reduce(vals)
+        return NDArray(allreduce_arrays([v._h.array for v in vals]))
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            stored = self._stored.get(k)
+            if stored is None:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            merged = self._reduce(v)
+            if self._updater is not None:
+                grad = merged
+                local = _local_shard(merged._h.array,
+                                     stored.context.jax_device())
+                if local is not None:
+                    grad = NDArray(local)
+                elif merged.context != stored.context:
+                    grad = merged.as_in_context(stored.context)
+                self._updater(_updater_key(k), grad, stored)
+            else:
+                # keep the replicated global array: pull becomes a local
+                # shard read on every participating device.  If the reduce
+                # degenerated to returning a caller-owned NDArray (single
+                # copy), store a snapshot — push captures the value at push
+                # time (base-class contract).
+                if merged is v or (isinstance(v, (list, tuple))
+                                   and any(merged is x for x in v)):
+                    merged = merged.copy()
+                self._stored[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            stored = self._stored[k]
+            if isinstance(olist, NDArray):
+                olist = [olist]
+            for o in olist:
+                local = _local_shard(stored._h.array,
+                                     o.context.jax_device())
+                if local is None:
+                    stored.copyto(o)
+                    continue
+                o._h.array = local.astype(o._h.array.dtype) \
+                    if local.dtype != o._h.array.dtype else local
 
     def push_pull(self, key, push_value, pull_out, priority=0):
-        """Fused push+pull: reduce per-device grads, run updater (or store),
-        broadcast result into pull_out — one engine-free round trip
-        (ref python fast path: _update_params_on_kvstore, model.py:126)."""
+        """Fused push+pull: one all-reduce dispatch per key, outs filled
+        from local replica shards (ref fast path: model.py:106)."""
         self.push(key, push_value, priority)
         self.pull(key, out=pull_out, priority=priority)
 

@@ -27,7 +27,8 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, kvs.KVStore):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore and "tpu" not in kvstore:
+        if (num_device == 1 and "dist" not in kvstore
+                and "tpu" not in kvstore and "ici" not in kvstore):
             kv = None
         else:
             kv = kvs.create(kvstore)
@@ -39,6 +40,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
     else:
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:
+        update_on_kvstore = False
+    elif any(t in kv.type for t in ("nccl", "tpu", "ici")):
+        # collective stores all-reduce gradients and run the optimizer
+        # replicated per device — no central weight copy to update
+        # (ref: model.py _create_kvstore nccl special-case)
         update_on_kvstore = False
     return (kv, update_on_kvstore)
 

@@ -1,0 +1,108 @@
+"""tpu_ici kvstore: the reduce must be a real XLA collective.
+
+Round-1 verdict: the old implementation gathered every per-device gradient
+copy onto device 0 and tree-summed there — the exact serialization pattern
+NCCL ring-reduce exists to avoid.  These tests pin the new contract:
+
+- the reduce is ONE jitted computation whose input is sharded over all
+  participating devices and whose output is replicated (XLA all-reduce);
+- no per-array device transfer (jax.device_put) happens on the push/pull
+  path when copies sit on distinct devices;
+- an 8-virtual-device Module DP run converges through kvstore='tpu_ici'.
+"""
+import numpy as np
+import pytest
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import tpu_ici
+
+
+def _cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip("needs %d virtual cpu devices" % n)
+    return devs[:n]
+
+
+def test_allreduce_arrays_is_collective():
+    devs = _cpu_devices()
+    arrays = [jax.device_put(np.full((4, 3), i + 1, np.float32), d)
+              for i, d in enumerate(devs)]
+    out = tpu_ici.allreduce_arrays(arrays)
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 3), 36.0))
+    # replicated: every device holds its own copy of the result
+    shard_devs = {s.device for s in out.addressable_shards}
+    assert shard_devs == set(devs)
+    # and the compiled reduce is an all-reduce, not a gather+sum
+    mesh = tpu_ici._kv_mesh(tuple(devs))
+    fn = tpu_ici._reduce_fn(mesh)
+    stacked = jax.ShapeDtypeStruct((len(devs), 4, 3), np.float32)
+    hlo = fn.lower(stacked).compile().as_text()
+    assert "all-reduce" in hlo, "reduce did not lower to an all-reduce"
+
+
+def test_push_pull_no_single_device_routing(monkeypatch):
+    devs = _cpu_devices()
+    kv = mx.kv.create("tpu_ici")
+    kv.init("w", mx.nd.zeros((2, 5), ctx=mx.cpu(0)))
+    vals = [mx.nd.array(np.full((2, 5), i + 1, np.float32), ctx=mx.cpu(i))
+            for i in range(8)]
+    outs = [mx.nd.zeros((2, 5), ctx=mx.cpu(i)) for i in range(8)]
+
+    calls = []
+    real_put = jax.device_put
+
+    def spy(x, device=None, **kw):
+        calls.append(device)
+        return real_put(x, device, **kw)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+    kv.push("w", vals)
+    kv.pull("w", out=outs)
+    monkeypatch.undo()
+
+    assert not calls, (
+        "push/pull routed data through jax.device_put (gather pattern): %r"
+        % (calls,))
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.asnumpy(), np.full((2, 5), 36.0))
+        assert list(o._h.array.devices())[0] == devs[i]
+
+
+def test_push_pull_fused_and_updater_path():
+    kv = mx.kv.create("tpu_ici")
+    kv.init("p", mx.nd.ones((3,), ctx=mx.cpu(0)))
+    vals = [mx.nd.array(np.full((3,), 0.5, np.float32), ctx=mx.cpu(i))
+            for i in range(4)]
+    outs = [mx.nd.zeros((3,), ctx=mx.cpu(i)) for i in range(4)]
+    kv.push_pull("p", vals, outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.full((3,), 2.0))
+    # updater path: merged gradient reaches the updater as a local shard
+    seen = {}
+    kv2 = mx.kv.create("tpu_ici")
+    kv2.init("q", mx.nd.ones((3,), ctx=mx.cpu(0)))
+    kv2.set_updater(lambda k, g, w: seen.setdefault(k, g.asnumpy()))
+    kv2.push("q", vals)
+    np.testing.assert_allclose(seen["q"], np.full((3,), 2.0))
+
+
+def test_module_dp_convergence_8dev():
+    rng = np.random.RandomState(0)
+    W = rng.randn(16, 4)
+    X = rng.randn(512, 16).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(8)])
+    mod.fit(it, num_epoch=8, kvstore="tpu_ici",
+            optimizer_params={"learning_rate": 0.5})
+    # collective stores run the optimizer replicated per device
+    assert mod._update_on_kvstore is False
+    assert mod._kvstore is not None and "ici" in mod._kvstore.type
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, "DP training through tpu_ici did not converge: %s" % acc
