@@ -50,16 +50,14 @@ def _reduce_fn(mesh):
         out_shardings=NamedSharding(mesh, P()))
 
 
-@functools.lru_cache(maxsize=None)
-def _sum_jit(n):
-    return jax.jit(lambda *xs: functools.reduce(lambda a, b: a + b, xs))
+_SUM = jax.jit(lambda *xs: functools.reduce(lambda a, b: a + b, xs))
 
 
 def _tree_sum(arrays):
     dev = list(arrays[0].devices())[0]
     moved = [a if list(a.devices())[0] == dev else jax.device_put(a, dev)
              for a in arrays]
-    return _sum_jit(len(moved))(*moved)
+    return _SUM(*moved)
 
 
 def allreduce_arrays(arrays):
@@ -127,6 +125,14 @@ class TpuIciKVStore(KVStore):
             stored = self._stored.get(k)
             if stored is None:
                 raise MXNetError("key %r has not been initialized" % (k,))
+            vals = [v] if isinstance(v, NDArray) else list(v)
+            if (type(stored) is not NDArray
+                    or any(type(x) is not NDArray for x in vals)):
+                # sparse / exotic storage: a sparse NDArray's inherited
+                # _h.array is an empty placeholder, so the dense collective
+                # below would silently drop the payload — use base semantics
+                super().push(k, v, priority)
+                continue
             merged = self._reduce(v)
             if self._updater is not None:
                 grad = merged
@@ -155,6 +161,11 @@ class TpuIciKVStore(KVStore):
             stored = self._stored[k]
             if isinstance(olist, NDArray):
                 olist = [olist]
+            if (type(stored) is not NDArray
+                    or any(type(o) is not NDArray for o in olist)):
+                super().pull(k, out=olist, priority=priority,
+                             ignore_sparse=ignore_sparse)
+                continue
             for o in olist:
                 local = _local_shard(stored._h.array,
                                      o.context.jax_device())

@@ -27,6 +27,21 @@ class BaseSparseNDArray(NDArray):
     def __len__(self):
         return self.shape[0]
 
+    @property
+    def context(self):
+        # the inherited _h.array is an empty placeholder whose device says
+        # nothing about where the payload lives — report the data's context
+        if self._ctx is not None:
+            return self._ctx
+        return self._data_arr.context
+
+    ctx = context
+
+    def as_in_context(self, context):
+        if self.context == context:
+            return self
+        return self.copyto(context)
+
     def __iadd__(self, other):
         raise MXNetError("not supported for this storage type")
 
@@ -82,6 +97,23 @@ class RowSparseNDArray(BaseSparseNDArray):
         idx = self._indices._h.array.astype(jnp.int32)
         out = out.at[idx].set(self._data_arr._h.array)
         return NDArray(out)
+
+    def copyto(self, other):
+        from ..context import Context
+        if isinstance(other, Context):
+            return RowSparseNDArray(self._data_arr.as_in_context(other),
+                                    self._indices.as_in_context(other),
+                                    self._sshape, ctx=other)
+        if isinstance(other, RowSparseNDArray):
+            if other is self:
+                raise MXNetError("cannot copy an array onto itself")
+            other._data_arr = self._data_arr.copy()
+            other._indices = self._indices.copy()
+            other._sshape = self._sshape
+            return other
+        if isinstance(other, NDArray):
+            return self.todense().copyto(other)
+        raise TypeError("copyto does not support type " + str(type(other)))
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -161,6 +193,25 @@ class CSRNDArray(BaseSparseNDArray):
             cols = indices[indptr[r]:indptr[r + 1]]
             out[r, cols] = data[indptr[r]:indptr[r + 1]]
         return nd_array(out, dtype=self.dtype)
+
+    def copyto(self, other):
+        from ..context import Context
+        if isinstance(other, Context):
+            return CSRNDArray(self._data_arr.as_in_context(other),
+                              self._indices.as_in_context(other),
+                              self._indptr.as_in_context(other),
+                              self._sshape, ctx=other)
+        if isinstance(other, CSRNDArray):
+            if other is self:
+                raise MXNetError("cannot copy an array onto itself")
+            other._data_arr = self._data_arr.copy()
+            other._indices = self._indices.copy()
+            other._indptr = self._indptr.copy()
+            other._sshape = self._sshape
+            return other
+        if isinstance(other, NDArray):
+            return self.todense().copyto(other)
+        raise TypeError("copyto does not support type " + str(type(other)))
 
     def tostype(self, stype):
         if stype == "csr":

@@ -88,6 +88,25 @@ def test_push_pull_fused_and_updater_path():
     np.testing.assert_allclose(seen["q"], np.full((3,), 2.0))
 
 
+def test_sparse_push_not_dropped():
+    # a RowSparseNDArray's inherited dense handle is an empty placeholder;
+    # push must route sparse values through base-class semantics, not the
+    # dense collective (which would silently hand the updater a (0,) array)
+    kv = mx.kv.create("tpu_ici")
+    kv.init("emb", mx.nd.zeros((4, 2), ctx=mx.cpu(0)))
+    seen = {}
+    kv.set_updater(lambda k, g, w: seen.setdefault(k, g))
+    grad = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 2), np.float32), [0, 2]), shape=(4, 2))
+    kv.push("emb", [grad])
+    assert "emb" in seen
+    g = seen["emb"]
+    dense = g.todense() if hasattr(g, "todense") else g
+    assert dense.shape == (4, 2)
+    np.testing.assert_allclose(
+        dense.asnumpy(), [[1, 1], [0, 0], [1, 1], [0, 0]])
+
+
 def test_module_dp_convergence_8dev():
     rng = np.random.RandomState(0)
     W = rng.randn(16, 4)
