@@ -1,9 +1,26 @@
-"""Driver benchmark: ResNet-50 batch-32 inference throughput on one chip.
+"""Driver benchmark: ResNet-50 batch-32 on one chip — training AND inference.
 
-Mirrors the reference's scoring benchmark
-(example/image-classification/benchmark_score.py; published P100 number:
-713.17 img/s at batch 32, docs/faq/perf.md:138-148 — see BASELINE.md).
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The north-star metric (BASELINE.json) is *training* images/sec, so that is
+the primary JSON field; inference throughput (the reference's
+benchmark_score.py, P100 713.17 img/s, docs/faq/perf.md:138-148) rides
+along, with achieved TFLOP/s and MFU derived from XLA's compiled cost
+analysis of the framework's own programs.
+
+Measurement methodology (round-1 verdict items addressed — the round-1
+numbers were artifacts of async dispatch over the chip tunnel, where even
+block_until_ready returns before work completes):
+- N iterations run INSIDE one jitted lax.fori_loop; every iteration is
+  data-dependent on the previous one (training chains on updated params,
+  inference perturbs the input with tanh(mean(logits))*1e-12), so no
+  execution can be elided, deduplicated, or overlapped out of the window;
+- the window ends with a real host fetch of a scalar accumulator that
+  transitively depends on every iteration;
+- throughput is the MARGINAL rate between a small and a large window,
+  cancelling the fixed dispatch+fetch latency of the tunnel;
+- per-iteration FLOPs come from XLA cost analysis of the single-step
+  compiled program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 from __future__ import annotations
 
@@ -12,46 +29,192 @@ import time
 
 import numpy as np
 
-BASELINE_IMG_S = 713.17  # ResNet-50 inference, batch 32, P100 (BASELINE.md)
+BASELINE_TRAIN_IMG_S = 181.53  # ResNet-50 training, batch 32, P100 (BASELINE.md)
+BASELINE_INFER_IMG_S = 713.17  # ResNet-50 inference, batch 32, P100
 BATCH = 32
-WARMUP = 3
-ITERS = 20
+N_SMALL = 5
+N_LARGE = 25
+
+# bf16 matmul peak by device kind (public spec sheets); MFU is null when the
+# platform is unknown (e.g. cpu test runs).
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _flops_of(compiled):
+    """Total flops from an AOT-compiled computation's cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return 0.0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0)) if ca else 0.0
+
+
+def _timed_windows(loop_fn, *args, reps=5):
+    """Run (small, large) window pairs; median marginal seconds per
+    iteration.  loop_fn must end in a host fetch."""
+    loop_fn(2, *args)  # warm (compile + caches)
+    estimates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        loop_fn(N_SMALL, *args)
+        t1 = time.perf_counter()
+        loop_fn(N_LARGE, *args)
+        t2 = time.perf_counter()
+        estimates.append(((t2 - t1) - (t1 - t0)) / (N_LARGE - N_SMALL))
+    estimates.sort()
+    return estimates[len(estimates) // 2]
+
+
+def _build_resnet_exe(mx, ctx, rng, grad_req):
+    from mxnet_tpu.models import resnet
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    exe = sym.simple_bind(ctx, grad_req=grad_req,
+                          data=(BATCH, 3, 224, 224),
+                          softmax_label=(BATCH,))
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = rng.uniform(0, 1, arr.shape).astype(np.float32)
+        elif name == "softmax_label":
+            arr[:] = rng.randint(0, 1000, arr.shape).astype(np.float32)
+        else:
+            arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+    return exe
+
+
+def _bench_inference(mx, jax, ctx, rng):
+    import jax.numpy as jnp
+    exe = _build_resnet_exe(mx, ctx, rng, grad_req="null")
+    prog = exe._prog
+    arg_names, aux_names = prog.arg_names, prog.aux_names
+    arg_vals = tuple(exe.arg_dict[n]._h.array for n in arg_names)
+    aux_vals = tuple(exe.aux_dict[n]._h.array for n in aux_names)
+    flops = _flops_of(
+        exe._fwd_jit.lower(arg_vals, aux_vals, (), False).compile())
+
+    @jax.jit
+    def loop(n, arg_vals, aux_vals):
+        amap0 = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+
+        def body(i, carry):
+            data, acc = carry
+            amap = dict(amap0)
+            amap["data"] = data
+            outs, _ = prog.evaluate(amap, aux_map, (), False)
+            m = jnp.mean(outs[0].astype(jnp.float32))
+            # chain: next input depends (negligibly) on this output
+            return data * (1.0 + jnp.tanh(m) * 1e-12), acc + m
+
+        _, acc = jax.lax.fori_loop(0, n, body,
+                                   (amap0["data"], jnp.float32(0.0)))
+        return acc
+
+    def run(n, arg_vals, aux_vals):
+        return float(loop(n, arg_vals, aux_vals))  # host fetch
+
+    sec_per_iter = _timed_windows(run, arg_vals, aux_vals)
+    return BATCH / sec_per_iter, flops / sec_per_iter
+
+
+def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
+    import jax.numpy as jnp
+    exe = _build_resnet_exe(mx, ctx, rng, grad_req="write")
+    prog = exe._prog
+    arg_names, aux_names = prog.arg_names, prog.aux_names
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    other_names = [n for n in arg_names if n not in set(param_names)]
+    other_vals = tuple(exe.arg_dict[n]._h.array for n in other_names)
+    params0 = tuple(exe.arg_dict[n]._h.array for n in param_names)
+    aux0 = tuple(exe.aux_dict[n]._h.array for n in aux_names)
+
+    def sgd_step(params, mom, aux):
+        amap = dict(zip(other_names, other_vals))
+        aux_map = dict(zip(aux_names, aux))
+
+        def f(pvals):
+            m = dict(amap)
+            m.update(zip(param_names, pvals))
+            outs, new_aux = prog.evaluate(m, aux_map, (), True)
+            return outs, tuple(new_aux[n] for n in aux_names)
+
+        (outs, new_aux), vjp_fn = jax.vjp(f, params)
+        heads = [jnp.ones_like(o) for o in outs]
+        zeros_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+        (grads,) = vjp_fn((heads, zeros_aux))
+        new_params, new_mom = [], []
+        for w, g, m in zip(params, grads, mom):
+            m2 = momentum * m - lr * g
+            new_params.append(w + m2)
+            new_mom.append(m2)
+        return tuple(new_params), tuple(new_mom), new_aux, outs
+
+    # per-step flops from the compiled single step
+    mom0 = tuple(jnp.zeros_like(p) for p in params0)
+    flops = _flops_of(jax.jit(sgd_step).lower(params0, mom0, aux0).compile())
+
+    @jax.jit
+    def loop(n, params, mom, aux):
+        def body(i, carry):
+            params, mom, aux, acc = carry
+            params, mom, aux, outs = sgd_step(params, mom, aux)
+            return (params, mom, aux,
+                    acc + jnp.mean(outs[0].astype(jnp.float32)))
+
+        _, _, _, acc = jax.lax.fori_loop(
+            0, n, body, (params, mom, aux, jnp.float32(0.0)))
+        return acc
+
+    def run(n, params, mom, aux):
+        return float(loop(n, params, mom, aux))  # host fetch
+
+    sec_per_iter = _timed_windows(run, params0, mom0, aux0)
+    return BATCH / sec_per_iter, flops / sec_per_iter
 
 
 def main():
     import jax
     import mxnet_tpu as mx
-    from mxnet_tpu.models import resnet
 
-    ctx = mx.tpu() if jax.default_backend() in ("tpu", "axon") else mx.cpu()
-    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
-                            image_shape="3,224,224")
-    exe = sym.simple_bind(ctx, grad_req="null",
-                          data=(BATCH, 3, 224, 224))
-    # random weights — throughput doesn't depend on values
+    on_chip = jax.default_backend() in ("tpu", "axon")
+    ctx = mx.tpu() if on_chip else mx.cpu()
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind)
     rng = np.random.RandomState(0)
-    for name, arr in exe.arg_dict.items():
-        if name not in ("data", "softmax_label"):
-            arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
-    exe.arg_dict["data"][:] = rng.uniform(
-        0, 1, (BATCH, 3, 224, 224)).astype(np.float32)
 
-    for _ in range(WARMUP):
-        exe.forward(is_train=False)
-        exe.outputs[0].wait_to_read()
+    infer_img_s, infer_flops_s = _bench_inference(mx, jax, ctx, rng)
+    train_img_s, train_flops_s = _bench_training(mx, jax, ctx, rng)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        exe.forward(is_train=False)
-    exe.outputs[0].wait_to_read()
-    dt = time.perf_counter() - t0
+    def tf(x):
+        return round(x / 1e12, 2) if x else None
 
-    img_s = BATCH * ITERS / dt
+    def mfu(x):
+        return round(x / 1e12 / peak, 4) if (x and peak) else None
+
     print(json.dumps({
-        "metric": "resnet50_inference_batch32",
-        "value": round(img_s, 2),
+        "metric": "resnet50_train_batch32",
+        "value": round(train_img_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "vs_baseline": round(train_img_s / BASELINE_TRAIN_IMG_S, 3),
+        "train_tflops": tf(train_flops_s),
+        "train_mfu": mfu(train_flops_s),
+        "inference_img_s": round(infer_img_s, 2),
+        "inference_vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 3),
+        "inference_tflops": tf(infer_flops_s),
+        "inference_mfu": mfu(infer_flops_s),
+        "device_kind": kind,
+        "peak_tflops_bf16": peak,
     }))
 
 
