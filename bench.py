@@ -34,6 +34,7 @@ BASELINE_INFER_IMG_S = 713.17  # ResNet-50 inference, batch 32, P100
 BATCH = 32
 N_SMALL = 5
 N_LARGE = 25
+REPS = 5
 
 # bf16 matmul peak by device kind (public spec sheets); MFU is null when the
 # platform is unknown (e.g. cpu test runs).
@@ -59,9 +60,11 @@ def _flops_of(compiled):
     return float(ca.get("flops", 0.0)) if ca else 0.0
 
 
-def _timed_windows(loop_fn, *args, reps=5):
+def _timed_windows(loop_fn, *args, reps=None):
     """Run (small, large) window pairs; median marginal seconds per
     iteration.  loop_fn must end in a host fetch."""
+    if reps is None:
+        reps = REPS  # resolved at call time so main() can shrink it for cpu
     loop_fn(2, *args)  # warm (compile + caches)
     estimates = []
     for _ in range(reps):
@@ -134,7 +137,8 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
     arg_names, aux_names = prog.arg_names, prog.aux_names
     param_names = [n for n in arg_names
                    if n not in ("data", "softmax_label")]
-    other_names = [n for n in arg_names if n not in set(param_names)]
+    param_set = set(param_names)
+    other_names = [n for n in arg_names if n not in param_set]
     other_vals = tuple(exe.arg_dict[n]._h.array for n in other_names)
     params0 = tuple(exe.arg_dict[n]._h.array for n in param_names)
     aux0 = tuple(exe.aux_dict[n]._h.array for n in aux_names)
@@ -187,8 +191,13 @@ def main():
     import jax
     import mxnet_tpu as mx
 
+    global N_SMALL, N_LARGE, REPS
     on_chip = jax.default_backend() in ("tpu", "axon")
     ctx = mx.tpu() if on_chip else mx.cpu()
+    if not on_chip:
+        # smoke-test configuration: a CPU run is a correctness check of the
+        # bench itself, not a measurement — keep it to a few steps
+        N_SMALL, N_LARGE, REPS = 1, 3, 1
     kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(kind)
     rng = np.random.RandomState(0)

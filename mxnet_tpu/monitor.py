@@ -1,6 +1,10 @@
-"""Monitor: tensor-level introspection (ref: python/mxnet/monitor.py:33;
-C-level hook SetMonitorCallback graph_executor.cc:121).  Our Executor runs an
-uncompiled tap pass when a monitor is installed."""
+"""Monitor: tensor-level introspection.
+
+API parity: python/mxnet/monitor.py:33 (C-level hook SetMonitorCallback,
+graph_executor.cc:121).  Our Executor runs an uncompiled tap pass when a
+monitor is installed, feeding every op output whose name matches the
+pattern through `stat_func` between tic() and toc().
+"""
 from __future__ import annotations
 
 import logging
@@ -10,13 +14,29 @@ from math import sqrt
 from .ndarray import NDArray
 
 
+def _default_stat(x):
+    """Mean absolute scale: ||x|| / sqrt(n)."""
+    return x.norm() / sqrt(x.size)
+
+
+def _render(value):
+    """Stringify a stat result (NDArray scalar, NDArray, or list)."""
+    values = [value] if isinstance(value, NDArray) else value
+    assert isinstance(values, list)
+    parts = []
+    for v in values:
+        if isinstance(v, NDArray) and v.size == 1:
+            parts.append(str(v.asscalar()))
+        else:
+            parts.append(str(v.asnumpy()))
+    return ",".join(parts)
+
+
 class Monitor:
+    """Collect per-tensor statistics every `interval` batches."""
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return x.norm() / sqrt(x.size)
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        self.stat_func = stat_func or _default_stat
         self.interval = interval
         self.activated = False
         self.queue = []
@@ -26,50 +46,46 @@ class Monitor:
         self.sort = sort
 
         def stat_helper(name, arr):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(arr)))
+            if self.activated and self.re_prog.match(name):
+                self.queue.append((self.step, name, self.stat_func(arr)))
+
         self.stat_helper = stat_helper
 
     def install(self, exe):
+        """Hook this monitor into an executor's output tap."""
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
+    def _sync_args(self):
+        for exe in self.exes:
+            for arr in exe.arg_arrays:
+                arr.wait_to_read()
+
     def tic(self):
+        """Start collecting if this step falls on the interval."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+            self._sync_args()
             self.queue = []
             self.activated = True
         self.step += 1
 
     def toc(self):
+        """Stop collecting; also stat matching weights.  Returns
+        [(step, name, rendered_value)]."""
         if not self.activated:
             return []
+        self._sync_args()
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in exe.arg_dict.items():
+            for name, arr in exe.arg_dict.items():
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+                    self.queue.append((self.step, name, self.stat_func(arr)))
         self.activated = False
-        res = []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join(str(v.asscalar() if isinstance(v, NDArray) and
-                             v.size == 1 else v.asnumpy())
-                         for v in v_list)
-            res.append((n, k, s))
+            self.queue.sort(key=lambda item: item[1])
+        results = [(step, name, _render(v)) for step, name, v in self.queue]
         self.queue = []
-        return res
+        return results
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        for step, name, rendered in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, rendered)
