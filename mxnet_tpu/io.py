@@ -505,13 +505,20 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
-class MXDataIter(DataIter):
-    """Placeholder for C++-backed iterators; ImageRecordIter lives in
-    mxnet_tpu.io_native once the native pipeline is built."""
+def MXDataIter(name, **kwargs):
+    """Create a registered iterator by name.
 
-    def __init__(self, *args, **kwargs):
-        raise MXNetError("this C++-backed iterator is provided by "
-                         "mxnet_tpu.io_native")
+    The reference's MXDataIter (python/mxnet/io.py:759) wraps a C++
+    iterator created through the MXDataIterCreateIter registry; here the
+    registry is the Python-side table below, so reference code that
+    resolves iterators by name keeps working."""
+    try:
+        creator = _DATA_ITER_REGISTRY[name]
+    except KeyError:
+        raise MXNetError(
+            "unknown data iterator %r; registered: %s"
+            % (name, sorted(_DATA_ITER_REGISTRY)))
+    return creator(**kwargs)
 
 
 def _build_rec_index(path_imgrec, path_idx):
@@ -592,11 +599,119 @@ def ImageRecordIter_v1(**kwargs):
     return ImageRecordIter(**kwargs)
 
 
+def _parse_libsvm(path):
+    """Parse a libsvm file into (labels[R, L], indptr[R+1], indices, values).
+
+    Lines are `label[,label...] idx:val idx:val ...`; feature indices are
+    0-based (matching the reference's LibSVMIter contract,
+    src/io/iter_libsvm.cc)."""
+    labels, indptr, indices, values = [], [0], [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, *feats = line.split()
+            row_labels = [float(x) for x in head.split(",")]
+            if labels and len(row_labels) != len(labels[0]):
+                raise MXNetError(
+                    "%s:%d: %d label(s) but earlier rows have %d"
+                    % (path, lineno, len(row_labels), len(labels[0])))
+            labels.append(row_labels)
+            for tok in feats:
+                idx, val = tok.split(":")
+                indices.append(int(idx))
+                values.append(float(val))
+            indptr.append(len(indices))
+    if not labels:
+        raise MXNetError("%s: no data rows" % (path,))
+    return (np.asarray(labels, np.float32), np.asarray(indptr, np.int64),
+            np.asarray(indices, np.int64), np.asarray(values, np.float32))
+
+
 class LibSVMIter(DataIter):
+    """Sparse batch iterator over libsvm files (ref: src/io/iter_libsvm.cc).
+
+    Yields DataBatches whose data is a CSRNDArray of shape
+    (batch_size,) + data_shape and whose label is dense — a single float
+    per row from the data file, or vectors from a separate `label_libsvm`
+    file.  A final partial batch wraps around to the first rows with
+    `pad` set, like the reference's round-batch loader."""
+
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 batch_size=1, **kwargs):
-        raise MXNetError("LibSVMIter requires sparse NDArray support "
-                         "(mxnet_tpu.ndarray.sparse)")
+                 label_shape=None, batch_size=1, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        labels, self._indptr, self._indices, self._values = \
+            _parse_libsvm(data_libsvm)
+        self._labels = labels[:, 0] if labels.shape[1] == 1 else labels
+        if label_libsvm is not None:
+            ext_labels, lptr, lidx, lval = _parse_libsvm(label_libsvm)
+            if len(ext_labels) != len(labels):
+                raise MXNetError(
+                    "label_libsvm has %d rows but data_libsvm has %d"
+                    % (len(ext_labels), len(labels)))
+            dim = int(label_shape[0]) if label_shape else (
+                int(lidx.max()) + 1 if lidx.size else 1)
+            dense = np.zeros((len(ext_labels), dim), np.float32)
+            for r in range(len(ext_labels)):
+                cols = lidx[lptr[r]:lptr[r + 1]]
+                dense[r, cols] = lval[lptr[r]:lptr[r + 1]]
+            self._labels = dense
+        self._data_shape = tuple(int(x) for x in data_shape)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._round_batch = bool(round_batch)
+        self.num_rows = len(self._indptr) - 1
+        self._row_nnz = np.diff(self._indptr)
+        if self._indices.size and int(self._indices.max()) >= self._data_shape[0]:
+            raise MXNetError(
+                "libsvm feature index %d out of range for data_shape %s "
+                "(indices are 0-based)"
+                % (int(self._indices.max()), self._data_shape))
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) + (
+            self._labels.shape[1:] if self._labels.ndim > 1 else ())
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _row_batch(self, rows):
+        """CSR slice for the given row ids (may wrap for padding)."""
+        from .ndarray import sparse as _sp
+        counts = self._row_nnz[rows]
+        indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        spans = [np.arange(self._indptr[r], self._indptr[r + 1])
+                 for r in rows]
+        flat = np.concatenate(spans).astype(np.int64) if spans else \
+            np.zeros((0,), np.int64)
+        return _sp.CSRNDArray(
+            array(self._values[flat]), self._indices[flat], indptr,
+            (len(rows),) + self._data_shape)
+
+    def next(self):
+        if self._cursor >= self.num_rows:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        pad = max(0, end - self.num_rows)
+        if pad and not self._round_batch:
+            raise StopIteration  # discard the final partial batch
+        rows = np.arange(self._cursor, end) % self.num_rows
+        self._cursor = end
+        data = self._row_batch(rows)
+        label = array(self._labels[rows])
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
 
 class DevicePrefetchIter(DataIter):
@@ -662,3 +777,15 @@ class DevicePrefetchIter(DataIter):
         except StopIteration:
             self._pending = None
         return out
+
+
+# name -> creator table backing MXDataIter (the C++ iterator-registry
+# analog; MXNET_REGISTER_IO_ITER in the reference)
+_DATA_ITER_REGISTRY = {
+    "MNISTIter": MNISTIter,
+    "CSVIter": CSVIter,
+    "LibSVMIter": LibSVMIter,
+    "ImageRecordIter": ImageRecordIter,
+    "ImageRecordIter_v1": ImageRecordIter_v1,
+    "NDArrayIter": NDArrayIter,
+}
