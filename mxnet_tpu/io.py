@@ -635,8 +635,10 @@ class LibSVMIter(DataIter):
     Yields DataBatches whose data is a CSRNDArray of shape
     (batch_size,) + data_shape and whose label is dense — a single float
     per row from the data file, or vectors from a separate `label_libsvm`
-    file.  A final partial batch wraps around to the first rows with
-    `pad` set, like the reference's round-batch loader."""
+    file.  The final partial batch is always served with `pad` set and
+    wrapped rows as padding content (the reference's batch loader also
+    returns the padded tail regardless of round_batch,
+    iter_batchloader.h); `round_batch` is accepted for API parity."""
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
                  label_shape=None, batch_size=1, round_batch=True,
@@ -703,8 +705,6 @@ class LibSVMIter(DataIter):
             raise StopIteration
         end = self._cursor + self.batch_size
         pad = max(0, end - self.num_rows)
-        if pad and not self._round_batch:
-            raise StopIteration  # discard the final partial batch
         rows = np.arange(self._cursor, end) % self.num_rows
         self._cursor = end
         data = self._row_batch(rows)

@@ -56,6 +56,15 @@ def _make_op_func(canonical, op):
 
 from . import sparse  # noqa: F401,E402
 
+
+def cast_storage(data, stype="default"):
+    """Storage-aware cast (ref: cast_storage op).  Hand-written so the
+    imperative dense->sparse direction yields a real sparse NDArray; the
+    registry op of the same name serves symbol graphs (dense identity
+    there — jitted graphs have only dense buffers)."""
+    return sparse.cast_storage(data, stype)
+
+
 _mod = _sys.modules[__name__]
 _GENERATED = {}
 for _name, _op in list(_registry.op_registry().items()):

@@ -517,15 +517,21 @@ def _finish_invoke(op, nattrs, inputs, outs, ctx_attr, out, key, record):
     if op.num_inputs == 0:
         dev = _parse_ctx_attr(ctx_attr).jax_device()
         vis = [jax.device_put(v, dev) for v in vis]
-    out_nds = [NDArray(v) for v in vis]
+    # a sparse_impl may emit ready-made (sparse) NDArrays; pass them through
+    out_nds = [v if isinstance(v, NDArray) else NDArray(v) for v in vis]
     if record and ag.is_recording():
         ag.record_op(op, nattrs, inputs, [i._h.array for i in inputs],
                      out_nds, key)
     if out is not None:
         outs_given = [out] if isinstance(out, NDArray) else list(out)
         for dst, src in zip(outs_given, out_nds):
-            dst._h.array = src._h.array
-            dst._tape_entry = src._tape_entry
+            if type(src) is NDArray and type(dst) is NDArray:
+                dst._h.array = src._h.array
+                dst._tape_entry = src._tape_entry
+            else:
+                # sparse on either side: a handle swap would install the
+                # empty dense placeholder; copyto knows the storage types
+                src.copyto(dst)
         return out
     if len(out_nds) == 1:
         return out_nds[0]

@@ -62,33 +62,35 @@ def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None, **kwargs)
 
 def poisson(lam=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
     """Draw samples from a Poisson distribution (float output, ref parity)."""
-    return _helper("_random_poisson", None, {"lam": lam}, shape, dtype, ctx,
-                   out, kwargs)
+    return _helper("_random_poisson", "_sample_poisson", {"lam": lam}, shape,
+                   dtype, ctx, out, kwargs)
 
 
 def exponential(scale=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
     """Draw samples from an exponential distribution with mean `scale`."""
-    return _helper("_random_exponential", None, {"lam": 1.0 / scale}, shape,
-                   dtype, ctx, out, kwargs)
+    return _helper("_random_exponential", "_sample_exponential",
+                   {"lam": 1.0 / scale}, shape, dtype, ctx, out, kwargs)
 
 
 def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
     """Draw samples from a gamma distribution (shape alpha, scale beta)."""
-    return _helper("_random_gamma", None, {"alpha": alpha, "beta": beta},
-                   shape, dtype, ctx, out, kwargs)
+    return _helper("_random_gamma", "_sample_gamma",
+                   {"alpha": alpha, "beta": beta}, shape, dtype, ctx, out,
+                   kwargs)
 
 
 def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None,
                       **kwargs):
     """Draw samples from a negative binomial distribution."""
-    return _helper("_random_negative_binomial", None, {"k": k, "p": p},
-                   shape, dtype, ctx, out, kwargs)
+    return _helper("_random_negative_binomial", "_sample_negative_binomial",
+                   {"k": k, "p": p}, shape, dtype, ctx, out, kwargs)
 
 
 def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None,
                                   ctx=None, out=None, **kwargs):
     """Draw samples from a generalized negative binomial distribution."""
-    return _helper("_random_generalized_negative_binomial", None,
+    return _helper("_random_generalized_negative_binomial",
+                   "_sample_generalized_negative_binomial",
                    {"mu": mu, "alpha": alpha}, shape, dtype, ctx, out, kwargs)
 
 

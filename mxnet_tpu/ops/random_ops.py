@@ -30,7 +30,7 @@ def _uniform(key, low=0.0, high=1.0, shape=None, ctx=None, dtype=None):
 
 
 register("_random_uniform", _uniform, num_inputs=0, needs_rng=True,
-         aliases=("uniform", "random_uniform", "_sample_uniform"),
+         aliases=("uniform", "random_uniform"),
          params=dict(_SAMPLE_PARAMS, low=(pFloat, 0.0), high=(pFloat, 1.0)))
 
 
@@ -40,7 +40,7 @@ def _normal(key, loc=0.0, scale=1.0, shape=None, ctx=None, dtype=None):
 
 
 register("_random_normal", _normal, num_inputs=0, needs_rng=True,
-         aliases=("normal", "random_normal", "_sample_normal"),
+         aliases=("normal", "random_normal"),
          params=dict(_SAMPLE_PARAMS, loc=(pFloat, 0.0), scale=(pFloat, 1.0)))
 
 
@@ -136,29 +136,112 @@ register("_sample_multinomial", _multinomial, num_inputs=1, needs_rng=True,
                  "dtype": (pDtype, "int32")})
 
 
-# Tensor-parameter sampling (sample_uniform w/ per-element params)
-def _sample_uniform_t(key, low, high, shape=None, dtype=None):
-    dt = np_dtype(dtype or "float32")
+# ---------------------------------------------------------------------------
+# Tensor-parameter ("multisample") ops: params are arrays of shape [s]; the
+# output is [s]x[t] with one draw per parameter element (ref:
+# src/operator/random/multisample_op.cc — `_sample_*`, public `sample_*`)
+# ---------------------------------------------------------------------------
+
+def _multi_shapes(param, shape):
+    """(out_shape, param broadcast shape) for multisample semantics."""
     s = tuple(shape) if shape else ()
-    out_shape = low.shape + s
+    return param.shape + s, param.shape + (1,) * len(s)
+
+
+def _multi_dtype(dtype, param):
+    return np_dtype(dtype) if dtype else param.dtype
+
+
+def _sample_uniform_t(key, low, high, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(low, shape)
+    dt = np_dtype(dtype or "float32")
     u = jax.random.uniform(key, out_shape, dt)
-    bshape = low.shape + (1,) * len(s)
-    return u * (high.reshape(bshape) - low.reshape(bshape)) + low.reshape(bshape)
+    lo, hi = low.reshape(bshape), high.reshape(bshape)
+    return u * (hi - lo) + lo
 
 
-register("_sample_uniform_tensor", _sample_uniform_t, num_inputs=2, needs_rng=True,
+register("_sample_uniform", _sample_uniform_t, num_inputs=2, needs_rng=True,
+         aliases=("sample_uniform", "_sample_uniform_tensor"),
          params={"shape": (pShape, None), "dtype": (pDtype, None)})
 
 
 def _sample_normal_t(key, mu, sigma, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(mu, shape)
     dt = np_dtype(dtype or "float32")
-    s = tuple(shape) if shape else ()
-    out_shape = mu.shape + s
-    bshape = mu.shape + (1,) * len(s)
-    return jax.random.normal(key, out_shape, dt) * sigma.reshape(bshape) + mu.reshape(bshape)
+    z = jax.random.normal(key, out_shape, dt)
+    return z * sigma.reshape(bshape) + mu.reshape(bshape)
 
 
-register("_sample_normal_tensor", _sample_normal_t, num_inputs=2, needs_rng=True,
+register("_sample_normal", _sample_normal_t, num_inputs=2, needs_rng=True,
+         aliases=("sample_normal", "_sample_normal_tensor"),
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_gamma_t(key, alpha, beta, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(alpha, shape)
+    dt = _multi_dtype(dtype, alpha)
+    g = jax.random.gamma(key, alpha.reshape(bshape).astype(dt), out_shape, dt)
+    return g * beta.reshape(bshape).astype(dt)
+
+
+register("_sample_gamma", _sample_gamma_t, num_inputs=2, needs_rng=True,
+         aliases=("sample_gamma",),
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_exponential_t(key, lam, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(lam, shape)
+    dt = _multi_dtype(dtype, lam)
+    return jax.random.exponential(key, out_shape, dt) \
+        / lam.reshape(bshape).astype(dt)
+
+
+register("_sample_exponential", _sample_exponential_t, num_inputs=1,
+         needs_rng=True, aliases=("sample_exponential",),
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_poisson_t(key, lam, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(lam, shape)
+    dt = _multi_dtype(dtype, lam)
+    rate = jnp.broadcast_to(lam.reshape(bshape), out_shape)
+    return jax.random.poisson(key, rate, out_shape).astype(dt)
+
+
+register("_sample_poisson", _sample_poisson_t, num_inputs=1, needs_rng=True,
+         aliases=("sample_poisson",),
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_negative_binomial_t(key, k, p, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(k, shape)
+    dt = _multi_dtype(dtype, p)
+    k1, k2 = jax.random.split(key)
+    kk = jnp.broadcast_to(k.reshape(bshape), out_shape).astype(jnp.float32)
+    pp = jnp.broadcast_to(p.reshape(bshape), out_shape).astype(jnp.float32)
+    lam = jax.random.gamma(k1, kk, out_shape) * (1 - pp) / pp
+    return jax.random.poisson(k2, lam, out_shape).astype(dt)
+
+
+register("_sample_negative_binomial", _sample_negative_binomial_t,
+         num_inputs=2, needs_rng=True, aliases=("sample_negative_binomial",),
+         params={"shape": (pShape, None), "dtype": (pDtype, None)})
+
+
+def _sample_gen_negative_binomial_t(key, mu, alpha, shape=None, dtype=None):
+    out_shape, bshape = _multi_shapes(mu, shape)
+    dt = _multi_dtype(dtype, mu)
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / jnp.broadcast_to(alpha.reshape(bshape), out_shape) \
+        .astype(jnp.float32)
+    mub = jnp.broadcast_to(mu.reshape(bshape), out_shape).astype(jnp.float32)
+    lam = jax.random.gamma(k1, r, out_shape) * mub / r
+    return jax.random.poisson(k2, lam, out_shape).astype(dt)
+
+
+register("_sample_generalized_negative_binomial",
+         _sample_gen_negative_binomial_t, num_inputs=2, needs_rng=True,
+         aliases=("sample_generalized_negative_binomial",),
          params={"shape": (pShape, None), "dtype": (pDtype, None)})
 
 

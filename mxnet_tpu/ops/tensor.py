@@ -591,3 +591,84 @@ register("ones_like", lambda x: jnp.ones_like(x), num_inputs=1)
 
 register("shape_array", lambda x: jnp.asarray(x.shape, jnp.int64), num_inputs=1)
 register("size_array", lambda x: jnp.asarray([x.size], jnp.int64), num_inputs=1)
+
+
+# ---------------------------------------------------------------------------
+# Sparse-storage ops (ref: src/operator/tensor/cast_storage-inl.h,
+# sparse_retain-inl.h, square_sum-inl.h).  Dense impls keep these usable in
+# symbol graphs (whole-graph XLA has only dense buffers); imperative sparse
+# inputs dispatch to the FComputeEx-analog sparse_impl below.
+# ---------------------------------------------------------------------------
+
+def _cast_storage_dense(data, stype="default"):
+    # storage type is an NDArray-level concept: inside a jitted graph every
+    # buffer is dense, so the node is an identity marker
+    return data
+
+
+def _cast_storage_sparse(inputs, attrs):
+    arr = inputs[0]
+    stype = attrs.get("stype", "default")
+    return (arr.todense() if stype == "default" else arr.tostype(stype),)
+
+
+register("cast_storage", _cast_storage_dense, num_inputs=1,
+         sparse_impl=_cast_storage_sparse,
+         params={"stype": (pStr, "default")})
+
+
+def _sparse_retain_dense(data, indices):
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), bool).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+def _sparse_retain_sparse(inputs, attrs):
+    return (inputs[0].retain(inputs[1]),)
+
+
+register("sparse_retain", _sparse_retain_dense, num_inputs=2,
+         input_names=["data", "indices"],
+         sparse_impl=_sparse_retain_sparse,
+         sparse_pattern=("row_sparse", "default"))
+
+
+def _square_sum_dense(data, axis=None, keepdims=False, exclude=False):
+    ax = _norm_axis(axis, data.ndim, exclude)
+    return jnp.sum(data * data, axis=ax, keepdims=bool(keepdims))
+
+
+def _square_sum_sparse(inputs, attrs):
+    """row_sparse fast path: reduce over the stored rows only (ref:
+    square_sum-inl.h — 2-D input, axis 0 or 1; axis=1+keepdims yields
+    row_sparse).  Anything richer declines to the dense fallback."""
+    from ..ndarray import sparse as _sp
+    from ..ndarray import NDArray as _ND
+    rsp = inputs[0]
+    if attrs.get("exclude") or len(rsp.shape) != 2:
+        return NotImplemented
+    axis = attrs.get("axis")
+    keepdims = bool(attrs.get("keepdims", False))
+    if isinstance(axis, tuple):
+        axis = axis[0] if len(axis) == 1 else None
+    data = rsp.data._h.array
+    n_rows = rsp.shape[0]
+    if axis == 1 or axis == -1:
+        row_vals = jnp.sum(data * data, axis=tuple(range(1, data.ndim)))
+        if keepdims:
+            out_shape = (n_rows, 1)
+            return (_sp.RowSparseNDArray(
+                _ND(row_vals[:, None]), rsp.indices, out_shape),)
+        idx = rsp.indices._h.array.astype(jnp.int32)
+        return (jnp.zeros((n_rows,), data.dtype).at[idx].set(row_vals),)
+    if axis == 0:
+        out = jnp.sum(data * data, axis=0)
+        return (out[None] if keepdims else out,)
+    return (jnp.sum(data * data),)
+
+
+register("_square_sum", _square_sum_dense, num_inputs=1,
+         aliases=("square_sum",),
+         sparse_impl=_square_sum_sparse,
+         sparse_pattern=("row_sparse",),
+         params=_REDUCE_PARAMS)

@@ -51,27 +51,29 @@ def normal(loc=0, scale=1, shape=None, dtype=None, **kwargs):
 
 
 def poisson(lam=1, shape=None, dtype=None, **kwargs):
-    return _helper("_random_poisson", None, {"lam": lam}, shape, dtype, kwargs)
+    return _helper("_random_poisson", "_sample_poisson", {"lam": lam},
+                   shape, dtype, kwargs)
 
 
 def exponential(scale=1, shape=None, dtype=None, **kwargs):
-    return _helper("_random_exponential", None, {"lam": 1.0 / scale},
-                   shape, dtype, kwargs)
+    return _helper("_random_exponential", "_sample_exponential",
+                   {"lam": 1.0 / scale}, shape, dtype, kwargs)
 
 
 def gamma(alpha=1, beta=1, shape=None, dtype=None, **kwargs):
-    return _helper("_random_gamma", None, {"alpha": alpha, "beta": beta},
-                   shape, dtype, kwargs)
+    return _helper("_random_gamma", "_sample_gamma",
+                   {"alpha": alpha, "beta": beta}, shape, dtype, kwargs)
 
 
 def negative_binomial(k=1, p=1, shape=None, dtype=None, **kwargs):
-    return _helper("_random_negative_binomial", None, {"k": k, "p": p},
-                   shape, dtype, kwargs)
+    return _helper("_random_negative_binomial", "_sample_negative_binomial",
+                   {"k": k, "p": p}, shape, dtype, kwargs)
 
 
 def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None,
                                   **kwargs):
-    return _helper("_random_generalized_negative_binomial", None,
+    return _helper("_random_generalized_negative_binomial",
+                   "_sample_generalized_negative_binomial",
                    {"mu": mu, "alpha": alpha}, shape, dtype, kwargs)
 
 

@@ -323,3 +323,91 @@ def test_norm_and_l2():
     l2 = mx.nd.L2Normalization(mx.nd.array(x), mode="instance")
     expected = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
     assert_almost_equal(l2.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_parameter_samplers():
+    """Multisample ops (ref: multisample_op.cc): params of shape [s] ->
+    output [s]x[t], one distribution per parameter element."""
+    alpha = mx.nd.array([1.0, 8.0])
+    beta = mx.nd.array([1.0, 2.0])
+    g = mx.nd.random.gamma(alpha, beta, shape=(4000,))
+    assert g.shape == (2, 4000)
+    m = g.asnumpy().mean(axis=1)
+    assert abs(m[0] - 1.0) < 0.2 and abs(m[1] - 16.0) < 2.0
+
+    lam = mx.nd.array([2.0, 10.0])
+    p = mx.nd.random.poisson(lam, shape=(4000,))
+    mp = p.asnumpy().mean(axis=1)
+    assert abs(mp[0] - 2.0) < 0.3 and abs(mp[1] - 10.0) < 0.7
+
+    e = mx.nd.random.exponential(mx.nd.array([1.0, 4.0]), shape=(4000,))
+    me = e.asnumpy().mean(axis=1)
+    assert abs(me[0] - 1.0) < 0.2 and abs(me[1] - 4.0) < 0.6
+
+    nb = mx.nd.random.negative_binomial(
+        mx.nd.array([3.0]), mx.nd.array([0.4]), shape=(6000,))
+    assert abs(nb.asnumpy().mean() - 4.5) < 0.6
+
+    gnb = mx.nd.random.generalized_negative_binomial(
+        mx.nd.array([5.0]), mx.nd.array([0.3]), shape=(6000,))
+    assert abs(gnb.asnumpy().mean() - 5.0) < 0.7
+
+    # public op names + no-shape default (one draw per distribution)
+    s = mx.nd.sample_gamma(alpha, beta)
+    assert s.shape == (2,)
+    # symbol path builds and runs
+    sym = mx.sym.random.normal(mx.sym.Variable("mu"), mx.sym.Variable("sg"),
+                               shape=(8,))
+    exe = sym.simple_bind(mx.cpu(), mu=(3,), sg=(3,))
+    exe.arg_dict["mu"][:] = [0.0, 5.0, -5.0]
+    exe.arg_dict["sg"][:] = [1.0, 1.0, 1.0]
+    out = exe.forward()[0].asnumpy()
+    assert out.shape == (3, 8)
+    assert abs(out[1].mean() - 5.0) < 1.5 and abs(out[2].mean() + 5.0) < 1.5
+
+
+def test_sparse_storage_ops_registered():
+    """cast_storage / sparse_retain / _square_sum as ops in both namespaces
+    (ref: cast_storage-inl.h, sparse_retain-inl.h, square_sum-inl.h)."""
+    d = mx.nd.array([[1.0, 0.0, 2.0], [0.0, 0.0, 0.0], [3.0, 0.0, 0.0]])
+    rs = mx.nd.cast_storage(d, "row_sparse")
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(
+        mx.nd.cast_storage(rs, "default").asnumpy(), d.asnumpy())
+    assert mx.nd.cast_storage(d, "csr").stype == "csr"
+
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), [0, 2]), shape=(4, 3))
+    idx = mx.nd.array([2.0])
+    kept_sparse = mx.nd.sparse_retain(rsp, idx)
+    assert kept_sparse.stype == "row_sparse"
+    kept_dense = mx.nd.sparse_retain(rsp.todense(), idx)
+    np.testing.assert_allclose(kept_sparse.todense().asnumpy(),
+                               kept_dense.asnumpy())
+
+    q_sp = mx.nd._square_sum(rsp, axis=1, keepdims=True)
+    assert q_sp.stype == "row_sparse"
+    q_dn = mx.nd.square_sum(rsp.todense(), axis=1, keepdims=True)
+    np.testing.assert_allclose(q_sp.todense().asnumpy(), q_dn.asnumpy())
+    assert abs(float(mx.nd.square_sum(rsp).asnumpy()) - 6.0) < 1e-6
+
+    # symbol namespace: the ops exist and run dense
+    ssym = mx.sym.sparse_retain(mx.sym.Variable("x"), mx.sym.Variable("i"))
+    exe = ssym.simple_bind(mx.cpu(), x=(4, 3), i=(1,))
+    exe.arg_dict["x"][:] = rsp.todense().asnumpy()
+    exe.arg_dict["i"][:] = [2.0]
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(),
+                               kept_dense.asnumpy())
+    qsym = mx.sym.square_sum(mx.sym.Variable("x"), axis=1)
+    exe2 = qsym.simple_bind(mx.cpu(), x=(4, 3))
+    exe2.arg_dict["x"][:] = rsp.todense().asnumpy()
+    np.testing.assert_allclose(
+        exe2.forward()[0].asnumpy(),
+        (rsp.todense().asnumpy() ** 2).sum(axis=1))
+
+
+def test_square_sum_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    x = mx.sym.Variable("x")
+    sym = mx.sym.square_sum(x, axis=1)
+    check_numeric_gradient(sym, [np.random.rand(3, 4).astype(np.float32)])
