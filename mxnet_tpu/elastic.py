@@ -77,6 +77,11 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         return module
 
     states_file = "%s-%04d.states" % (prefix, start)
+    if save_optimizer_states and start > 0 and not os.path.exists(states_file):
+        logger.warning(
+            "elastic resume: params checkpoint for epoch %d has no matching "
+            ".states file — optimizer state (momentum/moments) restarts "
+            "from zero", start)
     if save_optimizer_states and start > 0 and os.path.exists(states_file):
         # optimizer state exists only after init_optimizer runs inside
         # fit; restore it immediately after (momentum/Adam moments survive
@@ -90,12 +95,19 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         module.init_optimizer = _init_then_load
 
     cb = fit_kwargs.pop("epoch_end_callback", None)
-    cbs = [do_checkpoint(prefix)]
+    # .states is written atomically and BEFORE the params checkpoint: a
+    # crash between the two leaves states-without-params (harmless — resume
+    # keys off the params file) rather than params-without-states (silent
+    # momentum loss)
+    cbs = []
     if save_optimizer_states:
         def _save_states(iter_no, sym, arg, aux):
-            module.save_optimizer_states(
-                "%s-%04d.states" % (prefix, iter_no + 1))
+            final = "%s-%04d.states" % (prefix, iter_no + 1)
+            tmp = final + ".tmp"
+            module.save_optimizer_states(tmp)
+            os.replace(tmp, final)
         cbs.append(_save_states)
+    cbs.append(do_checkpoint(prefix))
     if cb is not None:
         cbs.extend(cb if isinstance(cb, (list, tuple)) else [cb])
     # force_init when resuming: the checkpoint is authoritative even if

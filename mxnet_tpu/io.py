@@ -562,8 +562,11 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
         raise MXNetError("ImageRecordIter requires data_shape")
     data_shape = tuple(int(x) for x in data_shape)
     if seed is not None:
-        # augmenters draw from the global RNGs (same as the reference's
-        # per-process default seeding)
+        # NOTE: augmenters draw from the process-global RNGs, so seeding
+        # here affects (and is affected by) other global-RNG users — two
+        # iterators with different seeds interleave one stream.  The seed
+        # is re-applied on every reset() (below) so each epoch's order is
+        # reproducible even when other code draws between epochs.
         import random as _pyrandom
         _pyrandom.seed(int(seed))
         np.random.seed(int(seed) & 0x7FFFFFFF)
@@ -587,12 +590,30 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
                           rand_mirror=rand_mirror, mean=mean, std=std,
                           brightness=brightness, contrast=contrast,
                           saturation=saturation, pca_noise=pca_noise)
-    return ImageIter(batch_size=batch_size, data_shape=data_shape,
-                     label_width=label_width, path_imgrec=path_imgrec,
-                     path_imgidx=path_imgidx, shuffle=shuffle,
-                     part_index=part_index, num_parts=num_parts,
-                     aug_list=aug, data_name=data_name,
-                     label_name=label_name)
+    it = ImageIter(batch_size=batch_size, data_shape=data_shape,
+                   label_width=label_width, path_imgrec=path_imgrec,
+                   path_imgidx=path_imgidx, shuffle=shuffle,
+                   part_index=part_index, num_parts=num_parts,
+                   aug_list=aug, data_name=data_name,
+                   label_name=label_name)
+    if seed is not None:
+        # reproducible epochs: reset() re-seeds the global RNGs from
+        # (seed, epoch index), so epoch k's shuffle/augment stream depends
+        # only on the seed — not on interleaved global-RNG draws — while
+        # successive epochs still get distinct augmentation draws
+        base_reset = it.reset
+        epoch_box = [0]
+
+        def _reset_with_seed():
+            import random as _pyrandom
+            epoch_seed = (int(seed) + 1000003 * epoch_box[0]) & 0x7FFFFFFF
+            epoch_box[0] += 1
+            _pyrandom.seed(epoch_seed)
+            np.random.seed(epoch_seed)
+            base_reset()
+
+        it.reset = _reset_with_seed
+    return it
 
 
 def ImageRecordIter_v1(**kwargs):

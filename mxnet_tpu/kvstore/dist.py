@@ -37,6 +37,7 @@ from . import KVStore, _key_value
 from .gradient_compression import GradientCompression
 
 _rendezvoused = False
+_barrier_seq = 0  # process-global so barrier names are never reused
 
 
 def _global_state():
@@ -216,6 +217,23 @@ class DistKVStore(KVStore):
                 merged.copyto(stored)
 
     def barrier(self):
-        # a scalar allreduce is a barrier: nobody leaves before all arrive
-        # (no-op when single-process — _allreduce handles that)
+        """Named rendezvous barrier.
+
+        An anonymous scalar allreduce pairs purely by call order: a rank
+        calling barrier() a different number of times would silently pair
+        its barrier with a peer's data reduction and corrupt values.  So
+        a per-call named coordination-service barrier runs FIRST — call
+        skew fails loudly there (timeout) — and the scalar allreduce runs
+        after it, preserving this method's role as the gloo-context
+        warm-up collective (see __init__)."""
+        global _barrier_seq
+        _barrier_seq += 1  # process-global: barrier ids never reused
+        try:
+            from jax._src import distributed
+            client = getattr(distributed.global_state, "client", None)
+        except Exception:
+            client = None
+        if client is not None:
+            client.wait_at_barrier(
+                "mxnet_tpu_kv_barrier_%d" % _barrier_seq, 180_000)
         self._allreduce_across_hosts(jnp.zeros((1,), jnp.float32))

@@ -60,6 +60,16 @@ class Predictor:
         # which args are real weights (came from the param blob) vs
         # data-like extras (labels) — reshape treats them differently
         self._param_names = set(arg_params) | set(aux_params)
+        self._out_shapes = self._infer_out_shapes()
+
+    def _infer_out_shapes(self):
+        """Output shapes from the bound argument shapes — the reference
+        computes these at MXPredCreate time (c_predict_api.cc), so
+        get_output_shape must be valid BEFORE the first forward (C
+        consumers size their output buffers with it)."""
+        bound = {n: tuple(a.shape) for n, a in self._exe.arg_dict.items()}
+        _, out_shapes, _ = self._symbol.infer_shape(**bound)
+        return [tuple(s) for s in out_shapes]
 
     def set_input(self, name, data):
         """MXPredSetInput."""
@@ -82,6 +92,7 @@ class Predictor:
     def reshape(self, input_shapes):
         """MXPredReshape: re-bind with new shapes (re-jit per signature)."""
         self._exe = self._exe.reshape(**input_shapes)
+        self._out_shapes = self._infer_out_shapes()
         return self
 
     def reshaped(self, input_shapes):
@@ -125,6 +136,7 @@ class Predictor:
                                   allow_extra_params=True)
         new._input_names = set(shape_kwargs)
         new._param_names = set(self._param_names)
+        new._out_shapes = new._infer_out_shapes()
         return new
 
     # -- raw-buffer entry points for the C ABI (src/c_predict_api.cc) -------
@@ -138,8 +150,10 @@ class Predictor:
         self.set_input(name, data)
 
     def get_output_shape(self, index=0):
-        """MXPredGetOutputShape."""
-        return tuple(self._exe.outputs[index].shape)
+        """MXPredGetOutputShape — valid immediately after create."""
+        if self._exe.outputs:
+            return tuple(self._exe.outputs[index].shape)
+        return self._out_shapes[index]
 
     def get_output_bytes(self, index=0):
         """MXPredGetOutput as raw float32 bytes (C ABI marshalling)."""

@@ -102,11 +102,25 @@ extern "C" {
 
 const char *MXGetLastError() { return last_error.c_str(); }
 
+// NULL handles must produce -1 + MXGetLastError, not a segfault — ported C
+// consumers probe error paths this way
+#define MXPRED_CHECK_HANDLE(h)                    \
+  if ((h) == nullptr) {                           \
+    last_error = "null PredictorHandle";          \
+    return -1;                                    \
+  }
+
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,
                  mx_uint num_input_nodes, const char **input_keys,
                  const mx_uint *input_shape_indptr,
                  const mx_uint *input_shape_data, PredictorHandle *out) {
+  if (!symbol_json_str || !param_bytes || !out ||
+      (num_input_nodes > 0 &&
+       (!input_keys || !input_shape_indptr || !input_shape_data))) {
+    last_error = "MXPredCreate: null argument";
+    return -1;
+  }
   GIL gil;
   PyObject *mod = PyImport_ImportModule("mxnet_tpu.predict");
   if (!mod) { set_err_from_python(); return -1; }
@@ -114,22 +128,31 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
   Py_DECREF(mod);
   if (!cls) { set_err_from_python(); return -1; }
 
-  PyObject *json = PyUnicode_FromString(symbol_json_str);
-  PyObject *blob = PyBytes_FromStringAndSize(
-      static_cast<const char *>(param_bytes), param_size);
-  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
-                                 input_shape_indptr, input_shape_data);
+  // each allocation is checked before use: passing a NULL into
+  // Py_BuildValue crashes instead of reporting through MXGetLastError
+  PyObject *json = nullptr, *blob = nullptr, *shapes = nullptr;
+  PyObject *args = nullptr, *kwargs = nullptr, *inst = nullptr;
+  json = PyUnicode_FromString(symbol_json_str);
+  if (json) {
+    blob = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+  }
+  if (blob) {
+    shapes = shapes_dict(num_input_nodes, input_keys,
+                         input_shape_indptr, input_shape_data);
+  }
   const char *dev = dev_type == 2 ? "tpu" : "cpu";
-  PyObject *args = Py_BuildValue("(OOO)", json, blob, shapes);
-  PyObject *kwargs = Py_BuildValue("{s:s,s:i}", "dev_type", dev,
-                                   "dev_id", dev_id);
-  PyObject *inst = PyObject_Call(cls, args, kwargs);
+  if (shapes) args = Py_BuildValue("(OOO)", json, blob, shapes);
+  if (args) {
+    kwargs = Py_BuildValue("{s:s,s:i}", "dev_type", dev, "dev_id", dev_id);
+  }
+  if (kwargs) inst = PyObject_Call(cls, args, kwargs);
   Py_DECREF(cls);
-  Py_DECREF(json);
-  Py_DECREF(blob);
-  Py_DECREF(shapes);
-  Py_DECREF(args);
-  Py_DECREF(kwargs);
+  Py_XDECREF(json);
+  Py_XDECREF(blob);
+  Py_XDECREF(shapes);
+  Py_XDECREF(args);
+  Py_XDECREF(kwargs);
   if (!inst) { set_err_from_python(); return -1; }
   auto *p = new PredictorObj{inst, {}};
   *out = p;
@@ -138,6 +161,11 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
 
 int MXPredSetInput(PredictorHandle handle, const char *key,
                    const mx_float *data, mx_uint size) {
+  MXPRED_CHECK_HANDLE(handle);
+  if (!key || (!data && size > 0)) {
+    last_error = "MXPredSetInput: null argument";
+    return -1;
+  }
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   // hand the buffer over as bytes; set_input reshapes to the bound shape
@@ -151,6 +179,7 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
 }
 
 int MXPredForward(PredictorHandle handle) {
+  MXPRED_CHECK_HANDLE(handle);
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *r = PyObject_CallMethod(p->py, "forward", nullptr);
@@ -161,6 +190,11 @@ int MXPredForward(PredictorHandle handle) {
 
 int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim) {
+  MXPRED_CHECK_HANDLE(handle);
+  if (!shape_data || !shape_ndim) {
+    last_error = "MXPredGetOutputShape: null output pointer";
+    return -1;
+  }
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *r = PyObject_CallMethod(p->py, "get_output_shape", "I", index);
@@ -180,6 +214,11 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
 
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
                     mx_uint size) {
+  MXPRED_CHECK_HANDLE(handle);
+  if (!data && size > 0) {
+    last_error = "MXPredGetOutput: null buffer";
+    return -1;
+  }
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *r = PyObject_CallMethod(p->py, "get_output_bytes", "I", index);
@@ -206,6 +245,12 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
 int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
                   const char **input_keys, const mx_uint *input_shape_indptr,
                   const mx_uint *input_shape_data, PredictorHandle *out) {
+  MXPRED_CHECK_HANDLE(handle);
+  if (!out || (num_input_nodes > 0 &&
+               (!input_keys || !input_shape_indptr || !input_shape_data))) {
+    last_error = "MXPredReshape: null argument";
+    return -1;
+  }
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
@@ -221,6 +266,7 @@ int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
 }
 
 int MXPredFree(PredictorHandle handle) {
+  if (handle == nullptr) return 0;  // free(NULL) is a no-op
   GIL gil;
   auto *p = static_cast<PredictorObj *>(handle);
   Py_XDECREF(p->py);

@@ -356,3 +356,72 @@ def test_engine_tsan_stress(tmp_path):
     assert run.returncode == 0, \
         "TSAN reported races or ordering broke:\n" + run.stdout + run.stderr
     assert "ENGINE_TSAN_STRESS_OK" in run.stdout
+
+
+def test_c_predict_output_shape_before_forward(tmp_path):
+    """MXPredGetOutputShape must be valid right after MXPredCreate — C
+    consumers size their output buffers before calling Forward (ref ABI
+    contract: the reference computes out_shapes at create time)."""
+    import ctypes
+    import os
+    from mxnet_tpu.io_native import get_cpredict_lib
+
+    lib = get_cpredict_lib()
+    if lib is None:
+        pytest.skip("C predict library unavailable (no toolchain)")
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3, name="fc"),
+        name="softmax")
+    rng = np.random.RandomState(1)
+    params = {"arg:fc_weight": mx.nd.array(rng.rand(3, 4).astype(np.float32)),
+              "arg:fc_bias": mx.nd.array(rng.rand(3).astype(np.float32))}
+    pfile = os.path.join(str(tmp_path), "m-0000.params")
+    mx.nd.save(pfile, params)
+    with open(pfile, "rb") as f:
+        blob = f.read()
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape = (ctypes.c_uint32 * 2)(5, 4)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(net.tojson().encode(), blob, len(blob), 1, 0, 1,
+                          keys, indptr, shape, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    # shape query BEFORE any forward
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError().decode()
+    assert ndim.value == 2 and sdata[0] == 5 and sdata[1] == 3
+    lib.MXPredFree(handle)
+
+    # python-side too
+    from mxnet_tpu.predict import Predictor
+    p = Predictor(net.tojson(), {"arg:" + k[4:]: v for k, v in params.items()},
+                  {"data": (7, 4)})
+    assert p.get_output_shape(0) == (7, 3)
+
+
+def test_c_predict_null_handle_is_error_not_crash():
+    """NULL handles return -1 with MXGetLastError set (ADVICE: used to
+    segfault)."""
+    import ctypes
+    from mxnet_tpu.io_native import get_cpredict_lib
+
+    lib = get_cpredict_lib()
+    if lib is None:
+        pytest.skip("C predict library unavailable (no toolchain)")
+    assert lib.MXPredForward(None) == -1
+    assert b"null" in lib.MXGetLastError()
+    assert lib.MXPredSetInput(None, b"data", None, 0) == -1
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    assert lib.MXPredGetOutputShape(None, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == -1
+    assert lib.MXPredGetOutput(None, 0, None, 4) == -1
+    assert lib.MXPredFree(None) == 0  # free(NULL) no-op
+    out = ctypes.c_void_p()
+    assert lib.MXPredCreate(None, None, 0, 1, 0, 0, None, None, None,
+                            ctypes.byref(out)) == -1
