@@ -602,7 +602,9 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
         # only on the seed — not on interleaved global-RNG draws — while
         # successive epochs still get distinct augmentation draws
         base_reset = it.reset
-        epoch_box = [0]
+        # construction already consumed the seed-0 stream (ImageIter's own
+        # init-time reset/shuffle), so the first wrapped reset starts at 1
+        epoch_box = [1]
 
         def _reset_with_seed():
             import random as _pyrandom

@@ -224,6 +224,25 @@ register("Convolution", _convolution, input_names=("data", "weight", "bias"),
          aliases=("Convolution_v1",))
 
 
+def _deconv_pad_adj(in_spatial, ke, stride, pad, adj, target_shape):
+    """Effective (pad, adj) per spatial dim.  target_shape overrides both
+    with a CENTERED crop (ref: deconvolution-inl.h InferPad:116-137 —
+    total = s(i-1)+ke-t, pad=(total+1)/2, adj=total%2)."""
+    nd = len(ke)
+    if not target_shape:
+        return tuple(pad), (tuple(adj) if adj else (0,) * nd)
+    pads, adjs = [], []
+    for t, i, s, k in zip(target_shape, in_spatial, stride, ke):
+        total = s * (int(i) - 1) + k - int(t)
+        if total < 0:
+            raise MXNetError(
+                "Deconvolution: target_shape %s exceeds the full output "
+                "size" % (tuple(target_shape),))
+        adjs.append(total % 2)
+        pads.append((total + 1) // 2)
+    return tuple(pads), tuple(adjs)
+
+
 def _deconvolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
                    pad=None, adj=None, target_shape=None, num_filter=1,
                    num_group=1, no_bias=True, workspace=1024, cudnn_tune=None,
@@ -232,15 +251,41 @@ def _deconvolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
-    # Deconv == gradient of conv w.r.t. input: conv_transpose with IOHW kernel
-    out = lax.conv_transpose(
-        data, jnp.swapaxes(weight, 0, 1) if num_group == 1 else weight,
-        strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=_conv_dn(nd),
-        transpose_kernel=True,
-    )
+    # Deconv == gradient of conv w.r.t. input.  The MXNet weight layout is
+    # (C_in, num_filter/g, kh, kw) — with transpose_kernel=True and OIHW
+    # dimension numbers, conv_transpose wants exactly the forward conv's
+    # kernel layout (O_fwd=C_in, I_fwd=num_filter/g), so the weight passes
+    # through unchanged (deconvolution-inl.h semantics).
+    #
+    # conv_transpose's explicit padding applies to the stride-dilated input,
+    # so MXNet's crop semantics (out = (i-1)*s + ke - 2p + adj, where
+    # ke = (k-1)*dilate + 1) translate to (ke-1-p, ke-1-p+adj) per side.
+    ke = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    pad, adjv = _deconv_pad_adj(data.shape[2:], ke, stride, pad, adj,
+                                target_shape)
+    padding = [(k - 1 - p, k - 1 - p + a)
+               for k, p, a in zip(ke, pad, adjv)]
+
+    def one_group(d, w):
+        return lax.conv_transpose(
+            d, w,
+            strides=stride,
+            padding=padding,
+            rhs_dilation=dilate,
+            dimension_numbers=_conv_dn(nd),
+            transpose_kernel=True,
+        )
+
+    g = int(num_group)
+    if g == 1:
+        out = one_group(data, weight)
+    else:
+        # conv_transpose has no group support: split C_in into g groups,
+        # transpose-convolve each, concatenate the per-group outputs
+        d_groups = jnp.split(data, g, axis=1)
+        w_groups = jnp.split(weight, g, axis=0)
+        out = jnp.concatenate(
+            [one_group(d, w) for d, w in zip(d_groups, w_groups)], axis=1)
     if not no_bias:
         out = out + rest[0].reshape((1, -1) + (1,) * nd)
     return out
@@ -262,8 +307,12 @@ def _deconv_infer_shape(in_shapes, attrs):
     filled[1] = (dshape[1], num_filter // num_group) + tuple(kernel)
     if not no_bias:
         filled[2] = (num_filter,)
-    spatial = tuple(stride[i] * (dshape[2 + i] - 1) + (dilate[i] * (kernel[i] - 1) + 1)
-                    - 2 * pad[i] for i in range(nd))
+    ke = [(kernel[i] - 1) * dilate[i] + 1 for i in range(nd)]
+    pad_eff, adj_eff = _deconv_pad_adj(
+        dshape[2:], ke, stride, pad, attrs.get("adj"),
+        attrs.get("target_shape"))
+    spatial = tuple(stride[i] * (dshape[2 + i] - 1) + ke[i]
+                    - 2 * pad_eff[i] + adj_eff[i] for i in range(nd))
     return filled, [(dshape[0], num_filter) + spatial]
 
 

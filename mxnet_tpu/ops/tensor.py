@@ -57,7 +57,7 @@ for _n, _f in _LOGIC.items():
 register("_grad_add", lambda a, b: a + b, num_inputs=2)
 
 
-def _add_n(*args):
+def _add_n(*args, num_args=0):
     out = args[0]
     for a in args[1:]:
         out = out + a

@@ -255,6 +255,7 @@ int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
   auto *p = static_cast<PredictorObj *>(handle);
   PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
                                  input_shape_indptr, input_shape_data);
+  if (!shapes) { set_err_from_python(); return -1; }
   // `reshaped` returns a NEW predictor sharing the weights — the old
   // handle stays valid with its old shapes and both handles must be
   // freed, matching the reference contract
