@@ -19,8 +19,10 @@ from jax import shard_map
 
 def _pipeline_local(stage_params, x_micro, stage_fn, axis_name):
     """Inside shard_map.  stage_params: this stage's params (pytree, leading
-    layer dim already sharded away); x_micro: [n_micro, mb, ...] full
-    microbatch stream (replicated); returns [n_micro, mb, ...] outputs."""
+    layer dim already sharded away); x_micro: [n_micro_local, mb, ...] this
+    chip's microbatch stream — when the caller runs data parallelism over
+    the leading dim, n_micro_local is the per-replica share, not the
+    caller's n_micro.  Returns [n_micro_local, mb, ...] outputs."""
     pp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = x_micro.shape[0]
@@ -51,13 +53,18 @@ def _pipeline_local(stage_params, x_micro, stage_fn, axis_name):
 
 
 def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
-                    axis_name="pp", params_spec=None, batch_axis=None):
+                    axis_name="pp", params_spec=None, batch_axis=None,
+                    tail_spec=None):
     """Run x through pp pipeline stages.
 
     stage_params: pytree whose leaves have a leading `n_stages` dim, sharded
     over `axis_name` (each chip gets its stage's slice).
-    x: [batch, ...] replicated input; split into n_micro microbatches.
+    x: [batch, ...] input; split into n_micro microbatches.
     stage_fn(params_slice, x_mb) -> y_mb, same shape as x_mb.
+    tail_spec: PartitionSpec entries for x's trailing (non-batch) dims —
+    pass the sharding those dims already carry (e.g. ("sp", None) for
+    [b, seq, d] with sequence parallelism) so the shard_map boundary does
+    not force a reshard.
     """
     from .mesh import current_mesh
     mesh = mesh or current_mesh()
@@ -69,6 +76,17 @@ def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
     if params_spec is None:
         params_spec = jax.tree_util.tree_map(
             lambda _: P(axis_name), stage_params)
+    tail = tuple(tail_spec) if tail_spec else (None,) * (x.ndim - 1)
+
+    # the [b] -> [n_micro, mb] reshape lands the batch sharding on the
+    # LEADING (microbatch-count) dim; keep dp there when it divides evenly
+    # so the shard_map boundary matches the surrounding layout instead of
+    # triggering an SPMD full-rematerialization copy
+    dp_size = mesh.shape.get(batch_axis, 1) if batch_axis else 1
+    if batch_axis and n_micro % dp_size == 0:
+        x_spec = P(batch_axis, None, *tail)
+    else:
+        x_spec = P(None, batch_axis, *tail)
 
     def local(params, xm):
         # shard_map hands each chip params with the stage dim = 1; drop it
@@ -76,8 +94,8 @@ def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
         return _pipeline_local(params, xm, stage_fn, axis_name)
 
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(params_spec, P(None, batch_axis)),
-                   out_specs=P(None, batch_axis),
+                   in_specs=(params_spec, x_spec),
+                   out_specs=x_spec,
                    check_vma=False)
     y_micro = fn(stage_params, x_micro)
     return y_micro.reshape((b,) + y_micro.shape[2:])

@@ -280,6 +280,17 @@ case("Convolution", [signed(1, 2, 5, 5), signed(3, 2, 3, 3), signed(3)],
      attrs={"kernel": (3, 3), "num_filter": 3}, rtol=8e-2)
 case("Deconvolution", [signed(1, 2, 4, 4), signed(2, 3, 2, 2), signed(3)],
      attrs={"kernel": (2, 2), "num_filter": 3}, rtol=8e-2)
+case("Deconvolution", [signed(1, 2, 4, 4), signed(2, 3, 3, 3), signed(3)],
+     attrs={"kernel": (3, 3), "num_filter": 3, "stride": (2, 2),
+            "pad": (1, 1), "adj": (1, 1)}, rtol=8e-2)
+case("Deconvolution", [signed(1, 4, 4, 4), signed(4, 2, 2, 2), signed(4)],
+     attrs={"kernel": (2, 2), "num_filter": 4, "num_group": 2}, rtol=8e-2)
+case("Deconvolution", [signed(1, 2, 3, 3), signed(2, 2, 3, 3), signed(2)],
+     attrs={"kernel": (3, 3), "num_filter": 2, "stride": (2, 2),
+            "target_shape": (6, 6)}, grad=False)
+case("Deconvolution", [signed(1, 2, 5, 5), signed(2, 2, 2, 2), signed(2)],
+     attrs={"kernel": (2, 2), "num_filter": 2, "dilate": (2, 2)},
+     rtol=8e-2)
 case("Pooling", [signed(1, 2, 4, 4)],
      attrs={"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})
 case("Pooling", [pos(1, 2, 4, 4)],
@@ -521,3 +532,44 @@ def test_tested_elsewhere_ledger_is_current():
         fname = where.split(" ")[0]
         assert os.path.exists(os.path.join(os.path.dirname(here), fname)), \
             "ledger entry %r points at missing file %r" % (name, fname)
+
+
+def test_deconvolution_is_gradient_of_convolution():
+    """Semantic anchor for every Deconvolution branch: deconv(y, w) must
+    equal d/dx[sum(conv(x, w) * y)] — computed through the framework's own
+    autograd over its Convolution, an independent code path."""
+    from mxnet_tpu import autograd
+
+    def grad_of_conv(y_np, w_np, x_shape, **conv_kw):
+        x = mx.nd.zeros(x_shape)
+        x.attach_grad()
+        with autograd.record():
+            out = mx.nd.Convolution(x, mx.nd.array(w_np), no_bias=True,
+                                    **conv_kw)
+            s = mx.nd.sum(out * mx.nd.array(y_np))
+        s.backward()
+        return x.grad.asnumpy()
+
+    for conv_kw, x_shape, w_shape in [
+        ({"kernel": (2, 2), "num_filter": 2}, (1, 3, 6, 6), (2, 3, 2, 2)),
+        ({"kernel": (3, 3), "num_filter": 2, "stride": (2, 2),
+          "pad": (1, 1)}, (1, 3, 7, 7), (2, 3, 3, 3)),
+        ({"kernel": (2, 2), "num_filter": 2, "dilate": (2, 2)},
+         (1, 3, 7, 7), (2, 3, 2, 2)),
+        ({"kernel": (2, 2), "num_filter": 4, "num_group": 2},
+         (1, 4, 5, 5), (4, 2, 2, 2)),
+    ]:
+        w_np = RNG.randn(*w_shape).astype(np.float32)
+        x_probe = mx.nd.Convolution(
+            mx.nd.array(RNG.randn(*x_shape).astype(np.float32)),
+            mx.nd.array(w_np), no_bias=True, **conv_kw)
+        y_np = RNG.randn(*x_probe.shape).astype(np.float32)
+        expect = grad_of_conv(y_np, w_np, x_shape, **conv_kw)
+        # deconv kernel/stride/... mirror the conv attrs; weight layout
+        # (C_in_of_conv_output, num_filter_of_deconv, kh, kw) is shared
+        deconv_kw = dict(conv_kw)
+        deconv_kw["num_filter"] = x_shape[1]
+        got = mx.nd.Deconvolution(mx.nd.array(y_np), mx.nd.array(w_np),
+                                  no_bias=True, **deconv_kw)
+        assert_almost_equal(got.asnumpy(), expect, rtol=1e-4, atol=1e-5,
+                            names=("deconv", "grad_of_conv"))
