@@ -1,0 +1,70 @@
+/*
+ * C training ABI (capability parity target: the reference's C API training
+ * surface consumed by cpp-package — MXExecutorForward/Backward + optimizer
+ * updates, cpp-package/include/mxnet-cpp/executor.h, example/mlp.cpp).
+ *
+ * Workflow:
+ *   MXTrainCreate(symbol_json, shapes, optimizer)  -> handle
+ *   loop: MXTrainSetInput(...); MXTrainStep();     // fwd+bwd+update
+ *   eval: MXTrainSetInput(...); MXTrainForward(); MXTrainGetOutput(...)
+ *   MXTrainSaveCheckpoint(prefix, epoch); MXTrainFree(handle)
+ *
+ * All functions return 0 on success, -1 on failure with the message
+ * available from MXTrainGetLastError().  Buffers are float32, row-major,
+ * sized by the shapes given at create time.
+ */
+#ifndef MXNET_TPU_C_TRAIN_API_H_
+#define MXNET_TPU_C_TRAIN_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *TrainerHandle;
+
+const char *MXTrainGetLastError();
+
+/* symbol_json: JSON text or path handled by the Python side.
+ * input keys/shapes use the same CSR layout as MXPredCreate:
+ * shapes of input i are input_shape_data[indptr[i]:indptr[i+1]].
+ * Inputs whose key ends in "label" bind as labels.
+ * optimizer: registered optimizer name ("sgd", "adam", ...);
+ * opt_keys/opt_vals: numeric optimizer hyper-parameters
+ * (e.g. "learning_rate", "momentum", "wd"). */
+int MXTrainCreate(const char *symbol_json, int dev_type, int dev_id,
+                  mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  const char *optimizer, mx_uint num_opt_params,
+                  const char **opt_keys, const mx_float *opt_vals,
+                  TrainerHandle *out);
+
+int MXTrainSetInput(TrainerHandle handle, const char *key,
+                    const mx_float *data, mx_uint size);
+
+/* one training step on the staged inputs: forward + backward + update */
+int MXTrainStep(TrainerHandle handle);
+
+/* inference forward on the staged inputs (no gradient, no update) */
+int MXTrainForward(TrainerHandle handle);
+
+int MXTrainGetOutputShape(TrainerHandle handle, mx_uint index,
+                          mx_uint **shape_data, mx_uint *shape_ndim);
+
+int MXTrainGetOutput(TrainerHandle handle, mx_uint index, mx_float *data,
+                     mx_uint size);
+
+/* writes prefix-symbol.json + prefix-%04d.params (mx.model checkpoint
+ * format, loadable by the predict ABI and the Python frontends) */
+int MXTrainSaveCheckpoint(TrainerHandle handle, const char *prefix,
+                          int epoch);
+
+int MXTrainFree(TrainerHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_TRAIN_API_H_ */

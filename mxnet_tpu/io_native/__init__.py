@@ -257,6 +257,27 @@ _cpredict_lib = None
 _cpredict_tried = False
 
 
+def _load_embed_lib(src_name, lib_path, declare):
+    """Shared lazy build+load for the CPython-embedding ABI libraries
+    (predict/train): rebuild when the source is newer, load with PyDLL
+    (these ABIs re-enter Python, so the GIL must be held), apply the
+    per-library ctypes declarations.  Returns None when the toolchain or
+    Python headers are unavailable."""
+    import sysconfig
+    src = os.path.join(_SRC_DIR, src_name)
+    inc = os.path.join(_SRC_DIR, "..", "include")
+    if not os.path.exists(lib_path) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(lib_path)):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-I" + sysconfig.get_paths()["include"], "-I" + inc,
+               "-o", lib_path, src]
+        subprocess.run(cmd, check=True, capture_output=True)
+    lib = ctypes.PyDLL(lib_path)
+    declare(lib)
+    return lib
+
+
 def get_cpredict_lib():
     """Load (building if needed) the C predict ABI library; None if the
     toolchain or Python headers are unavailable.  Python-symbol references
@@ -268,47 +289,98 @@ def get_cpredict_lib():
             return _cpredict_lib
         _cpredict_tried = True
         try:
-            import sysconfig
-            src = os.path.join(_SRC_DIR, "c_predict_api.cc")
-            inc = os.path.join(_SRC_DIR, "..", "include")
-            if not os.path.exists(_CPREDICT_PATH) or (
-                    os.path.exists(src)
-                    and os.path.getmtime(src) > os.path.getmtime(
-                        _CPREDICT_PATH)):
-                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                       "-I" + sysconfig.get_paths()["include"], "-I" + inc,
-                       "-o", _CPREDICT_PATH, src]
-                subprocess.run(cmd, check=True, capture_output=True)
-            lib = ctypes.PyDLL(_CPREDICT_PATH)  # C ABI re-enters Python: keep GIL
-            u32p = ctypes.POINTER(ctypes.c_uint32)
-            f32p = ctypes.POINTER(ctypes.c_float)
-            lib.MXGetLastError.restype = ctypes.c_char_p
-            lib.MXPredCreate.restype = ctypes.c_int
-            lib.MXPredCreate.argtypes = [
-                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
-                ctypes.c_int, ctypes.c_uint32,
-                ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
-                ctypes.POINTER(ctypes.c_void_p)]
-            lib.MXPredSetInput.restype = ctypes.c_int
-            lib.MXPredSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                           f32p, ctypes.c_uint32]
-            lib.MXPredForward.restype = ctypes.c_int
-            lib.MXPredForward.argtypes = [ctypes.c_void_p]
-            lib.MXPredGetOutputShape.restype = ctypes.c_int
-            lib.MXPredGetOutputShape.argtypes = [
-                ctypes.c_void_p, ctypes.c_uint32,
-                ctypes.POINTER(u32p), u32p]
-            lib.MXPredGetOutput.restype = ctypes.c_int
-            lib.MXPredGetOutput.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
-                                            f32p, ctypes.c_uint32]
-            lib.MXPredReshape.restype = ctypes.c_int
-            lib.MXPredReshape.argtypes = [
-                ctypes.c_void_p, ctypes.c_uint32,
-                ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
-                ctypes.POINTER(ctypes.c_void_p)]
-            lib.MXPredFree.restype = ctypes.c_int
-            lib.MXPredFree.argtypes = [ctypes.c_void_p]
-            _cpredict_lib = lib
+            _cpredict_lib = _load_embed_lib(
+                "c_predict_api.cc", _CPREDICT_PATH, _declare_cpredict)
         except Exception:
             _cpredict_lib = None
         return _cpredict_lib
+
+
+def _declare_cpredict(lib):
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXPredCreate.restype = ctypes.c_int
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXPredSetInput.restype = ctypes.c_int
+    lib.MXPredSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   f32p, ctypes.c_uint32]
+    lib.MXPredForward.restype = ctypes.c_int
+    lib.MXPredForward.argtypes = [ctypes.c_void_p]
+    lib.MXPredGetOutputShape.restype = ctypes.c_int
+    lib.MXPredGetOutputShape.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(u32p), u32p]
+    lib.MXPredGetOutput.restype = ctypes.c_int
+    lib.MXPredGetOutput.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    f32p, ctypes.c_uint32]
+    lib.MXPredReshape.restype = ctypes.c_int
+    lib.MXPredReshape.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXPredFree.restype = ctypes.c_int
+    lib.MXPredFree.argtypes = [ctypes.c_void_p]
+
+
+# ---------------------------------------------------------------------------
+# C training ABI (src/c_train_api.cc) — same embedding architecture as the
+# predict ABI; gives C/C++ hosts a real training path (parity target: the
+# training surface cpp-package consumes, cpp-package/example/mlp.cpp)
+# ---------------------------------------------------------------------------
+
+_CTRAIN_PATH = os.path.join(os.path.dirname(__file__),
+                            "libmxnet_tpu_ctrain.so")
+_ctrain_lib = None
+_ctrain_tried = False
+
+
+def get_ctrain_lib():
+    """Load (building if needed) the C training ABI library; None if the
+    toolchain or Python headers are unavailable."""
+    global _ctrain_lib, _ctrain_tried
+    with _lock:
+        if _ctrain_lib is not None or _ctrain_tried:
+            return _ctrain_lib
+        _ctrain_tried = True
+        try:
+            _ctrain_lib = _load_embed_lib(
+                "c_train_api.cc", _CTRAIN_PATH, _declare_ctrain)
+        except Exception:
+            _ctrain_lib = None
+        return _ctrain_lib
+
+
+def _declare_ctrain(lib):
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.MXTrainGetLastError.restype = ctypes.c_char_p
+    lib.MXTrainCreate.restype = ctypes.c_int
+    lib.MXTrainCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_char_p), u32p, u32p,
+        ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_char_p), f32p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTrainSetInput.restype = ctypes.c_int
+    lib.MXTrainSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    f32p, ctypes.c_uint32]
+    lib.MXTrainStep.restype = ctypes.c_int
+    lib.MXTrainStep.argtypes = [ctypes.c_void_p]
+    lib.MXTrainForward.restype = ctypes.c_int
+    lib.MXTrainForward.argtypes = [ctypes.c_void_p]
+    lib.MXTrainGetOutputShape.restype = ctypes.c_int
+    lib.MXTrainGetOutputShape.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.POINTER(u32p), u32p]
+    lib.MXTrainGetOutput.restype = ctypes.c_int
+    lib.MXTrainGetOutput.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, f32p, ctypes.c_uint32]
+    lib.MXTrainSaveCheckpoint.restype = ctypes.c_int
+    lib.MXTrainSaveCheckpoint.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.MXTrainFree.restype = ctypes.c_int
+    lib.MXTrainFree.argtypes = [ctypes.c_void_p]

@@ -79,11 +79,17 @@ def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
     tail = tuple(tail_spec) if tail_spec else (None,) * (x.ndim - 1)
 
     # the [b] -> [n_micro, mb] reshape lands the batch sharding on the
-    # LEADING (microbatch-count) dim; keep dp there when it divides evenly
-    # so the shard_map boundary matches the surrounding layout instead of
-    # triggering an SPMD full-rematerialization copy
+    # LEADING (microbatch-count) dim; keeping dp there makes the shard_map
+    # boundary match the surrounding layout (no SPMD full-remat copy), but
+    # shrinks each replica's stream to n_micro/dp — at pp>1 that inflates
+    # the pipeline bubble (pp-1)/(n_local+pp-1).  Heuristic: take the
+    # aligned layout when there is no bubble to inflate (pp==1) or each
+    # replica still pipelines >=2 microbatches; callers who want it at
+    # deeper pipelines should raise n_micro (e.g. 2*dp).
     dp_size = mesh.shape.get(batch_axis, 1) if batch_axis else 1
-    if batch_axis and n_micro % dp_size == 0:
+    pp_size = mesh.shape.get(axis_name, 1)
+    if (batch_axis and n_micro % dp_size == 0
+            and (pp_size == 1 or n_micro // dp_size >= 2)):
         x_spec = P(batch_axis, None, *tail)
     else:
         x_spec = P(None, batch_axis, *tail)

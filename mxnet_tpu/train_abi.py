@@ -1,0 +1,123 @@
+"""Training session for the C ABI.
+
+The reference's cpp-package trains through the C API executor surface
+(cpp-package/include/mxnet-cpp/executor.h: Forward/Backward + optimizer
+Update per parameter, driven from C++ — e.g. cpp-package/example/mlp.cpp).
+This module is the Python-side engine behind the equivalent C training ABI
+(src/c_train_api.cc): a TrainSession owns a bound Module, and the C entry
+points marshal raw float buffers in/out.  One `step()` is
+forward+backward+update — which the Module lowers to its fused jitted
+train step where eligible, so a C host gets the same one-dispatch-per-batch
+hot path as Python training.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+class TrainSession:
+    """(symbol json, input shapes, optimizer) -> trainable module."""
+
+    def __init__(self, symbol_json, input_shapes, dev_type="cpu", dev_id=0,
+                 optimizer="sgd", optimizer_params=None, initializer=None,
+                 label_names=None):
+        from . import initializer as init_mod
+        from . import module as mod_mod
+        from .context import Context
+        from .symbol import load_json
+
+        if isinstance(symbol_json, str) and not \
+                symbol_json.lstrip().startswith("{"):
+            with open(symbol_json) as f:
+                symbol_json = f.read()
+        sym = load_json(symbol_json)
+        ctx = Context(Context.devstr2type.get(dev_type, 1), dev_id)
+
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in dict(input_shapes).items()}
+        args = set(sym.list_arguments())
+        unknown = [k for k in shapes if k not in args]
+        if unknown:
+            raise MXNetError("input name(s) %s not in symbol arguments"
+                             % unknown)
+        if label_names is None:
+            label_names = [k for k in shapes if k.endswith("label")]
+        data_names = [k for k in shapes if k not in set(label_names)]
+        if not data_names:
+            raise MXNetError("no data inputs among %s" % sorted(shapes))
+
+        self._mod = mod_mod.Module(sym, data_names=data_names,
+                                   label_names=label_names, context=ctx)
+        self._mod.bind(
+            data_shapes=[(n, shapes[n]) for n in data_names],
+            label_shapes=[(n, shapes[n]) for n in label_names] or None,
+            for_training=True)
+        self._mod.init_params(initializer or init_mod.Xavier(), force_init=True)
+        self._mod.init_optimizer(optimizer=optimizer,
+                                 optimizer_params=dict(optimizer_params or
+                                                       {"learning_rate": 0.01}))
+        self._data_names = data_names
+        self._label_names = list(label_names)
+        self._shapes = shapes
+        self._staged = {}
+
+    # -- buffer marshalling (C ABI) -----------------------------------------
+
+    def set_input_bytes(self, name, buf):
+        if name not in self._shapes:
+            raise MXNetError("unknown input %r (have %s)"
+                             % (name, sorted(self._shapes)))
+        arr = np.frombuffer(buf, np.float32).reshape(self._shapes[name])
+        self._staged[name] = arr
+
+    def _batch(self, need_labels):
+        from .io import DataBatch
+        from .ndarray import array as nd_array, zeros as nd_zeros
+        required = self._data_names + (self._label_names if need_labels
+                                       else [])
+        missing = [n for n in required if n not in self._staged]
+        if missing:
+            raise MXNetError("inputs not set before step/forward: %s"
+                             % missing)
+
+        def label_of(n):
+            # inference may omit labels; the bound graph still has a label
+            # slot, so fill zeros of the declared shape
+            if n in self._staged:
+                return nd_array(self._staged[n])
+            return nd_zeros(self._shapes[n])
+
+        return DataBatch(
+            data=[nd_array(self._staged[n]) for n in self._data_names],
+            label=[label_of(n) for n in self._label_names])
+
+    def step(self):
+        """One training step: forward + backward + optimizer update."""
+        batch = self._batch(need_labels=True)
+        self._mod.forward_backward(batch)
+        self._mod.update()
+
+    def forward(self):
+        """Inference forward on the staged inputs (labels optional)."""
+        self._mod.forward(self._batch(need_labels=False), is_train=False)
+
+    def get_output_shape(self, index=0):
+        outs = self._mod.get_outputs()
+        return tuple(outs[index].shape)
+
+    def get_output_bytes(self, index=0):
+        out = self._mod.get_outputs()[index]
+        return np.ascontiguousarray(
+            out.asnumpy().astype(np.float32)).tobytes()
+
+    # -- persistence ---------------------------------------------------------
+
+    def save_checkpoint(self, prefix, epoch=0):
+        self._mod.save_checkpoint(prefix, epoch)
+
+    def load_params(self, prefix, epoch=0):
+        from .model import load_checkpoint
+        _, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        self._mod.set_params(arg_params, aux_params)

@@ -1,0 +1,272 @@
+// C training ABI implementation (capability parity target: the training
+// surface cpp-package consumes from the reference C API —
+// MXExecutorForward/Backward + per-parameter optimizer updates, see
+// cpp-package/include/mxnet-cpp/executor.h and example/mlp.cpp).
+//
+// Same embedding architecture as src/c_predict_api.cc: the training engine
+// is the Python-side TrainSession (mxnet_tpu/train_abi.py) whose step() is
+// the Module's fused forward+backward+update jitted program; this layer
+// owns the interpreter bootstrap and float-buffer marshalling so any
+// C/C++/FFI host can TRAIN through real C linkage, not just infer.
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *TrainerHandle;
+}
+
+namespace {
+
+thread_local std::string last_error;
+
+struct TrainerObj {
+  PyObject *py;                    // mxnet_tpu.train_abi.TrainSession
+  std::vector<mx_uint> shape_buf;  // backing store for GetOutputShape
+};
+
+void set_err_from_python() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptb = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptb);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptb);
+  last_error = "python error";
+  if (pvalue) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) {
+        last_error = msg;
+      } else {
+        PyErr_Clear();
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptb);
+}
+
+std::once_flag py_init_once;
+
+class GIL {
+ public:
+  GIL() {
+    std::call_once(py_init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        PyEval_SaveThread();
+      }
+    });
+    state_ = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject *shapes_dict(mx_uint num, const char **keys,
+                      const mx_uint *indptr, const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  if (!d) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    if (!t) { Py_DECREF(d); return nullptr; }
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(data[j]));
+    }
+    PyDict_SetItemString(d, keys[i], t);
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTrainGetLastError() { return last_error.c_str(); }
+
+#define MXTRAIN_CHECK_HANDLE(h)              \
+  if ((h) == nullptr) {                      \
+    last_error = "null TrainerHandle";       \
+    return -1;                               \
+  }
+
+int MXTrainCreate(const char *symbol_json, int dev_type, int dev_id,
+                  mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  const char *optimizer, mx_uint num_opt_params,
+                  const char **opt_keys, const mx_float *opt_vals,
+                  TrainerHandle *out) {
+  if (!symbol_json || !out || num_input_nodes == 0 || !input_keys ||
+      !input_shape_indptr || !input_shape_data ||
+      (num_opt_params > 0 && (!opt_keys || !opt_vals))) {
+    last_error = "MXTrainCreate: null argument";
+    return -1;
+  }
+  GIL gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.train_abi");
+  if (!mod) { set_err_from_python(); return -1; }
+  PyObject *cls = PyObject_GetAttrString(mod, "TrainSession");
+  Py_DECREF(mod);
+  if (!cls) { set_err_from_python(); return -1; }
+
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject *opt_params = shapes ? PyDict_New() : nullptr;
+  if (opt_params) {
+    for (mx_uint i = 0; i < num_opt_params; ++i) {
+      PyObject *v = PyFloat_FromDouble(opt_vals[i]);
+      if (!v) { Py_CLEAR(opt_params); break; }
+      PyDict_SetItemString(opt_params, opt_keys[i], v);
+      Py_DECREF(v);
+    }
+  }
+  const char *dev = dev_type == 2 ? "tpu" : "cpu";
+  PyObject *args = nullptr, *kwargs = nullptr, *inst = nullptr;
+  if (opt_params) {
+    args = Py_BuildValue("(sO)", symbol_json, shapes);
+    kwargs = Py_BuildValue("{s:s,s:i,s:s,s:O}", "dev_type", dev,
+                           "dev_id", dev_id,
+                           "optimizer", optimizer ? optimizer : "sgd",
+                           "optimizer_params", opt_params);
+  }
+  if (args && kwargs) inst = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(cls);
+  Py_XDECREF(shapes);
+  Py_XDECREF(opt_params);
+  Py_XDECREF(args);
+  Py_XDECREF(kwargs);
+  if (!inst) { set_err_from_python(); return -1; }
+  *out = new TrainerObj{inst, {}};
+  return 0;
+}
+
+int MXTrainSetInput(TrainerHandle handle, const char *key,
+                    const mx_float *data, mx_uint size) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  if (!key || (!data && size > 0)) {
+    last_error = "MXTrainSetInput: null argument";
+    return -1;
+  }
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  if (!buf) { set_err_from_python(); return -1; }
+  PyObject *r = PyObject_CallMethod(p->py, "set_input_bytes", "sO", key, buf);
+  Py_DECREF(buf);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int call_noarg(TrainerHandle handle, const char *method) {
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, method, nullptr);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainStep(TrainerHandle handle) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  return call_noarg(handle, "step");
+}
+
+int MXTrainForward(TrainerHandle handle) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  return call_noarg(handle, "forward");
+}
+
+int MXTrainGetOutputShape(TrainerHandle handle, mx_uint index,
+                          mx_uint **shape_data, mx_uint *shape_ndim) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  if (!shape_data || !shape_ndim) {
+    last_error = "MXTrainGetOutputShape: null output pointer";
+    return -1;
+  }
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "get_output_shape", "I", index);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_ssize_t n = PySequence_Size(r);
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    p->shape_buf[i] = static_cast<mx_uint>(PyLong_AsUnsignedLong(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXTrainGetOutput(TrainerHandle handle, mx_uint index, mx_float *data,
+                     mx_uint size) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  if (!data && size > 0) {
+    last_error = "MXTrainGetOutput: null buffer";
+    return -1;
+  }
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "get_output_bytes", "I", index);
+  if (!r) { set_err_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_err_from_python();
+    return -1;
+  }
+  if (static_cast<mx_uint>(len / sizeof(mx_float)) != size) {
+    last_error = "MXTrainGetOutput: size mismatch (want " +
+                 std::to_string(size) + " floats, output has " +
+                 std::to_string(len / sizeof(mx_float)) + ")";
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainSaveCheckpoint(TrainerHandle handle, const char *prefix,
+                          int epoch) {
+  MXTRAIN_CHECK_HANDLE(handle);
+  if (!prefix) {
+    last_error = "MXTrainSaveCheckpoint: null prefix";
+    return -1;
+  }
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  PyObject *r = PyObject_CallMethod(p->py, "save_checkpoint", "si", prefix,
+                                    epoch);
+  if (!r) { set_err_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTrainFree(TrainerHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  auto *p = static_cast<TrainerObj *>(handle);
+  Py_XDECREF(p->py);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -275,17 +275,48 @@ def test_c_predict_abi_reshape(tmp_path):
     assert lib.MXPredFree(h2) == 0
 
 
+def _build_embed_binary(tmp_path, src_rel, libname, lib_path, out_name):
+    """Compile an example that embeds CPython and links one of the ABI
+    .so's; returns (exe_path, env) or pytest.skip()s when link flags are
+    underivable.  Shared by the predict and train external-binary tests."""
+    import subprocess
+    import sysconfig
+    import site
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = os.path.join(str(tmp_path), out_name)
+    libdir = os.path.dirname(lib_path)
+    libdir_py = sysconfig.get_config_var("LIBDIR") or ""
+    ldver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    if not ldver:
+        pytest.skip("cannot determine libpython link name")
+    ldflags = ["-L" + libdir_py, "-lpython" + ldver] + \
+        (sysconfig.get_config_var("LIBS") or "").split() + \
+        (sysconfig.get_config_var("SYSLIBS") or "").split()
+    cmd = ["g++", "-std=c++17", os.path.join(repo, src_rel),
+           "-I" + os.path.join(repo, "include"),
+           "-I" + sysconfig.get_paths()["include"],
+           "-L" + libdir, "-l" + libname,
+           "-Wl,-rpath," + libdir, "-o", exe] + ldflags
+    build = subprocess.run(cmd, capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + site.getsitepackages() + [site.getusersitepackages()]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    return exe, env
+
+
 def test_cpp_frontend_compiles_and_runs(tmp_path):
     """Compile + run the header-only C++ frontend (predictor.hpp) as a real
     external binary against a saved checkpoint (parity: cpp-package)."""
     import subprocess
-    import sysconfig
     from mxnet_tpu.io_native import get_cpredict_lib, _CPREDICT_PATH
 
     if get_cpredict_lib() is None:
         pytest.skip("C predict library unavailable")
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # checkpoint artifacts
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
         mx.sym.var("data"), num_hidden=4, name="fc"), name="softmax")
@@ -297,30 +328,9 @@ def test_cpp_frontend_compiles_and_runs(tmp_path):
         "arg:fc_weight": mx.nd.array(rng.rand(4, 6).astype(np.float32)),
         "arg:fc_bias": mx.nd.array(rng.rand(4).astype(np.float32))})
 
-    exe = os.path.join(str(tmp_path), "demo")
-    libdir = os.path.dirname(_CPREDICT_PATH)
-    # derive embed link flags from the RUNNING interpreter (a PATH
-    # python3-config may be absent or belong to a different python)
-    libdir_py = sysconfig.get_config_var("LIBDIR") or ""
-    ldver = sysconfig.get_config_var("LDVERSION") or         sysconfig.get_config_var("VERSION")
-    if not ldver:
-        pytest.skip("cannot determine libpython link name")
-    ldflags = ["-L" + libdir_py, "-lpython" + ldver] +         (sysconfig.get_config_var("LIBS") or "").split() +         (sysconfig.get_config_var("SYSLIBS") or "").split()
-    cmd = ["g++", "-std=c++17",
-           os.path.join(repo, "examples", "predict-c", "predict_demo.cc"),
-           "-I" + os.path.join(repo, "include"),
-           "-I" + sysconfig.get_paths()["include"],
-           "-L" + libdir, "-lmxnet_tpu_cpredict",
-           "-Wl,-rpath," + libdir, "-o", exe] + ldflags
-    build = subprocess.run(cmd, capture_output=True, text=True)
-    assert build.returncode == 0, build.stderr
-
-    # the embedded interpreter needs the repo + venv on its module path
-    import site
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + site.getsitepackages() + [site.getusersitepackages()]
-        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    exe, env = _build_embed_binary(
+        tmp_path, os.path.join("examples", "predict-c", "predict_demo.cc"),
+        "mxnet_tpu_cpredict", _CPREDICT_PATH, "demo")
     run = subprocess.run([exe, sym_path, pfile, "2", "6"],
                          capture_output=True, text=True, timeout=300,
                          env=env)
@@ -425,3 +435,114 @@ def test_c_predict_null_handle_is_error_not_crash():
     out = ctypes.c_void_p()
     assert lib.MXPredCreate(None, None, 0, 1, 0, 0, None, None, None,
                             ctypes.byref(out)) == -1
+
+
+def test_c_train_abi_trains(tmp_path):
+    """Training through the C ABI (parity: the reference C API training
+    surface cpp-package consumes — executor.h Forward/Backward + updates):
+    build the trainer from symbol JSON, run SGD steps on a learnable task,
+    assert accuracy, checkpoint, and reload via the predict path."""
+    import ctypes
+    import os
+    from mxnet_tpu.io_native import get_ctrain_lib
+
+    lib = get_ctrain_lib()
+    if lib is None:
+        pytest.skip("C train library unavailable (no toolchain)")
+
+    h1 = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=16, name="fc1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        h1, num_hidden=3, name="fc2"), name="softmax")
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 3)
+    X = rng.randn(256, 8).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    keys = (ctypes.c_char_p * 2)(b"data", b"softmax_label")
+    indptr = (ctypes.c_uint32 * 3)(0, 2, 3)
+    shapes = (ctypes.c_uint32 * 3)(64, 8, 64)
+    okeys = (ctypes.c_char_p * 1)(b"learning_rate")
+    ovals = (ctypes.c_float * 1)(0.3)
+    handle = ctypes.c_void_p()
+    rc = lib.MXTrainCreate(net.tojson().encode(), 1, 0, 2, keys, indptr,
+                           shapes, b"sgd", 1, okeys, ovals,
+                           ctypes.byref(handle))
+    assert rc == 0, lib.MXTrainGetLastError().decode()
+
+    def put(name, arr):
+        flat = np.ascontiguousarray(arr, np.float32)
+        rc = lib.MXTrainSetInput(
+            handle, name,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
+        assert rc == 0, lib.MXTrainGetLastError().decode()
+
+    for epoch in range(25):
+        for i in range(0, 256, 64):
+            put(b"data", X[i:i + 64])
+            put(b"softmax_label", y[i:i + 64])
+            assert lib.MXTrainStep(handle) == 0, \
+                lib.MXTrainGetLastError().decode()
+
+    correct = 0
+    out = np.zeros((64, 3), np.float32)
+    for i in range(0, 256, 64):
+        put(b"data", X[i:i + 64])
+        put(b"softmax_label", y[i:i + 64])
+        assert lib.MXTrainForward(handle) == 0
+        sdata = ctypes.POINTER(ctypes.c_uint32)()
+        ndim = ctypes.c_uint32()
+        assert lib.MXTrainGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                         ctypes.byref(ndim)) == 0
+        assert ndim.value == 2 and sdata[0] == 64 and sdata[1] == 3
+        assert lib.MXTrainGetOutput(
+            handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.size) == 0
+        correct += int((np.argmax(out, 1) == y[i:i + 64]).sum())
+    acc = correct / 256.0
+    assert acc > 0.97, "C-ABI training accuracy %.3f" % acc
+
+    prefix = os.path.join(str(tmp_path), "cmlp")
+    assert lib.MXTrainSaveCheckpoint(handle, prefix.encode(), 7) == 0
+    assert lib.MXTrainFree(handle) == 0
+    # checkpoint is the standard two-artifact format: predict path loads it
+    from mxnet_tpu.predict import load_checkpoint_predictor
+    p = load_checkpoint_predictor(prefix, 7, {"data": (4, 8)})
+    p.forward(data=mx.nd.array(X[:4]))
+    probs = p.get_output(0).asnumpy()
+    assert (np.argmax(probs, 1) == y[:4]).mean() >= 0.75
+
+    # error paths: null handle, bad input name
+    assert lib.MXTrainStep(None) == -1
+    assert b"null" in lib.MXTrainGetLastError()
+
+
+def test_cpp_training_example_compiles_and_trains(tmp_path):
+    """Compile examples/train-c/mlp_train.cc as an external binary and let
+    it train its MLP through the .so to >97%% accuracy (the port of
+    cpp-package/example/mlp.cpp)."""
+    import subprocess
+    from mxnet_tpu.io_native import get_ctrain_lib, _CTRAIN_PATH
+
+    if get_ctrain_lib() is None:
+        pytest.skip("C train library unavailable")
+
+    h1 = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=64, name="fc1"), act_type="relu")
+    h2 = mx.sym.Activation(mx.sym.FullyConnected(
+        h1, num_hidden=32, name="fc2"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        h2, num_hidden=10, name="fc3"), name="softmax")
+    sym_path = os.path.join(str(tmp_path), "mlp-symbol.json")
+    net.save(sym_path)
+
+    exe, env = _build_embed_binary(
+        tmp_path, os.path.join("examples", "train-c", "mlp_train.cc"),
+        "mxnet_tpu_ctrain", _CTRAIN_PATH, "mlp_train")
+    ckpt = os.path.join(str(tmp_path), "mlp")
+    run = subprocess.run([exe, sym_path, ckpt], capture_output=True,
+                         text=True, timeout=600, env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "TRAINED-OK" in run.stdout, run.stdout
+    assert os.path.exists(ckpt + "-symbol.json")
+    assert os.path.exists(ckpt + "-0011.params")
