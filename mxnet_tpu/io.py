@@ -546,7 +546,7 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
                     brightness=0.0, contrast=0.0, saturation=0.0,
                     pca_noise=0.0, num_parts=1, part_index=0,
                     data_name="data", label_name="softmax_label",
-                    seed=None, **kwargs):
+                    seed=None, preprocess_threads=0, ctx=None, **kwargs):
     """Image pipeline over packed .rec files (ref: ImageRecordIter2,
     src/io/iter_image_recordio_2.cc — the reference's C++ decode/augment/
     batch pipeline with its flat kwargs surface).  Decode runs through
@@ -615,6 +615,23 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
             base_reset()
 
         it.reset = _reset_with_seed
+    if preprocess_threads and int(preprocess_threads) > 0:
+        # the reference's preprocess_threads knob (iter_image_recordio_2.cc
+        # decode thread pool) maps onto the native dependency engine:
+        # decode/augment and device upload become engine ops (see
+        # EnginePipelineIter).  Decode ops serialize on the iterator var
+        # (augmenter RNG is single-threaded state), so >2 workers buys
+        # nothing — cap the pool.  NOTE: with a seed, augmentation draws
+        # now run on engine threads; same-seed runs stay reproducible only
+        # if the main thread does not use the global RNGs mid-epoch.
+        try:
+            return EnginePipelineIter(it, ctx=ctx,
+                                      num_workers=min(
+                                          2, int(preprocess_threads)))
+        except RuntimeError:
+            if ctx is not None:
+                # no native engine: still honor the requested device
+                return DevicePrefetchIter(it, ctx=ctx)
     return it
 
 
@@ -737,6 +754,21 @@ class LibSVMIter(DataIter):
                          provide_label=self.provide_label)
 
 
+def _upload_batch(batch, dev):
+    """A DataBatch with every data/label array device_put onto `dev`."""
+    import jax as _jax
+
+    def put(arrs):
+        if not arrs:
+            return arrs
+        return [NDArray(_jax.device_put(a._h.array, dev)) for a in arrs]
+
+    return DataBatch(data=put(batch.data), label=put(batch.label or []),
+                     pad=batch.pad, index=batch.index,
+                     provide_data=batch.provide_data,
+                     provide_label=batch.provide_label)
+
+
 class DevicePrefetchIter(DataIter):
     """Upload batches to the device ahead of consumption.
 
@@ -774,17 +806,7 @@ class DevicePrefetchIter(DataIter):
         self._pending = None
 
     def _upload(self, batch):
-        def put(arrs):
-            if not arrs:
-                return arrs
-            return [self._NDArray(
-                self._jax.device_put(a._h.array, self._dev))
-                for a in arrs]
-
-        return DataBatch(data=put(batch.data), label=put(batch.label or []),
-                         pad=batch.pad, index=batch.index,
-                         provide_data=batch.provide_data,
-                         provide_label=batch.provide_label)
+        return _upload_batch(batch, self._dev)
 
     def next(self):
         if self._pending is None:
@@ -812,3 +834,103 @@ _DATA_ITER_REGISTRY = {
     "ImageRecordIter_v1": ImageRecordIter_v1,
     "NDArrayIter": NDArrayIter,
 }
+
+
+class EnginePipelineIter(DataIter):
+    """Engine-scheduled input pipeline: decode/augment and device upload run
+    as NativeEngine ops with var dependencies.
+
+    This is the host-side analog of the reference's threaded iterator
+    stack + FnProperty copy lanes (SURVEY.md §2.1/§2.4: dmlc ThreadedIter
+    prefetch feeding engine-ordered CopyFromCPU ops): `produce` ops pull
+    and preprocess batches (serialized on the iterator var — augmenter RNG
+    stays single-threaded), `upload` ops issue the host->device transfer,
+    and the training loop only ever waits on a ready slot.  Spans appear in
+    the profiler's Chrome trace under the "engine" category.
+    """
+
+    def __init__(self, base, depth=2, ctx=None, num_workers=2, engine=None):
+        from .io_native import NativeEngine
+        super().__init__(base.batch_size)
+        self._base = base
+        self._engine = engine or NativeEngine(num_workers)
+        self._ctx = ctx
+        self._iter_var = self._engine.new_var()
+        self._slots = [{"var": self._engine.new_var(), "batch": None,
+                        "stop": False, "error": None}
+                       for _ in range(max(1, depth))]
+        self._idx = 0
+        self._armed = False
+
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def _arm(self, slot):
+        from . import profiler as _profiler
+
+        def produce():
+            try:
+                with _profiler.record_span("engine_decode_augment",
+                                           category="engine"):
+                    slot["batch"] = self._base.next()
+                slot["stop"] = False
+            except StopIteration:
+                slot["batch"], slot["stop"] = None, True
+            except Exception as e:  # surfaced on the consumer thread
+                slot["error"] = e
+
+        # produce ops serialize on _iter_var (the base iterator and the
+        # augmenter RNG are single-threaded state); each writes its slot
+        self._engine.push(produce, mutable_vars=(self._iter_var,
+                                                 slot["var"]))
+        if self._ctx is not None:
+            dev = self._ctx.jax_device()
+
+            def upload():
+                if slot["batch"] is None or slot["error"] is not None:
+                    return
+                with _profiler.record_span("engine_device_upload",
+                                           category="engine"):
+                    slot["batch"] = _upload_batch(slot["batch"], dev)
+
+            # write-after-write on the slot var orders upload after produce
+            # while the NEXT slot's produce overlaps (the copy-lane analog)
+            self._engine.push(upload, mutable_vars=(slot["var"],))
+
+    def _arm_all(self):
+        for s in self._slots:
+            s["batch"], s["stop"], s["error"] = None, False, None
+            self._arm(s)
+        self._armed = True
+
+    def next(self):
+        if not self._armed:
+            self._arm_all()
+        slot = self._slots[self._idx % len(self._slots)]
+        self._engine.wait_for_var(slot["var"])
+        if slot["error"] is not None:
+            # surface the error but keep the pipeline usable: re-arm the
+            # slot and advance, like the success path
+            err = slot["error"]
+            slot["error"], slot["batch"] = None, None
+            self._arm(slot)
+            self._idx += 1
+            raise err
+        if slot["stop"]:
+            raise StopIteration
+        batch = slot["batch"]
+        slot["batch"] = None
+        self._arm(slot)  # refill behind the consumer
+        self._idx += 1
+        return batch
+
+    def reset(self):
+        self._engine.wait_for_all()
+        self._base.reset()
+        self._armed = False
+        self._idx = 0
