@@ -37,6 +37,13 @@ def get_symbol(network, num_layers, image_shape):
 
 def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
           iters=20):
+    """Chained-fori_loop methodology (same as bench.py): iterations are
+    data-dependent, the window ends in a real host fetch, and the rate is
+    the marginal between two window sizes — async dispatch over a chip
+    tunnel otherwise reports non-physical numbers (see README)."""
+    import jax
+    import jax.numpy as jnp
+
     sym = get_symbol(network, num_layers, image_shape)
     shape = tuple(int(x) for x in image_shape.split(","))
     exe = sym.simple_bind(dev, grad_req="null",
@@ -47,14 +54,50 @@ def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
             arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
     exe.arg_dict["data"][:] = rng.uniform(
         0, 1, (batch_size,) + shape).astype(np.float32)
-    for _ in range(3):
-        exe.forward(is_train=False)
-        exe.outputs[0].wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        exe.forward(is_train=False)
-    exe.outputs[0].wait_to_read()
-    return batch_size * iters / (time.perf_counter() - t0)
+
+    prog = exe._prog
+    arg_names, aux_names = prog.arg_names, prog.aux_names
+    arg_vals = tuple(exe.arg_dict[n]._h.array for n in arg_names)
+    aux_vals = tuple(exe.aux_dict[n]._h.array for n in aux_names)
+    from mxnet_tpu import random as _random
+    base_keys = tuple(_random.next_key() for _ in range(exe._n_keys))
+
+    @jax.jit
+    def loop(n, arg_vals, aux_vals):
+        amap0 = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+
+        def body(i, carry):
+            data, acc = carry
+            amap = dict(amap0)
+            amap["data"] = data
+            keys = tuple(jax.random.fold_in(k, i) for k in base_keys)
+            outs, _ = prog.evaluate(amap, aux_map, keys, False)
+            m = jnp.mean(outs[0].astype(jnp.float32))
+            return data * (1.0 + jnp.tanh(m) * 1e-12), acc + m
+
+        _, acc = jax.lax.fori_loop(0, n, body,
+                                   (amap0["data"], jnp.float32(0.0)))
+        return acc
+
+    def run(n, *_args):
+        return float(loop(n, arg_vals, aux_vals))  # real host fetch
+
+    # reuse the shared window-pair timing from bench.py (repo root is on
+    # sys.path above) so the two tools cannot drift methodologically
+    import bench as _bench
+    iters = max(6, int(iters))
+    old_small, old_large = _bench.N_SMALL, _bench.N_LARGE
+    try:
+        _bench.N_SMALL, _bench.N_LARGE = max(2, iters // 5), iters
+        sec_per_iter = _bench._timed_windows(run, reps=5)
+    finally:
+        _bench.N_SMALL, _bench.N_LARGE = old_small, old_large
+    if sec_per_iter <= 0:
+        raise RuntimeError(
+            "non-positive marginal timing (%.3g s/iter): host too noisy "
+            "for this window size; raise --iters" % sec_per_iter)
+    return batch_size / sec_per_iter
 
 
 if __name__ == "__main__":

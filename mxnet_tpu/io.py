@@ -629,9 +629,9 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
                                       num_workers=min(
                                           2, int(preprocess_threads)))
         except RuntimeError:
-            if ctx is not None:
-                # no native engine: still honor the requested device
-                return DevicePrefetchIter(it, ctx=ctx)
+            pass  # no native engine: DevicePrefetchIter below still uploads
+    if ctx is not None:
+        return DevicePrefetchIter(it, ctx=ctx)
     return it
 
 
@@ -783,13 +783,9 @@ class DevicePrefetchIter(DataIter):
     def __init__(self, base_iter, ctx=None):
         super().__init__()
         from .context import current_context
-        from .ndarray import NDArray
-        import jax as _jax
         self._base = base_iter
         self._ctx = ctx or current_context()
         self._dev = self._ctx.jax_device()
-        self._jax = _jax
-        self._NDArray = NDArray
         self._pending = None
         self.batch_size = getattr(base_iter, "batch_size", None)
 
@@ -805,20 +801,17 @@ class DevicePrefetchIter(DataIter):
         self._base.reset()
         self._pending = None
 
-    def _upload(self, batch):
-        return _upload_batch(batch, self._dev)
-
     def next(self):
         if self._pending is None:
             try:
-                self._pending = self._upload(self._base.next())
+                self._pending = _upload_batch(self._base.next(), self._dev)
             except StopIteration:
                 raise
         out = self._pending
         # issue the NEXT upload now — it overlaps the caller's compute on
         # the batch being returned
         try:
-            self._pending = self._upload(self._base.next())
+            self._pending = _upload_batch(self._base.next(), self._dev)
         except StopIteration:
             self._pending = None
         return out
@@ -894,9 +887,12 @@ class EnginePipelineIter(DataIter):
             def upload():
                 if slot["batch"] is None or slot["error"] is not None:
                     return
-                with _profiler.record_span("engine_device_upload",
-                                           category="engine"):
-                    slot["batch"] = _upload_batch(slot["batch"], dev)
+                try:
+                    with _profiler.record_span("engine_device_upload",
+                                               category="engine"):
+                        slot["batch"] = _upload_batch(slot["batch"], dev)
+                except Exception as e:  # surfaced on the consumer thread
+                    slot["error"] = e
 
             # write-after-write on the slot var orders upload after produce
             # while the NEXT slot's produce overlaps (the copy-lane analog)
