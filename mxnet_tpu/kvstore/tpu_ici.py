@@ -181,6 +181,51 @@ class TpuIciKVStore(KVStore):
         self.push(key, push_value, priority)
         self.pull(key, out=pull_out, priority=priority)
 
+    def push_pull_list(self, keys, push_values, pull_outs, priority=0):
+        """Batched fused push+pull: each device's gradients for ALL keys
+        flatten into one buffer, so the reduce is ONE all-reduce per dtype
+        group instead of one per key — the reference NCCL store's
+        batched-key aggregation (kvstore_nccl.h:62 GroupKVPairs).  Keys
+        that do not fit the dense multi-device fast path (sparse values,
+        updater installed, duplicate devices) fall back per key."""
+        groups = {}   # (dtype, device tuple) -> [(key, {dev: arr}, out)]
+        fallback = []
+        for k, v, o in zip(keys, push_values, pull_outs):
+            stored = self._stored.get(k)
+            if stored is None:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            vals = [v] if isinstance(v, NDArray) else list(v)
+            arrays = [x._h.array for x in vals
+                      if type(x) is NDArray]
+            by_dev = {list(a.devices())[0]: a for a in arrays}
+            if (self._updater is not None or type(stored) is not NDArray
+                    or len(arrays) != len(vals)
+                    or len(arrays) < 2 or len(by_dev) != len(arrays)):
+                fallback.append((k, v, o))
+                continue
+            devs = tuple(sorted(by_dev, key=lambda d: d.id))
+            groups.setdefault((arrays[0].dtype, devs), []).append(
+                (k, by_dev, o))
+
+        for (_, devs), items in groups.items():
+            # one flattened concat per device (runs on that device), one
+            # collective for the whole group
+            flats = [jnp.concatenate(
+                [jnp.ravel(by_dev[d]) for _, by_dev, _ in items])
+                for d in devs]
+            merged_flat = allreduce_arrays(flats)
+            offset = 0
+            for k, by_dev, o in items:
+                shape = tuple(next(iter(by_dev.values())).shape)
+                n = int(np.prod(shape))  # () -> 1; zero-size dims -> 0
+                # slicing the replicated buffer is a local view per device
+                seg = merged_flat[offset:offset + n].reshape(shape)
+                offset += n
+                self._stored[k] = NDArray(seg)
+                self.pull(k, out=o, priority=priority)
+        for k, v, o in fallback:
+            self.push_pull(k, v, o, priority)
+
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)
         self.pull(key, out=out, priority=priority)

@@ -76,15 +76,20 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
     """(ref: model.py:145)"""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        index = index
-        if kvstore:
+    live = [(i, a, g) for i, (a, g) in
+            enumerate(zip(param_arrays, grad_arrays)) if g[0] is not None]
+    if kvstore is not None and hasattr(kvstore, "push_pull_list") and live:
+        # collective stores aggregate every key into one dispatch (the
+        # reference's batched NCCL fast path, model.py:106 + GroupKVPairs)
+        kvstore.push_pull_list([param_names[i] for i, _, _ in live],
+                               [g for _, _, g in live],
+                               [g for _, _, g in live])
+    elif kvstore is not None:
+        for index, _, grad_list in live:
             name = param_names[index]
             kvstore.push(name, grad_list, priority=-index)
             kvstore.pull(name, grad_list, priority=-index)
+    for index, arg_list, grad_list in live:
         for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
             updater(index * num_device + k, g, p)
 

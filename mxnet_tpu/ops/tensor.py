@@ -110,7 +110,7 @@ for _n, _f in _SCALAR_LOGIC.items():
 
 _UNARY = {
     "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
-    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.fix,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
     "square": jnp.square, "sqrt": jnp.sqrt,
     "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
     "rcbrt": lambda x: 1.0 / jnp.cbrt(x),

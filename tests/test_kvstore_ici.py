@@ -125,3 +125,65 @@ def test_module_dp_convergence_8dev():
     it.reset()
     acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
     assert acc > 0.9, "DP training through tpu_ici did not converge: %s" % acc
+
+
+def test_push_pull_list_batched_single_collective(monkeypatch):
+    """push_pull_list aggregates every key into one flattened all-reduce
+    (ref: KVStoreNCCL GroupKVPairs batching) and matches per-key results."""
+    devs = _cpu_devices()
+    kv = mx.kv.create("tpu_ici")
+    shapes = {"a": (2, 3), "b": (5,), "c": (1, 2, 2)}
+    for k, s in shapes.items():
+        kv.init(k, mx.nd.zeros(s, ctx=mx.cpu(0)))
+
+    rng = np.random.RandomState(0)
+    vals = {k: [mx.nd.array(rng.rand(*s).astype(np.float32), ctx=mx.cpu(i))
+                for i in range(8)] for k, s in shapes.items()}
+    expected = {k: sum(v.asnumpy() for v in vals[k]) for k in shapes}
+    outs = {k: [mx.nd.zeros(s, ctx=mx.cpu(i)) for i in range(8)]
+            for k, s in shapes.items()}
+
+    calls = []
+    real = tpu_ici.allreduce_arrays
+
+    def spy(arrays):
+        calls.append(len(arrays))
+        return real(arrays)
+
+    monkeypatch.setattr(tpu_ici, "allreduce_arrays", spy)
+    kv.push_pull_list(list(shapes), [vals[k] for k in shapes],
+                      [outs[k] for k in shapes])
+    monkeypatch.undo()
+
+    assert calls == [8], "expected ONE collective for all keys, got %r" % calls
+    for k in shapes:
+        for i, o in enumerate(outs[k]):
+            np.testing.assert_allclose(o.asnumpy(), expected[k], rtol=1e-6)
+            assert list(o._h.array.devices())[0] == devs[i]
+
+
+def test_module_dp_uses_batched_push_pull(monkeypatch):
+    """Module DP through tpu_ici issues one collective per batch, not one
+    per parameter."""
+    calls = []
+    real = tpu_ici.allreduce_arrays
+
+    def spy(arrays):
+        calls.append(len(arrays))
+        return real(arrays)
+
+    monkeypatch.setattr(tpu_ici, "allreduce_arrays", spy)
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 16).astype(np.float32)
+    y = np.argmax(X @ rng.randn(16, 4), axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    h = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=8), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=4),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(4)])
+    mod.fit(it, num_epoch=1, kvstore="tpu_ici",
+            optimizer_params={"learning_rate": 0.1})
+    monkeypatch.undo()
+    # 2 batches/epoch, 4 params -> batched = 2 collectives (one per batch)
+    assert len(calls) == 2, calls
