@@ -31,22 +31,39 @@ class FusedTrainStep:
     @staticmethod
     def supports(module):
         """Conservative gating; anything unusual uses the general path."""
-        if len(module._context) != 1:
+        n = len(module._context)
+        if module._exec_group is None or len(module._exec_group.execs) != n:
             return False
-        if module._kvstore is not None or module._update_on_kvstore:
+        if module._update_on_kvstore:
             return False
-        if module._exec_group is None or len(module._exec_group.execs) != 1:
-            return False
+        if n == 1:
+            if module._kvstore is not None:
+                return False
+        else:
+            # multi-device DP: the fused step shards the batch over a dp
+            # mesh and XLA inserts the gradient all-reduce, replacing the
+            # kvstore's collective — only collective-style stores (or no
+            # store) may be silently subsumed this way
+            kv = module._kvstore
+            if kv is not None and not any(t in kv.type
+                                          for t in ("tpu", "ici")):
+                return False
+            devs = [c.jax_device() for c in module._context]
+            if len(set(devs)) != n:
+                return False
+            # equal batch slices so the dp shards line up with the execs
+            sizes = {s.stop - s.start for s in module._exec_group.slices}
+            if len(sizes) != 1:
+                return False
         opt = module._optimizer
         if type(opt) is not opt_mod.SGD or opt.multi_precision:
             return False
-        exe = module._exec_group.execs[0]
-        if exe._monitor_callback is not None:
-            return False
+        for exe in module._exec_group.execs:
+            if exe._monitor_callback is not None:
+                return False
+            if any(req == "add" for req in exe._grad_req.values()):
+                return False
         if getattr(module, "inputs_need_grad", False):
-            return False
-        # grad_req 'add' aggregation isn't modeled in the fused update
-        if any(req == "add" for req in exe._grad_req.values()):
             return False
         return True
 
@@ -58,6 +75,8 @@ class FusedTrainStep:
         exe = self.exe
         prog = exe._prog
         self.prog = prog
+        self.n_dev = len(module._context)
+        self.devices = [c.jax_device() for c in module._context]
         self.param_names = list(exe._grad_names)
         self.other_names = [n for n in prog.arg_names
                             if n not in set(self.param_names)]
@@ -72,9 +91,35 @@ class FusedTrainStep:
         self.momentum = float(getattr(self.opt, "momentum", 0.0))
         self.rescale = float(self.opt.rescale_grad)
         self.clip = self.opt.clip_gradient
-        self.mom = {
-            n: jnp.zeros_like(exe.arg_dict[n]._h.array)
-            for n in self.param_names} if self.momentum else None
+
+        if self.n_dev > 1:
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+            self._mesh = Mesh(np.array(self.devices), ("dp",))
+            self._sh_repl = NamedSharding(self._mesh, P())
+            self._sh_dp = NamedSharding(self._mesh, P("dp"))
+            # canonical replicated parameter/aux state lives in the fused
+            # step; per-exec arg_dicts receive local replica shards after
+            # every run so eval/save paths stay consistent
+            self._gparams = [
+                jax.device_put(np.asarray(exe.arg_dict[n]._h.array),
+                               self._sh_repl)
+                for n in self.param_names]
+            self._gaux = [
+                jax.device_put(np.asarray(exe.aux_dict[n]._h.array),
+                               self._sh_repl)
+                for n in prog.aux_names]
+            self.mom = {
+                n: jax.device_put(
+                    np.zeros(exe.arg_dict[n].shape,
+                             exe.arg_dict[n]._h.array.dtype),
+                    self._sh_repl)
+                for n in self.param_names} if self.momentum else None
+        else:
+            self._mesh = None
+            self.mom = {
+                n: jnp.zeros_like(exe.arg_dict[n]._h.array)
+                for n in self.param_names} if self.momentum else None
 
         prog_ref = prog
         param_names = self.param_names
@@ -92,8 +137,6 @@ class FusedTrainStep:
         import os
         donate = os.environ.get("MXNET_TPU_FUSED_DONATE", "0") == "1"
 
-        @functools.partial(jax.jit,
-                           donate_argnums=(0, 2) if donate else ())
         def _step(param_vals, other_vals, mom_vals, aux_vals, keys, lrs,
                   wds):
             arg_map = dict(zip(other_names, other_vals))
@@ -125,7 +168,61 @@ class FusedTrainStep:
                     new_params.append(w - lr * (g + wd * w))
             return outs, new_params, new_mom, new_aux
 
-        self._step = _step
+        if self.n_dev == 1:
+            self._step = jax.jit(
+                _step, donate_argnums=(0, 2) if donate else ())
+            return
+
+        # -- multi-device DP: derive shardings, validate at full shapes --
+        # The program was shape-specialized on per-exec SLICES; the DP step
+        # runs the FULL batch through it.  Abstractly evaluate at the full
+        # shapes now — a program with baked batch dims fails HERE (module
+        # falls back to the general path) and the output shapes tell us
+        # which outputs carry the batch dim.
+        repl, dp = self._sh_repl, self._sh_dp
+        full_batch = int(module._data_shapes[0].shape[0])
+        full_shape = {d.name: tuple(d.shape) for d in module._data_shapes}
+        if module._label_shapes:
+            full_shape.update((l.name, tuple(l.shape))
+                              for l in module._label_shapes)
+        # batch-carrying inputs (data/label) shard over dp; every other
+        # graph input (fixed params, states) stays replicated
+        batch_names = set(self.data_names) | set(self.label_names)
+        self._other_is_batch = [n in batch_names for n in self.other_names]
+        sds = jax.ShapeDtypeStruct
+        others = [sds(full_shape.get(n, exe.arg_dict[n].shape),
+                      exe.arg_dict[n]._h.array.dtype)
+                  for n in self.other_names]
+        pvals = [sds(p.shape, p.dtype) for p in self._gparams]
+        avals = [sds(a.shape, a.dtype) for a in self._gaux]
+        mvals = [sds(self.mom[n].shape, self.mom[n].dtype)
+                 for n in self.param_names] if self.mom is not None else []
+        keys = tuple(_random.next_key() for _ in range(exe._n_keys))
+        f32 = sds((len(self.param_names),), np.float32)
+        outs_sd, _, _, _ = jax.eval_shape(_step, pvals, others, mvals,
+                                          avals, keys, f32, f32)
+        # XLA derives the gradient all-reduce from these shardings — the
+        # kvstore collective collapsed into the step program
+        self._step = jax.jit(
+            _step,
+            in_shardings=(
+                [repl] * len(self.param_names),
+                [dp if b else repl for b in self._other_is_batch],
+                [repl] * len(mvals),
+                [repl] * len(aux_names),
+                (repl,) * exe._n_keys,
+                repl, repl),
+            out_shardings=(
+                [dp if (len(o.shape) >= 1 and o.shape[0] == full_batch)
+                 else repl for o in outs_sd],
+                [repl] * len(self.param_names),
+                [repl] * len(mvals),
+                [repl] * len(aux_names)),
+            donate_argnums=(0, 2) if donate else ())
+        # identity of the shard handles we last scattered into exec 0's
+        # arg/aux dicts; a mismatch means someone called set_params/
+        # init_params after us and the global state must be refreshed
+        self._scattered = {}
 
     def run(self, data_batch):
         module = self.module
@@ -141,6 +238,9 @@ class FusedTrainStep:
                         self.mom[n] = v
         self.ran = True
         exe = self.exe
+        if self.n_dev > 1:
+            self._run_dp(data_batch)
+            return
         # load batch into the bound input buffers (device upload + dtype
         # cast; the batch usually arrives host-side from the data pipeline)
         def _load(name, arr):
@@ -160,16 +260,7 @@ class FusedTrainStep:
                 if name in exe.arg_dict:
                     _load(name, arr)
 
-        opt = self.opt
-        lrs, wds = [], []
-        for j, name in enumerate(self.param_names):
-            i = self.param_idx[j]
-            opt._update_count(i)
-            lrs.append(opt._get_lr(i) * 1.0)
-            wds.append(opt._get_wd(i) * 1.0)
-        lrs = jnp.asarray(np.asarray(lrs, np.float32))
-        wds = jnp.asarray(np.asarray(wds, np.float32))
-
+        lrs, wds = self._lr_wd()
         param_vals = [exe.arg_dict[n]._h.array for n in self.param_names]
         other_vals = [exe.arg_dict[n]._h.array for n in self.other_names]
         aux_vals = [exe.aux_dict[n]._h.array for n in self.prog.aux_names]
@@ -189,6 +280,98 @@ class FusedTrainStep:
             exe.aux_dict[n]._h.array = v
         exe.outputs = [NDArray(o) for o in outs]
 
+    def _lr_wd(self):
+        opt = self.opt
+        lrs, wds = [], []
+        for j, name in enumerate(self.param_names):
+            i = self.param_idx[j]
+            opt._update_count(i)
+            lrs.append(opt._get_lr(i) * 1.0)
+            wds.append(opt._get_wd(i) * 1.0)
+        return (jnp.asarray(np.asarray(lrs, np.float32)),
+                jnp.asarray(np.asarray(wds, np.float32)))
+
+    @staticmethod
+    def _replica_shard(garr, dev):
+        """The addressable replica of a replicated/dp-sharded global array
+        on `dev` (falls back to a copy if the device holds no shard)."""
+        for s in garr.addressable_shards:
+            if s.device == dev:
+                return s.data
+        return jax.device_put(np.asarray(garr), dev)
+
+    def _run_dp(self, data_batch):
+        """Multi-device data-parallel step: ONE jitted program over the dp
+        mesh — batch sharded, params replicated, gradient all-reduce
+        inserted by XLA from the shardings (replaces per-device executors
+        + kvstore collective + per-device updater loop)."""
+        exe = self.exe
+        # refresh the canonical replicated state if set_params/init_params
+        # replaced exec handles since our last scatter
+        for j, n in enumerate(self.param_names):
+            cur = exe.arg_dict[n]._h.array
+            if self._scattered.get(n) is not cur:
+                self._gparams[j] = jax.device_put(np.asarray(cur),
+                                                  self._sh_repl)
+        for j, n in enumerate(self.prog.aux_names):
+            cur = exe.aux_dict[n]._h.array
+            if self._scattered.get(n) is not cur:
+                self._gaux[j] = jax.device_put(np.asarray(cur),
+                                               self._sh_repl)
+
+        batch_by_name = dict(zip(self.data_names, data_batch.data))
+        if self.label_names and data_batch.label:
+            batch_by_name.update(zip(self.label_names, data_batch.label))
+
+        def global_input(name, is_batch):
+            if is_batch and name in batch_by_name:
+                src = batch_by_name[name]._h.array
+                want = exe.arg_dict[name]._h.array.dtype
+                if src.dtype != want:
+                    src = src.astype(want)
+                # device_put reshards device arrays directly (no host hop)
+                return jax.device_put(src, self._sh_dp)
+            # non-batch graph input (fixed param, state): replicate the
+            # bound value
+            return jax.device_put(
+                np.asarray(exe.arg_dict[name]._h.array), self._sh_repl)
+
+        other_vals = [global_input(n, b)
+                      for n, b in zip(self.other_names,
+                                      self._other_is_batch)]
+        lrs, wds = self._lr_wd()
+        mom_vals = [self.mom[n] for n in self.param_names] \
+            if self.mom is not None else []
+        keys = tuple(_random.next_key() for _ in range(exe._n_keys))
+
+        outs, new_params, new_mom, new_aux = self._step(
+            self._gparams, other_vals, mom_vals, self._gaux, keys, lrs,
+            wds)
+
+        self._gparams = list(new_params)
+        self._gaux = list(new_aux)
+        if self.mom is not None:
+            for n, v in zip(self.param_names, new_mom):
+                self.mom[n] = v
+        # hand every exec its local replica shard so eval/save/get_params
+        # see the updated state with zero cross-device traffic
+        for k, exe_k in enumerate(self.module._exec_group.execs):
+            dev = self.devices[k]
+            for n, v in zip(self.param_names, new_params):
+                shard = self._replica_shard(v, dev)
+                exe_k.arg_dict[n]._h.array = shard
+                if k == 0:
+                    self._scattered[n] = shard
+            for n, v in zip(self.prog.aux_names, new_aux):
+                shard = self._replica_shard(v, dev)
+                exe_k.aux_dict[n]._h.array = shard
+                if k == 0:
+                    self._scattered[n] = shard
+            # batch-carrying outs are dp-sharded: each exec's shard IS its
+            # batch slice; batchless outs arrive as full replicas
+            exe_k.outputs = [NDArray(self._replica_shard(o, dev))
+                             for o in outs]
+
     def transfer_to_updater(self, updater):
         """Seed a local Updater's per-index SGD momentum from the fused
         buffers so retiring the fused path mid-training keeps momentum."""
@@ -197,8 +380,17 @@ class FusedTrainStep:
         from ..ndarray import NDArray
         for j, name in enumerate(self.param_names):
             idx = self.param_idx[j]
-            updater.states[idx] = NDArray(self.mom[name])
-            updater.states_synced[idx] = True
+            if self.n_dev > 1:
+                # the general path keeps per-device updater state at
+                # index*num_device + k (model.py:_update_params)
+                for k, dev in enumerate(self.devices):
+                    slot = idx * self.n_dev + k
+                    updater.states[slot] = NDArray(
+                        self._replica_shard(self.mom[name], dev))
+                    updater.states_synced[slot] = True
+            else:
+                updater.states[idx] = NDArray(self.mom[name])
+                updater.states_synced[idx] = True
 
     # -- optimizer-state checkpoint interop ---------------------------------
     def export_states(self):
@@ -211,4 +403,5 @@ class FusedTrainStep:
             return
         for n, v in states.items():
             if n in self.mom:
-                self.mom[n] = jnp.asarray(v)
+                self.mom[n] = jax.device_put(np.asarray(v), self._sh_repl) \
+                    if self.n_dev > 1 else jnp.asarray(v)

@@ -315,8 +315,14 @@ class Module(BaseModule):
         # one-dispatch-per-batch fused fwd+bwd+update (north star); falls
         # back silently when the configuration isn't supported
         from .fused_step import FusedTrainStep
-        self._fused_step = FusedTrainStep(self) \
-            if FusedTrainStep.supports(self) else None
+        try:
+            self._fused_step = FusedTrainStep(self) \
+                if FusedTrainStep.supports(self) else None
+        except Exception as e:  # e.g. a program with baked batch shapes
+            self.logger.warning(
+                "fused train step unavailable (%s); using the general "
+                "path", e)
+            self._fused_step = None
         self._fused_pending = False
 
         if self._preload_opt_states is not None:

@@ -164,7 +164,11 @@ def test_push_pull_list_batched_single_collective(monkeypatch):
 
 def test_module_dp_uses_batched_push_pull(monkeypatch):
     """Module DP through tpu_ici issues one collective per batch, not one
-    per parameter."""
+    per parameter.  (The DP fused train step would bypass the kvstore
+    entirely — disable it here to exercise the kvstore path.)"""
+    from mxnet_tpu.module.fused_step import FusedTrainStep
+    monkeypatch.setattr(FusedTrainStep, "supports",
+                        staticmethod(lambda m: False))
     calls = []
     real = tpu_ici.allreduce_arrays
 
