@@ -66,16 +66,23 @@ def _timed_windows(loop_fn, *args, reps=None):
     if reps is None:
         reps = REPS  # resolved at call time so main() can shrink it for cpu
     loop_fn(2, *args)  # warm (compile + caches)
-    estimates = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        loop_fn(N_SMALL, *args)
-        t1 = time.perf_counter()
-        loop_fn(N_LARGE, *args)
-        t2 = time.perf_counter()
-        estimates.append(((t2 - t1) - (t1 - t0)) / (N_LARGE - N_SMALL))
-    estimates.sort()
-    return estimates[len(estimates) // 2]
+    for attempt in range(3):
+        estimates = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            loop_fn(N_SMALL, *args)
+            t1 = time.perf_counter()
+            loop_fn(N_LARGE, *args)
+            t2 = time.perf_counter()
+            estimates.append(((t2 - t1) - (t1 - t0)) / (N_LARGE - N_SMALL))
+        estimates.sort()
+        med = estimates[len(estimates) // 2]
+        if med > 0:
+            return med
+        # host noise made the marginal estimate non-positive; re-measure
+        # rather than emit a negative/infinite rate in the JSON of record
+    raise RuntimeError(
+        "non-positive marginal sec/iter after retries: %r" % (estimates,))
 
 
 def _build_resnet_exe(mx, ctx, rng, grad_req):
@@ -95,12 +102,22 @@ def _build_resnet_exe(mx, ctx, rng, grad_req):
     return exe
 
 
-def _bench_inference(mx, jax, ctx, rng):
+def _bench_inference(mx, jax, ctx, rng, compute_dtype=None):
+    """compute_dtype=bfloat16: params and data stored/computed half-width —
+    the framework's native TPU inference mode."""
     import jax.numpy as jnp
     exe = _build_resnet_exe(mx, ctx, rng, grad_req="null")
     prog = exe._prog
     arg_names, aux_names = prog.arg_names, prog.aux_names
-    arg_vals = tuple(exe.arg_dict[n]._h.array for n in arg_names)
+
+    def maybe_cast(name, a):
+        if compute_dtype is not None and a.dtype == jnp.float32 \
+                and name != "softmax_label":
+            return a.astype(compute_dtype)
+        return a
+
+    arg_vals = tuple(maybe_cast(n, exe.arg_dict[n]._h.array)
+                     for n in arg_names)
     aux_vals = tuple(exe.aux_dict[n]._h.array for n in aux_names)
     flops = _flops_of(
         exe._fwd_jit.lower(arg_vals, aux_vals, (), False).compile())
@@ -116,8 +133,10 @@ def _bench_inference(mx, jax, ctx, rng):
             amap["data"] = data
             outs, _ = prog.evaluate(amap, aux_map, (), False)
             m = jnp.mean(outs[0].astype(jnp.float32))
-            # chain: next input depends (negligibly) on this output
-            return data * (1.0 + jnp.tanh(m) * 1e-12), acc + m
+            # chain: next input depends (negligibly) on this output (the
+            # factor is a runtime value, so XLA cannot fold the dependence)
+            return (data * (1.0 + jnp.tanh(m) * 1e-12).astype(data.dtype),
+                    acc + m)
 
         _, acc = jax.lax.fori_loop(0, n, body,
                                    (amap0["data"], jnp.float32(0.0)))
@@ -130,7 +149,12 @@ def _bench_inference(mx, jax, ctx, rng):
     return BATCH / sec_per_iter, flops / sec_per_iter
 
 
-def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
+def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
+                    compute_dtype=None):
+    """compute_dtype=bfloat16 is the mixed-precision mode the framework's
+    FusedTrainStep runs under optimizer multi_precision: f32 master weights
+    and momentum, half-width cast inside the step, f32 gradients through
+    the cast's vjp (ref semantics: optimizer.py:446-476 mp_sgd_mom_update)."""
     import jax.numpy as jnp
     exe = _build_resnet_exe(mx, ctx, rng, grad_req="write")
     prog = exe._prog
@@ -140,6 +164,11 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
     param_set = set(param_names)
     other_names = [n for n in arg_names if n not in param_set]
     other_vals = tuple(exe.arg_dict[n]._h.array for n in other_names)
+    if compute_dtype is not None:
+        other_vals = tuple(
+            v.astype(compute_dtype)
+            if n == "data" and v.dtype == jnp.float32 else v
+            for n, v in zip(other_names, other_vals))
     params0 = tuple(exe.arg_dict[n]._h.array for n in param_names)
     aux0 = tuple(exe.aux_dict[n]._h.array for n in aux_names)
 
@@ -149,6 +178,8 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
 
         def f(pvals):
             m = dict(amap)
+            if compute_dtype is not None:
+                pvals = [p.astype(compute_dtype) for p in pvals]
             m.update(zip(param_names, pvals))
             outs, new_aux = prog.evaluate(m, aux_map, (), True)
             return outs, tuple(new_aux[n] for n in aux_names)
@@ -159,7 +190,7 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9):
         (grads,) = vjp_fn((heads, zeros_aux))
         new_params, new_mom = [], []
         for w, g, m in zip(params, grads, mom):
-            m2 = momentum * m - lr * g
+            m2 = momentum * m - lr * g.astype(w.dtype)
             new_params.append(w + m2)
             new_mom.append(m2)
         return tuple(new_params), tuple(new_mom), new_aux, outs
@@ -202,8 +233,14 @@ def main():
     peak = PEAK_TFLOPS.get(kind)
     rng = np.random.RandomState(0)
 
-    infer_img_s, infer_flops_s = _bench_inference(mx, jax, ctx, rng)
-    train_img_s, train_flops_s = _bench_training(mx, jax, ctx, rng)
+    import jax.numpy as jnp
+    cdt = jnp.bfloat16  # the framework's native TPU precision mode
+    infer_img_s, infer_flops_s = _bench_inference(mx, jax, ctx, rng,
+                                                  compute_dtype=cdt)
+    train_img_s, train_flops_s = _bench_training(mx, jax, ctx, rng,
+                                                 compute_dtype=cdt)
+    infer32_img_s, infer32_flops_s = _bench_inference(mx, jax, ctx, rng)
+    train32_img_s, train32_flops_s = _bench_training(mx, jax, ctx, rng)
 
     def tf(x):
         return round(x / 1e12, 2) if x else None
@@ -211,17 +248,26 @@ def main():
     def mfu(x):
         return round(x / 1e12 / peak, 4) if (x and peak) else None
 
+    # primary = bf16 mixed-precision TRAINING (f32 masters) — the
+    # framework's recommended TPU mode, the analog of the reference's fp16
+    # multi_precision training; f32 numbers ride along for the strict
+    # baseline-precision comparison
     print(json.dumps({
         "metric": "resnet50_train_batch32",
         "value": round(train_img_s, 2),
         "unit": "images/sec",
         "vs_baseline": round(train_img_s / BASELINE_TRAIN_IMG_S, 3),
+        "precision": "bf16_mixed(f32_master)",
         "train_tflops": tf(train_flops_s),
         "train_mfu": mfu(train_flops_s),
+        "train_f32_img_s": round(train32_img_s, 2),
+        "train_f32_mfu": mfu(train32_flops_s),
         "inference_img_s": round(infer_img_s, 2),
         "inference_vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 3),
         "inference_tflops": tf(infer_flops_s),
         "inference_mfu": mfu(infer_flops_s),
+        "inference_f32_img_s": round(infer32_img_s, 2),
+        "inference_f32_mfu": mfu(infer32_flops_s),
         "device_kind": kind,
         "peak_tflops_bf16": peak,
     }))

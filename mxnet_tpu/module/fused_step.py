@@ -5,26 +5,49 @@ This is the north-star dispatch model (SURVEY.md §7 stage 5 / BASELINE.json):
 where the reference pushes every op of fwd/bwd through the engine and then
 runs one fused optimizer kernel per parameter per batch
 (graph_executor.cc RunOps + model.py _update_params), the whole training
-step here is a single jitted program with donated parameter buffers — one
-host->device dispatch per batch, zero per-parameter Python overhead, and XLA
-fuses the SGD update into the backward pass epilogue.
+step here is a single jitted program — one host->device dispatch per batch,
+zero per-parameter Python overhead, and XLA fuses the optimizer update into
+the backward pass epilogue.
 
-Module uses it automatically when the configuration allows (single device,
-SGD-family optimizer, local updates); anything else falls back to the
-general path.  Momentum state lives on device inside the step and is
-exported/imported for optimizer-state checkpoints.
+Every optimizer that implements `fused_update` (all of them, mirroring the
+reference's full fused-kernel set in src/operator/optimizer_op.cc) runs on
+this path; exotic configurations (monitors, grad_req='add', non-collective
+kvstores) fall back to the general path.
+
+Mixed precision (ref: optimizer.py:446-476 multi_precision): when the bound
+parameters are half-width (float16/bfloat16) and the optimizer has
+multi_precision set, the step keeps float32 MASTER weights and optimizer
+state internally, casts to the storage dtype for the forward, and receives
+float32 gradients through the cast's vjp — the exact mp_sgd_* semantics,
+generalized to every optimizer.  On TPU this is the native training mode:
+bfloat16 compute feeds the MXU and halves HBM traffic while updates
+accumulate in float32.
 """
 from __future__ import annotations
 
-import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import optimizer as opt_mod
 from .. import random as _random
 from ..ndarray import NDArray
+from ..optimizer import _is_low_precision
+
+
+# create_state-shaped pytrees are None / array / tuple-of-those — exactly
+# what jax.tree_util handles (None = empty node, NDArray = leaf)
+def _map_state(fn, st):
+    return jax.tree_util.tree_map(fn, st)
+
+
+def _map2_state(fn, a, b):
+    return jax.tree_util.tree_map(fn, a, b)
+
+
+def _state_leaves(st):
+    return jax.tree_util.tree_leaves(st)
 
 
 class FusedTrainStep:
@@ -42,11 +65,13 @@ class FusedTrainStep:
         else:
             # multi-device DP: the fused step shards the batch over a dp
             # mesh and XLA inserts the gradient all-reduce, replacing the
-            # kvstore's collective — only collective-style stores (or no
-            # store) may be silently subsumed this way
+            # kvstore's collective — only collective-style stores may be
+            # silently subsumed this way.  kvstore=None is rejected: the
+            # general path performs no aggregation there, and the fused
+            # step must not silently train different math (advisor
+            # finding, round 2).
             kv = module._kvstore
-            if kv is not None and not any(t in kv.type
-                                          for t in ("tpu", "ici")):
+            if kv is None or not any(t in kv.type for t in ("tpu", "ici")):
                 return False
             devs = [c.jax_device() for c in module._context]
             if len(set(devs)) != n:
@@ -56,7 +81,7 @@ class FusedTrainStep:
             if len(sizes) != 1:
                 return False
         opt = module._optimizer
-        if type(opt) is not opt_mod.SGD or opt.multi_precision:
+        if opt is None or not opt._fused_ok():
             return False
         for exe in module._exec_group.execs:
             if exe._monitor_callback is not None:
@@ -67,7 +92,7 @@ class FusedTrainStep:
             return False
         return True
 
-    def __init__(self, module):
+    def __init__(self, module, _carry_states=None, _carry_masters=None):
         self.module = module
         self.exe = module._exec_group.execs[0]
         self.opt = module._optimizer
@@ -80,7 +105,6 @@ class FusedTrainStep:
         self.param_names = list(exe._grad_names)
         self.other_names = [n for n in prog.arg_names
                             if n not in set(self.param_names)]
-        # data/label inputs by position in other_names
         self.data_names = [d.name for d in module._data_shapes]
         self.label_names = [l.name for l in module._label_shapes] \
             if module._label_shapes else []
@@ -88,9 +112,17 @@ class FusedTrainStep:
                   enumerate(module._exec_group.param_names)}
         self.param_idx = [idx_of.get(n, i)
                           for i, n in enumerate(self.param_names)]
-        self.momentum = float(getattr(self.opt, "momentum", 0.0))
-        self.rescale = float(self.opt.rescale_grad)
-        self.clip = self.opt.clip_gradient
+
+        # storage dtype per param, and the master dtype the update runs in
+        self.param_dtypes = [exe.arg_dict[n]._h.array.dtype
+                             for n in self.param_names]
+        mp = bool(getattr(self.opt, "multi_precision", False))
+        self.low = [_is_low_precision(dt) for dt in self.param_dtypes]
+        self.master_dtypes = [np.dtype(np.float32) if (mp and lo)
+                              else dt
+                              for dt, lo in zip(self.param_dtypes, self.low)]
+        self.mixed = [np.dtype(m) != np.dtype(p) for m, p in
+                      zip(self.master_dtypes, self.param_dtypes)]
 
         if self.n_dev > 1:
             from jax.sharding import (Mesh, NamedSharding,
@@ -98,149 +130,201 @@ class FusedTrainStep:
             self._mesh = Mesh(np.array(self.devices), ("dp",))
             self._sh_repl = NamedSharding(self._mesh, P())
             self._sh_dp = NamedSharding(self._mesh, P("dp"))
-            # canonical replicated parameter/aux state lives in the fused
-            # step; per-exec arg_dicts receive local replica shards after
-            # every run so eval/save paths stay consistent
-            self._gparams = [
-                jax.device_put(np.asarray(exe.arg_dict[n]._h.array),
-                               self._sh_repl)
-                for n in self.param_names]
-            self._gaux = [
-                jax.device_put(np.asarray(exe.aux_dict[n]._h.array),
-                               self._sh_repl)
-                for n in prog.aux_names]
-            self.mom = {
-                n: jax.device_put(
-                    np.zeros(exe.arg_dict[n].shape,
-                             exe.arg_dict[n]._h.array.dtype),
-                    self._sh_repl)
-                for n in self.param_names} if self.momentum else None
         else:
             self._mesh = None
-            self.mom = {
-                n: jnp.zeros_like(exe.arg_dict[n]._h.array)
-                for n in self.param_names} if self.momentum else None
+            self._sh_repl = None
+
+        def _to_global(arr):
+            # never the default backend: the bound device (or dp mesh)
+            return jax.device_put(arr, self._sh_repl if self.n_dev > 1
+                                  else self.devices[0])
+
+        self._to_global = _to_global
+
+        # canonical master weights + optimizer state live in the step;
+        # per-exec arg_dicts receive storage-dtype values after every run.
+        # On a reshape rebuild the carried masters are authoritative —
+        # re-deriving them from half-width exec storage would truncate the
+        # sub-ulp precision they exist to preserve.
+        if _carry_masters is not None:
+            self._masters = [
+                _to_global(np.asarray(m).astype(self.master_dtypes[j]))
+                for j, m in enumerate(_carry_masters)]
+        else:
+            self._masters = [
+                _to_global(np.asarray(exe.arg_dict[n]._h.array)
+                           .astype(self.master_dtypes[j]))
+                for j, n in enumerate(self.param_names)]
+        self._gaux = [
+            _to_global(np.asarray(exe.aux_dict[n]._h.array))
+            for n in prog.aux_names]
+        if _carry_states is not None:
+            self.states = [
+                _map_state(_to_global, st) for st in _carry_states]
+        else:
+            self.states = [self._init_state(j)
+                           for j in range(len(self.param_names))]
+        # per-param extras width (bias-correction coefficients etc.) —
+        # declared, not probed: fused_scalars needs _update_count to have
+        # run and may be stateful (Nadam's m_schedule)
+        self._n_extra = int(getattr(self.opt, "fused_n_scalars", 0))
+        self._needs_rng = bool(getattr(self.opt, "fused_needs_rng", False))
 
         prog_ref = prog
         param_names = self.param_names
         other_names = self.other_names
         aux_names = prog.aux_names
-        momentum = self.momentum
-        rescale = self.rescale
-        clip = self.clip
-        use_mom = self.mom is not None
+        opt = self.opt
+        param_dtypes = self.param_dtypes
+        mixed = self.mixed
+        n_params = len(param_names)
+        n_extra = self._n_extra
+        needs_rng = self._needs_rng
 
         # Buffer donation halves peak parameter memory, but on remote-
         # attached chips (tunneled runtimes) it forces per-step buffer
         # round-trips — measured 600ms vs 37ms per ResNet-50 step.  Default
         # off; flip on for memory-bound models on locally-attached chips.
-        import os
         donate = os.environ.get("MXNET_TPU_FUSED_DONATE", "0") == "1"
 
-        def _step(param_vals, other_vals, mom_vals, aux_vals, keys, lrs,
-                  wds):
+        def _step(masters, other_vals, states, aux_vals, keys, lrs, wds,
+                  extras, opt_key):
             arg_map = dict(zip(other_names, other_vals))
             aux_map = dict(zip(aux_names, aux_vals))
 
-            def f(pvals):
+            def f(mvals):
                 amap = dict(arg_map)
+                pvals = [m.astype(param_dtypes[j]) if mixed[j] else m
+                         for j, m in enumerate(mvals)]
                 amap.update(zip(param_names, pvals))
                 outs, new_aux = prog_ref.evaluate(amap, aux_map, keys, True)
                 return outs, [new_aux[n] for n in aux_names]
 
-            (outs, new_aux), vjp_fn = jax.vjp(f, param_vals)
+            (outs, new_aux), vjp_fn = jax.vjp(f, masters)
             heads = [jnp.ones_like(o) for o in outs]
             zeros_aux = [jnp.zeros_like(a) for a in new_aux]
             (grads,) = vjp_fn((heads, zeros_aux))
 
-            new_params, new_mom = [], []
-            for j, (w, g) in enumerate(zip(param_vals, grads)):
-                g = g * rescale
-                if clip is not None and clip > 0:
-                    g = jnp.clip(g, -clip, clip)
-                lr = lrs[j]
-                wd = wds[j]
-                if use_mom:
-                    m = momentum * mom_vals[j] - lr * (g + wd * w)
-                    new_params.append(w + m)
-                    new_mom.append(m)
-                else:
-                    new_params.append(w - lr * (g + wd * w))
-            return outs, new_params, new_mom, new_aux
+            opt_keys = jax.random.split(opt_key, n_params) if needs_rng \
+                else [None] * n_params
+            new_masters, new_states, new_exec = [], [], []
+            for j, (w, g) in enumerate(zip(masters, grads)):
+                ex = extras[j] if n_extra else ()
+                nw, nst = opt.fused_update(w, g, states[j], lrs[j], wds[j],
+                                           ex, key=opt_keys[j])
+                nw = nw.astype(w.dtype)
+                nst = _map2_state(lambda a, old: a.astype(old.dtype),
+                                  nst, states[j])
+                new_masters.append(nw)
+                new_states.append(nst)
+                new_exec.append(nw.astype(param_dtypes[j]) if mixed[j]
+                                else nw)
+            return outs, new_masters, new_states, new_aux, new_exec
 
         if self.n_dev == 1:
             self._step = jax.jit(
                 _step, donate_argnums=(0, 2) if donate else ())
+            # identity of the arrays we last wrote into exec's dicts; a
+            # mismatch means set_params/init_params replaced them and the
+            # master state must refresh from the exec value
+            self._scattered = {}
             return
 
         # -- multi-device DP: derive shardings, validate at full shapes --
-        # The program was shape-specialized on per-exec SLICES; the DP step
-        # runs the FULL batch through it.  Abstractly evaluate at the full
-        # shapes now — a program with baked batch dims fails HERE (module
-        # falls back to the general path) and the output shapes tell us
-        # which outputs carry the batch dim.
         repl, dp = self._sh_repl, self._sh_dp
         full_batch = int(module._data_shapes[0].shape[0])
         full_shape = {d.name: tuple(d.shape) for d in module._data_shapes}
         if module._label_shapes:
             full_shape.update((l.name, tuple(l.shape))
                               for l in module._label_shapes)
-        # batch-carrying inputs (data/label) shard over dp; every other
-        # graph input (fixed params, states) stays replicated
         batch_names = set(self.data_names) | set(self.label_names)
         self._other_is_batch = [n in batch_names for n in self.other_names]
         sds = jax.ShapeDtypeStruct
         others = [sds(full_shape.get(n, exe.arg_dict[n].shape),
                       exe.arg_dict[n]._h.array.dtype)
                   for n in self.other_names]
-        pvals = [sds(p.shape, p.dtype) for p in self._gparams]
+        mvals = [sds(m.shape, m.dtype) for m in self._masters]
+        svals = [_map_state(lambda a: sds(a.shape, a.dtype), st)
+                 for st in self.states]
         avals = [sds(a.shape, a.dtype) for a in self._gaux]
-        mvals = [sds(self.mom[n].shape, self.mom[n].dtype)
-                 for n in self.param_names] if self.mom is not None else []
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
-        f32 = sds((len(self.param_names),), np.float32)
-        outs_sd, _, _, _ = jax.eval_shape(_step, pvals, others, mvals,
-                                          avals, keys, f32, f32)
+        f32v = sds((n_params,), np.float32)
+        exv = sds((n_params, max(n_extra, 1)), np.float32)
+        kv = sds((2,), np.uint32)
+        outs_sd, _, _, _, _ = jax.eval_shape(
+            _step, mvals, others, svals, avals, keys, f32v, f32v, exv, kv)
         # XLA derives the gradient all-reduce from these shardings — the
         # kvstore collective collapsed into the step program
+        state_sh = [_map_state(lambda a: repl, st) for st in self.states]
         self._step = jax.jit(
             _step,
             in_shardings=(
-                [repl] * len(self.param_names),
+                [repl] * n_params,
                 [dp if b else repl for b in self._other_is_batch],
-                [repl] * len(mvals),
+                state_sh,
                 [repl] * len(aux_names),
                 (repl,) * exe._n_keys,
-                repl, repl),
+                repl, repl, repl, repl),
             out_shardings=(
                 [dp if (len(o.shape) >= 1 and o.shape[0] == full_batch)
                  else repl for o in outs_sd],
-                [repl] * len(self.param_names),
-                [repl] * len(mvals),
-                [repl] * len(aux_names)),
+                [repl] * n_params,
+                state_sh,
+                [repl] * len(aux_names),
+                [repl] * n_params),
             donate_argnums=(0, 2) if donate else ())
-        # identity of the shard handles we last scattered into exec 0's
-        # arg/aux dicts; a mismatch means someone called set_params/
-        # init_params after us and the global state must be refreshed
         self._scattered = {}
+
+    def _init_state(self, j):
+        """create_state-shaped optimizer state in the master dtype, with
+        jnp leaves (replicated across the dp mesh when present)."""
+        name = self.param_names[j]
+        exe = self.exe
+        master_local = jax.device_put(
+            np.asarray(exe.arg_dict[name]._h.array)
+            .astype(self.master_dtypes[j]), self.devices[0])
+        st_nd = self.opt.create_state(self.param_idx[j],
+                                      NDArray(master_local))
+        return _map_state(
+            lambda a: self._to_global(a._h.array
+                                      if isinstance(a, NDArray) else a),
+            st_nd)
 
     def run(self, data_batch):
         module = self.module
         if module._exec_group.execs[0] is not self.exe:
             # a reshape rebuilt the executors: rebind to the live one,
-            # carrying the momentum state over by name
+            # carrying optimizer state AND f32 masters over by position
+            # (same symbol, so the param list is unchanged)
+            states = self.states
+            masters = [np.asarray(m) for m in self._masters]
             self.exe = module._exec_group.execs[0]
-            mom = self.mom
-            self.__init__(module)
-            if mom is not None and self.mom is not None:
-                for n, v in mom.items():
-                    if n in self.mom and v.shape == self.mom[n].shape:
-                        self.mom[n] = v
+            self.__init__(module,
+                          _carry_states=[_map_state(np.asarray, st)
+                                         for st in states],
+                          _carry_masters=masters)
+            # the carried masters are authoritative: stop the staleness
+            # check below from re-deriving them off half-width storage
+            for n in self.param_names:
+                self._scattered[n] = \
+                    module._exec_group.execs[0].arg_dict[n]._h.array
         self.ran = True
         exe = self.exe
+        # refresh master state where set_params/init_params replaced the
+        # exec handles since our last write-back
+        for j, n in enumerate(self.param_names):
+            cur = exe.arg_dict[n]._h.array
+            if self._scattered.get(n) is not cur:
+                self._masters[j] = self._to_global(
+                    np.asarray(cur).astype(self.master_dtypes[j]))
+        for j, n in enumerate(self.prog.aux_names):
+            cur = exe.aux_dict[n]._h.array
+            if self._scattered.get(n) is not cur:
+                self._gaux[j] = self._to_global(np.asarray(cur))
         if self.n_dev > 1:
             self._run_dp(data_batch)
             return
+
         # load batch into the bound input buffers (device upload + dtype
         # cast; the batch usually arrives host-side from the data pipeline)
         def _load(name, arr):
@@ -260,36 +344,44 @@ class FusedTrainStep:
                 if name in exe.arg_dict:
                     _load(name, arr)
 
-        lrs, wds = self._lr_wd()
-        param_vals = [exe.arg_dict[n]._h.array for n in self.param_names]
+        lrs, wds, extras, opt_key = self._per_step_scalars()
         other_vals = [exe.arg_dict[n]._h.array for n in self.other_names]
-        aux_vals = [exe.aux_dict[n]._h.array for n in self.prog.aux_names]
-        mom_vals = [self.mom[n] for n in self.param_names] \
-            if self.mom is not None else []
+        aux_vals = list(self._gaux)
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        outs, new_params, new_mom, new_aux = self._step(
-            param_vals, other_vals, mom_vals, aux_vals, keys, lrs, wds)
+        outs, new_masters, new_states, new_aux, new_exec = self._step(
+            self._masters, other_vals, self.states, aux_vals, keys, lrs,
+            wds, extras, opt_key)
 
-        for n, v in zip(self.param_names, new_params):
+        self._masters = list(new_masters)
+        self.states = list(new_states)
+        self._gaux = list(new_aux)
+        for n, v in zip(self.param_names, new_exec):
             exe.arg_dict[n]._h.array = v
-        if self.mom is not None:
-            for n, v in zip(self.param_names, new_mom):
-                self.mom[n] = v
+            self._scattered[n] = v
         for n, v in zip(self.prog.aux_names, new_aux):
             exe.aux_dict[n]._h.array = v
+            self._scattered[n] = v
         exe.outputs = [NDArray(o) for o in outs]
 
-    def _lr_wd(self):
+    def _per_step_scalars(self):
         opt = self.opt
-        lrs, wds = [], []
+        lrs, wds, extras = [], [], []
         for j, name in enumerate(self.param_names):
             i = self.param_idx[j]
             opt._update_count(i)
             lrs.append(opt._get_lr(i) * 1.0)
             wds.append(opt._get_wd(i) * 1.0)
-        return (jnp.asarray(np.asarray(lrs, np.float32)),
-                jnp.asarray(np.asarray(wds, np.float32)))
+            extras.append(opt.fused_scalars(i))
+        n = len(self.param_names)
+        ex = np.asarray(extras, np.float32) if self._n_extra \
+            else np.zeros((n, 1), np.float32)
+        opt_key = _random.next_key() if self._needs_rng \
+            else jnp.zeros((2,), jnp.uint32)
+        put = lambda a: jax.device_put(
+            a, self._sh_repl if self.n_dev > 1 else self.devices[0])
+        return (put(np.asarray(lrs, np.float32)),
+                put(np.asarray(wds, np.float32)), put(ex), put(opt_key))
 
     @staticmethod
     def _replica_shard(garr, dev):
@@ -306,19 +398,6 @@ class FusedTrainStep:
         inserted by XLA from the shardings (replaces per-device executors
         + kvstore collective + per-device updater loop)."""
         exe = self.exe
-        # refresh the canonical replicated state if set_params/init_params
-        # replaced exec handles since our last scatter
-        for j, n in enumerate(self.param_names):
-            cur = exe.arg_dict[n]._h.array
-            if self._scattered.get(n) is not cur:
-                self._gparams[j] = jax.device_put(np.asarray(cur),
-                                                  self._sh_repl)
-        for j, n in enumerate(self.prog.aux_names):
-            cur = exe.aux_dict[n]._h.array
-            if self._scattered.get(n) is not cur:
-                self._gaux[j] = jax.device_put(np.asarray(cur),
-                                               self._sh_repl)
-
         batch_by_name = dict(zip(self.data_names, data_batch.data))
         if self.label_names and data_batch.label:
             batch_by_name.update(zip(self.label_names, data_batch.label))
@@ -339,25 +418,21 @@ class FusedTrainStep:
         other_vals = [global_input(n, b)
                       for n, b in zip(self.other_names,
                                       self._other_is_batch)]
-        lrs, wds = self._lr_wd()
-        mom_vals = [self.mom[n] for n in self.param_names] \
-            if self.mom is not None else []
+        lrs, wds, extras, opt_key = self._per_step_scalars()
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        outs, new_params, new_mom, new_aux = self._step(
-            self._gparams, other_vals, mom_vals, self._gaux, keys, lrs,
-            wds)
+        outs, new_masters, new_states, new_aux, new_exec = self._step(
+            self._masters, other_vals, self.states, self._gaux, keys, lrs,
+            wds, extras, opt_key)
 
-        self._gparams = list(new_params)
+        self._masters = list(new_masters)
+        self.states = list(new_states)
         self._gaux = list(new_aux)
-        if self.mom is not None:
-            for n, v in zip(self.param_names, new_mom):
-                self.mom[n] = v
         # hand every exec its local replica shard so eval/save/get_params
         # see the updated state with zero cross-device traffic
         for k, exe_k in enumerate(self.module._exec_group.execs):
             dev = self.devices[k]
-            for n, v in zip(self.param_names, new_params):
+            for n, v in zip(self.param_names, new_exec):
                 shard = self._replica_shard(v, dev)
                 exe_k.arg_dict[n]._h.array = shard
                 if k == 0:
@@ -372,36 +447,65 @@ class FusedTrainStep:
             exe_k.outputs = [NDArray(self._replica_shard(o, dev))
                              for o in outs]
 
+    def _wrap_nd(self, arr, dev):
+        return NDArray(self._replica_shard(arr, dev) if self.n_dev > 1
+                       else arr)
+
     def transfer_to_updater(self, updater):
-        """Seed a local Updater's per-index SGD momentum from the fused
-        buffers so retiring the fused path mid-training keeps momentum."""
-        if self.mom is None or updater is None:
+        """Seed a local Updater's per-index state from the fused buffers so
+        retiring the fused path mid-training keeps optimizer state (and the
+        f32 masters, under multi_precision)."""
+        if updater is None:
             return
-        from ..ndarray import NDArray
         for j, name in enumerate(self.param_names):
             idx = self.param_idx[j]
-            if self.n_dev > 1:
-                # the general path keeps per-device updater state at
-                # index*num_device + k (model.py:_update_params)
-                for k, dev in enumerate(self.devices):
-                    slot = idx * self.n_dev + k
-                    updater.states[slot] = NDArray(
-                        self._replica_shard(self.mom[name], dev))
-                    updater.states_synced[slot] = True
-            else:
-                updater.states[idx] = NDArray(self.mom[name])
-                updater.states_synced[idx] = True
+            devs = self.devices if self.n_dev > 1 else [self.devices[0]]
+            for k, dev in enumerate(devs):
+                slot = idx * self.n_dev + k if self.n_dev > 1 else idx
+                st_nd = _map_state(lambda a: self._wrap_nd(a, dev),
+                                   self.states[j])
+                if self.mixed[j]:
+                    st_nd = self.opt.fused_wrap_mp_state(
+                        st_nd, self._wrap_nd(self._masters[j], dev))
+                updater.states[slot] = st_nd
+                updater.states_synced[slot] = True
 
     # -- optimizer-state checkpoint interop ---------------------------------
     def export_states(self):
-        if self.mom is None:
-            return {}
-        return {n: np.asarray(v) for n, v in self.mom.items()}
+        out = {}
+        for j, name in enumerate(self.param_names):
+            entry = {"state": _map_state(np.asarray, self.states[j])}
+            if self.mixed[j]:
+                entry["master"] = np.asarray(self._masters[j])
+            out[name] = entry
+        return out
 
     def load_states(self, states):
-        if self.mom is None:
-            return
         for n, v in states.items():
-            if n in self.mom:
-                self.mom[n] = jax.device_put(np.asarray(v), self._sh_repl) \
-                    if self.n_dev > 1 else jnp.asarray(v)
+            if n not in self.param_names:
+                continue
+            j = self.param_names.index(n)
+            if isinstance(v, dict):  # fused_v2
+                st = v["state"]
+                if self.mixed[j] and v.get("master") is not None:
+                    self._masters[j] = self._to_global(
+                        np.asarray(v["master"])
+                        .astype(self.master_dtypes[j]))
+                    # pin: the restored f32 master is authoritative — the
+                    # next run()'s staleness check must not re-derive it
+                    # from the half-width exec value
+                    self._scattered[n] = \
+                        self.module._exec_group.execs[0].arg_dict[n]._h.array
+            else:  # fused_v1: bare SGD momentum array
+                st = v
+            cur_leaves = _state_leaves(self.states[j])
+            new_leaves = _state_leaves(st)
+            if len(cur_leaves) != len(new_leaves) or any(
+                    tuple(a.shape) != tuple(b.shape)
+                    for a, b in zip(cur_leaves, new_leaves)):
+                continue
+            it = iter(new_leaves)
+            self.states[j] = _map_state(
+                lambda old: self._to_global(
+                    np.asarray(next(it)).astype(old.dtype)),
+                self.states[j])

@@ -445,7 +445,7 @@ class Module(BaseModule):
             # path the restoring process ends up using
             import pickle
             with open(fname, "wb") as fout:
-                pickle.dump({"format": "fused_v1",
+                pickle.dump({"format": "fused_v2",
                              "states": self._fused_step.export_states()},
                             fout)
         elif self._update_on_kvstore:
@@ -465,7 +465,8 @@ class Module(BaseModule):
             # only the explicit format tag identifies fused states — a bare
             # str-keyed dict is ambiguous with kvstore updater states and
             # must fall through to the kvstore/updater restore path
-            if isinstance(obj, dict) and obj.get("format") == "fused_v1":
+            if isinstance(obj, dict) and obj.get("format") in ("fused_v1",
+                                                               "fused_v2"):
                 payload = obj["states"]
         except Exception:
             pass

@@ -11,6 +11,8 @@ vjp matches MXNet's Executor.backward exactly.
 """
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -94,10 +96,11 @@ register("SoftmaxActivation", _softmax_activation, num_inputs=1,
 
 def _fully_connected(data, weight, *rest, num_hidden=1, no_bias=False, flatten=True):
     x = data.reshape(data.shape[0], -1) if flatten or data.ndim == 2 else data
-    pt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
-    out = jnp.matmul(x, weight.T, preferred_element_type=pt)
-    if pt:
-        out = out.astype(data.dtype)
+    # bf16 operands hit the MXU directly; the MXU accumulates partial
+    # products in f32 regardless of operand dtype, so no explicit
+    # preferred_element_type is needed (and an f32 preferred type breaks
+    # the conv/dot transpose rules under vjp by mixing cotangent dtypes)
+    out = jnp.matmul(x, weight.T)
     if not no_bias:
         out = out + rest[0]
     return out
@@ -169,7 +172,6 @@ def _convolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
-    pt = jnp.float32 if data.dtype in (jnp.bfloat16, jnp.float16) else None
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -177,10 +179,7 @@ def _convolution(data, weight, *rest, kernel=(1, 1), stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=_conv_dn(nd),
         feature_group_count=int(num_group),
-        preferred_element_type=pt,
     )
-    if pt:
-        out = out.astype(data.dtype)
     if not no_bias:
         b = rest[0].reshape((1, -1) + (1,) * nd)
         out = out + b
@@ -393,29 +392,111 @@ register("Pooling", _pooling, num_inputs=1, infer_shape=_pool_infer_shape,
 # last two are state outputs the executor folds back into the aux arrays.
 # ---------------------------------------------------------------------------
 
+@_functools.lru_cache(maxsize=None)
+def _bn_train_core(ndim, ax, eps):
+    """Training-mode BN with a hand-written VJP (ref: batch_norm-inl.h
+    backward).  Autodiff of the naive formulation makes XLA carry f32
+    normalized activations as residuals and re-reduce twice — on TPU the
+    train step is HBM-bound, so BN is rebuilt around minimal traffic:
+    one-pass f32 stats (sum / sum-of-squares fused into a single read),
+    scale/shift forward (y = x*A + B with per-channel A, B), and residuals
+    of just the compute-dtype input plus per-channel mean/invstd.  The
+    backward is exact, including the cotangent paths through the returned
+    batch mean/var (which feed the moving-average update and
+    output_mean_var consumers)."""
+    red = tuple(i for i in range(ndim) if i != ax)
+    bshape = tuple(-1 if i == ax else 1 for i in range(ndim))
+
+    def stats(x):
+        x32 = x.astype(jnp.float32)
+        m = jnp.mean(x32, axis=red)
+        sq = jnp.mean(jnp.square(x32), axis=red)
+        var = jnp.maximum(sq - jnp.square(m), 0.0)
+        return m, var
+
+    @jax.custom_vjp
+    def core(x, g, b):
+        mean, var = stats(x)
+        inv = lax.rsqrt(var + eps)
+        A = (g.astype(jnp.float32) * inv).reshape(bshape)
+        B = (b.astype(jnp.float32)
+             - mean * g.astype(jnp.float32) * inv).reshape(bshape)
+        y = (x.astype(jnp.float32) * A + B).astype(x.dtype)
+        return y, mean, var
+
+    def fwd(x, g, b):
+        mean, var = stats(x)
+        inv = lax.rsqrt(var + eps)
+        A = (g.astype(jnp.float32) * inv).reshape(bshape)
+        B = (b.astype(jnp.float32)
+             - mean * g.astype(jnp.float32) * inv).reshape(bshape)
+        y = (x.astype(jnp.float32) * A + B).astype(x.dtype)
+        return (y, mean, var), (x, g, mean, inv)
+
+    def bwd(res, cts):
+        x, g, mean, inv = res
+        dy, dmean, dvar = cts
+        M = 1
+        for i in red:
+            M *= x.shape[i]
+        x32 = x.astype(jnp.float32)
+        dy32 = dy.astype(jnp.float32)
+        xc = x32 - mean.reshape(bshape)          # x - mean (recomputed)
+        sum_dy = jnp.sum(dy32, axis=red)
+        sum_dy_xc = jnp.sum(dy32 * xc, axis=red)
+        g32 = g.astype(jnp.float32)
+        # y-path (batch stats depend on x), + mean/var output cotangents
+        dx = (g32 * inv).reshape(bshape) * (
+            dy32 - (sum_dy / M).reshape(bshape)
+            - xc * (inv * inv * sum_dy_xc / M).reshape(bshape))
+        dx = dx + (dmean / M).reshape(bshape) \
+            + xc * (2.0 * dvar / M).reshape(bshape)
+        dg = sum_dy_xc * inv
+        db = sum_dy
+        return dx.astype(x.dtype), dg.astype(g.dtype), db.astype(g.dtype)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False, _train=False):
     ax = int(axis) % data.ndim
-    reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _train and not use_global_stats:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
+        core = _bn_train_core(data.ndim, ax, float(eps))
+        out, mean, var = core(data, g, beta)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
         mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps)
-    out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
-    out = out.astype(data.dtype) * g.reshape(bshape) + beta.reshape(bshape)
+        inv = lax.rsqrt(var + eps)
+        out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+        # g/beta are f32 in half-width nets (_bn_infer_type) — keep the
+        # output in the data dtype so train and eval modes agree
+        out = (out.astype(data.dtype) * g.reshape(bshape)
+               + beta.reshape(bshape)).astype(data.dtype)
     if output_mean_var:
         return (out, mean.astype(data.dtype), var.astype(data.dtype),
                 new_mm, new_mv)
     return out, new_mm, new_mv
+
+
+def _bn_infer_type(in_dtypes, attrs):
+    """gamma/beta/moving stats stay float32 when data is half-width
+    (ref: batch_norm-inl.h InferType — fp16 nets keep f32 BN params; on
+    TPU the same rule applies to bfloat16)."""
+    from ..base import dtype_name
+    d = in_dtypes[0]
+    if d is None:
+        return in_dtypes, None
+    pt = np.float32 if dtype_name(d) in ("float16", "bfloat16") else d
+    filled = [d, pt, pt, pt, pt][:len(in_dtypes)]
+    n_out = 3 if attrs.get("output_mean_var") else 1
+    return filled, [d] * n_out
 
 
 def _bn_infer_shape(in_shapes, attrs):
@@ -437,6 +518,7 @@ register("BatchNorm", _batch_norm,
          mutate_map=(3, 4),
          takes_train_flag=True,
          infer_shape=_bn_infer_shape,
+         infer_type=_bn_infer_type,
          aliases=("BatchNorm_v1",),
          params={"eps": (pFloat, 1e-3), "momentum": (pFloat, 0.9),
                  "fix_gamma": (pBool, True), "use_global_stats": (pBool, False),
@@ -636,9 +718,6 @@ def _softmax_output_grad(out, label, grad_scale, ignore_label, use_ignore,
     elif normalization == "valid":
         grad = grad / jnp.maximum(valid.sum(), 1.0)
     return grad * grad_scale
-
-
-import functools as _functools
 
 
 @_functools.lru_cache(maxsize=None)

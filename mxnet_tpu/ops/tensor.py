@@ -137,7 +137,11 @@ register("BlockGrad", lambda x: lax.stop_gradient(x), num_inputs=1,
          aliases=("stop_gradient",))
 register("make_loss", lambda x: x, num_inputs=1)
 register("Cast", lambda x, dtype="float32": x.astype(np_dtype(dtype)),
-         num_inputs=1, params={"dtype": (pDtype, "float32")}, aliases=("cast",))
+         num_inputs=1, params={"dtype": (pDtype, "float32")}, aliases=("cast",),
+         # output dtype is the attr, independent of input and of shape
+         # availability (the generic rule would leak the input dtype through)
+         infer_type=lambda in_dts, attrs: (in_dts,
+                                           [np_dtype(attrs["dtype"])]))
 register("clip", lambda x, a_min=0.0, a_max=1.0: jnp.clip(x, a_min, a_max),
          num_inputs=1, params={"a_min": (pFloat, 0.0), "a_max": (pFloat, 1.0)})
 

@@ -11,9 +11,19 @@ import pickle
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, dtype_name
 from .ndarray import NDArray, zeros, array, _invoke
 from .ndarray import ndarray as ndmod
+
+
+def _is_low_precision(dtype):
+    """True for storage dtypes that need an f32 master copy under
+    multi_precision (ref: optimizer.py:446 checks float16; bfloat16 is the
+    TPU-native half-width format so it gets the same treatment)."""
+    try:
+        return dtype_name(dtype) in ("float16", "bfloat16")
+    except Exception:
+        return False
 
 
 class Optimizer:
@@ -63,7 +73,7 @@ class Optimizer:
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = weight.astype(np.float32)
             return (weight_master_copy,) + (self.create_state(index, weight_master_copy),)
         return self.create_state(index, weight)
@@ -72,13 +82,52 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = state[0]
             grad32 = grad.astype(np.float32)
             self.update(index, weight_master_copy, grad32, state[1])
             weight_master_copy.copyto(weight)
         else:
             self.update(index, weight, grad, state)
+
+    # -- fused-step interface (jit-composable update math) -------------------
+    # The reference fuses every optimizer into dedicated kernels
+    # (src/operator/optimizer_op.cc); here the analogous design is that each
+    # optimizer exposes its update as pure jnp math that FusedTrainStep
+    # composes into the ONE jitted train program.  `fused_update` must
+    # reproduce `update()` exactly given the same scalars.
+    fused_needs_rng = False  # set True when fused_update takes a PRNG key
+    fused_n_scalars = 0      # width of the fused_scalars tuple (declared)
+
+    def _fused_ok(self):
+        # fused_update must come from a class at-or-below the one that
+        # defines update() in the MRO: a subclass overriding only update()
+        # (custom math over an existing optimizer) must NOT silently train
+        # with its parent's fused math
+        for klass in type(self).__mro__:
+            if "fused_update" in vars(klass):
+                return klass.fused_update is not Optimizer.fused_update
+            if "update" in vars(klass):
+                return False
+        return False
+
+    def fused_scalars(self, index):
+        """Extra per-step python scalars beyond lr/wd (e.g. bias-correction
+        coefficients).  Called once per parameter per step, after
+        _update_count — so stateful schedules (Nadam's m_schedule) mutate
+        here exactly as they would in update()."""
+        return ()
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        """(new_w, new_state) from master weight w, raw gradient g, and the
+        create_state-shaped `state` pytree; lr/wd/ex are traced scalars."""
+        raise NotImplementedError
+
+    def fused_wrap_mp_state(self, state_nd, master_nd):
+        """Updater-state structure for a low-precision weight under
+        multi_precision (base convention: (w32, state); SGD overrides to
+        its (mom, w32) layout)."""
+        return (master_nd,) + (state_nd,)
 
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
@@ -169,7 +218,7 @@ class SGD(Optimizer):
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             w32 = weight.astype(np.float32)
             if self.momentum != 0.0:
                 mom = zeros(weight.shape, weight.context, dtype=np.float32)
@@ -177,6 +226,21 @@ class SGD(Optimizer):
                 mom = None
             return (mom, w32)
         return self.create_state(index, weight)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        from .ops import optimizer_ops as fo
+        cg = -1.0 if self.clip_gradient is None else self.clip_gradient
+        if self.momentum == 0.0:
+            return fo._sgd_update(w, g, lr=lr, wd=wd,
+                                  rescale_grad=self.rescale_grad,
+                                  clip_gradient=cg), state
+        new_w, new_mom = fo._sgd_mom_update(
+            w, g, state, lr=lr, momentum=self.momentum, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=cg)
+        return new_w, new_mom
+
+    def fused_wrap_mp_state(self, state_nd, master_nd):
+        return (state_nd, master_nd)  # SGD's (mom, w32) layout
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -226,6 +290,19 @@ class Signum(Optimizer):
             _invoke("signsgd_update", [weight, grad],
                     dict(kwargs, lr=lr, wd=wd), out=weight)
 
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        from .ops import optimizer_ops as fo
+        cg = -1.0 if self.clip_gradient is None else self.clip_gradient
+        if state is None:
+            return fo._signsgd_update(w, g, lr=lr, wd=wd,
+                                      rescale_grad=self.rescale_grad,
+                                      clip_gradient=cg), None
+        new_w, new_mom = fo._signum_update(
+            w, g, state, lr=lr, momentum=self.momentum, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=cg,
+            wd_lh=self.wd_lh)
+        return new_w, new_mom
+
 
 @register
 class NAG(Optimizer):
@@ -254,6 +331,17 @@ class NAG(Optimizer):
         else:
             weight += -lr * (grad + wd * weight)
 
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if state is None:
+            return w - lr * (g + wd * w), None
+        g = g + wd * w
+        mom = self.momentum * state + g
+        return w - lr * (g + self.momentum * mom), mom
+
 
 @register
 class SGLD(Optimizer):
@@ -271,6 +359,17 @@ class SGLD(Optimizer):
             np.random.normal(0, math.sqrt(lr), size=weight.shape),
             ctx=weight.context, dtype=weight.dtype)
         weight += -lr / 2 * (grad + wd * weight) + noise
+
+    fused_needs_rng = True
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = jax.random.normal(key, w.shape, jnp.float32) * jnp.sqrt(lr)
+        return w - lr / 2 * (g + wd * w) + noise, state
 
 
 @register
@@ -294,7 +393,9 @@ class DCASGD(Optimizer):
         if self.clip_gradient is not None:
             grad = grad.clip(-self.clip_gradient, self.clip_gradient)
         mom, previous_weight = state
-        if mom:
+        # (reference writes `if mom:` — py2-era NDArray had no __bool__,
+        # so that test was object truthiness, i.e. `is not None`)
+        if mom is not None:
             mom *= self.momentum
             mom += -lr * (grad + wd * weight +
                           self.lamda * grad * grad * (weight - previous_weight))
@@ -304,6 +405,17 @@ class DCASGD(Optimizer):
                          self.lamda * grad * grad * (weight - previous_weight))
         previous_weight[:] = weight
         weight += mom
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        delta = -lr * (g + wd * w + self.lamda * g * g * (w - prev))
+        mom = delta if mom is None else self.momentum * mom + delta
+        new_w = w + mom
+        return new_w, (None if self.momentum == 0.0 else mom, w)
 
 
 @register
@@ -332,6 +444,21 @@ class Adam(Optimizer):
                 dict(_common_kwargs(self), lr=lr, wd=wd, beta1=self.beta1,
                      beta2=self.beta2, epsilon=self.epsilon), out=weight)
 
+    fused_n_scalars = 1
+
+    def fused_scalars(self, index):
+        t = self._index_update_count[index]
+        return (math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t),)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        from .ops import optimizer_ops as fo
+        cg = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_mean, new_var = fo._adam_update(
+            w, g, state[0], state[1], lr=lr * ex[0], beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=cg)
+        return new_w, (new_mean, new_var)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -352,6 +479,15 @@ class AdaGrad(Optimizer):
         history += grad * grad
         div = grad / (history + self.float_stable_eps).sqrt()
         weight += (div + weight * wd) * -lr
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        hist = state + g * g
+        return w - lr * (g / jnp.sqrt(hist + self.float_stable_eps)
+                         + w * wd), hist
 
 
 @register
@@ -388,6 +524,23 @@ class RMSProp(Optimizer):
             _invoke("rmspropalex_update", [weight, grad, n, g, delta], kwargs,
                     out=weight)
 
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        from .ops import optimizer_ops as fo
+        cg = -1.0 if self.clip_gradient is None else self.clip_gradient
+        cw = self.clip_weights if self.clip_weights else -1.0
+        if not self.centered:
+            new_w, new_n = fo._rmsprop_update(
+                w, g, state[0], lr=lr, gamma1=self.gamma1,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=cg, clip_weights=cw)
+            return new_w, (new_n,)
+        new_w, new_n, new_g, new_d = fo._rmspropalex_update(
+            w, g, state[0], state[1], state[2], lr=lr, gamma1=self.gamma1,
+            gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=cg,
+            clip_weights=cw)
+        return new_w, (new_n, new_g, new_d)
+
 
 @register
 class AdaDelta(Optimizer):
@@ -415,6 +568,18 @@ class AdaDelta(Optimizer):
         acc_delta += (1.0 - self.rho) * current_delta * current_delta
         weight[:] = weight - current_delta - wd * weight
 
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g = self.rho * acc_g + (1.0 - self.rho) * g * g
+        delta = (jnp.sqrt(acc_delta + self.epsilon)
+                 / jnp.sqrt(acc_g + self.epsilon)) * g
+        acc_delta = self.rho * acc_delta + (1.0 - self.rho) * delta * delta
+        return w - delta - wd * w, (acc_g, acc_delta)
+
 
 @register
 class Ftrl(Optimizer):
@@ -436,6 +601,15 @@ class Ftrl(Optimizer):
         _invoke("ftrl_update", [weight, grad, z, n],
                 dict(_common_kwargs(self), lr=lr, wd=wd, lamda1=self.lamda1,
                      beta=self.beta), out=weight)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        from .ops import optimizer_ops as fo
+        cg = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_z, new_n = fo._ftrl_update(
+            w, g, state[0], state[1], lr=lr, lamda1=self.lamda1,
+            beta=self.beta, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=cg)
+        return new_w, (new_z, new_n)
 
 
 @register
@@ -467,6 +641,25 @@ class FTML(Optimizer):
         d[:] = d_t
         weight[:] = -z / d_t
 
+    fused_n_scalars = 2
+
+    def fused_scalars(self, index):
+        t = self._index_update_count[index]
+        return (1.0 - self.beta1 ** t, 1.0 - self.beta2 ** t)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        coef1, coef2 = ex[0], ex[1]
+        g = g * self.rescale_grad + wd * w
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        d, v, z = state
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d_t = coef1 / lr * (jnp.sqrt(v / coef2) + self.epsilon)
+        sigma_t = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma_t * w
+        return -z / d_t, (d_t, v, z)
+
 
 @register
 class Adamax(Optimizer):
@@ -489,8 +682,24 @@ class Adamax(Optimizer):
             grad = grad.clip(-self.clip_gradient, self.clip_gradient)
         m_t, u_t = state
         m_t[:] = self.beta1 * m_t + (1.0 - self.beta1) * grad
-        u_t[:] = ndmod.maximum(self.beta2 * u_t, grad.abs())
+        u_t[:] = _invoke("_maximum", [self.beta2 * u_t, grad.abs()], {})
         weight[:] = weight - lr * m_t / u_t
+
+    fused_n_scalars = 1
+
+    def fused_scalars(self, index):
+        t = self._index_update_count[index]
+        return (1.0 / (1.0 - self.beta1 ** t),)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad + wd * w
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t = self.beta1 * m_t + (1.0 - self.beta1) * g
+        u_t = jnp.maximum(self.beta2 * u_t, jnp.abs(g))
+        return w - (lr * ex[0]) * m_t / u_t, (m_t, u_t)
 
 
 @register
@@ -528,6 +737,37 @@ class Nadam(Optimizer):
         m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
         weight[:] = weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
 
+    fused_n_scalars = 5
+
+    def fused_scalars(self, index):
+        # mirror update()'s stateful schedule exactly (mutates m_schedule
+        # once per parameter per step, like the per-call mutation there)
+        t = self._index_update_count[index]
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96
+                                   ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96
+                                     ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        return (momentum_t, momentum_t_1, self.m_schedule, m_schedule_next,
+                1.0 - self.beta2 ** t)
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        import jax.numpy as jnp
+        momentum_t, momentum_t_1, m_schedule, m_schedule_next, coef2 = ex
+        g = g * self.rescale_grad + wd * w
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m_t, v_t = state
+        m_t = self.beta1 * m_t + (1.0 - self.beta1) * g
+        v_t = self.beta2 * v_t + (1.0 - self.beta2) * g * g
+        grad_prime = g / (1.0 - m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / coef2
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        return w - lr * m_t_bar / (jnp.sqrt(v_t_prime) + self.epsilon), \
+            (m_t, v_t)
+
 
 @register
 class Test(Optimizer):
@@ -540,6 +780,10 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
         state[:] = weight
+
+    def fused_update(self, w, g, state, lr, wd, ex, key=None):
+        new_w = w + g * self.rescale_grad
+        return new_w, new_w
 
 
 create = Optimizer.create_optimizer

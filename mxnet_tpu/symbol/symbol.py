@@ -460,6 +460,25 @@ class Symbol:
                         and all(dtypes.get((node, i)) is not None
                                 for i in range(n_out)):
                     continue
+                # op-specific dtype rule (ref: InferType attr, e.g.
+                # BatchNorm pins gamma/beta/aux to float32 under half-width
+                # data, batch_norm-inl.h) — runs before and replaces the
+                # generic first-input-dtype propagation for this node
+                if op.infer_type is not None:
+                    try:
+                        t_filled, t_outs = op.infer_type(in_dtypes, attrs)
+                    except Exception:
+                        t_filled = t_outs = None
+                    if t_filled is not None:
+                        for (n, i), d in zip(in_entries, t_filled):
+                            if d is not None and dtypes.get((n, i)) is None:
+                                dtypes[(n, i)] = np_dtype(d)
+                                changed = True
+                    if t_outs is not None:
+                        for i, d in enumerate(t_outs[:n_out]):
+                            if d is not None and dtypes.get((node, i)) is None:
+                                dtypes[(node, i)] = np_dtype(d)
+                                changed = True
                 filled, out_shapes = None, None
                 if op.infer_shape is not None:
                     try:
@@ -516,8 +535,9 @@ class Symbol:
                     for i, s in enumerate(out_shapes[:n_out + n_state]):
                         changed |= store(shapes, (node, i), s)
                 # dtype propagation: default = first known input dtype
+                # (ops with an explicit infer_type rule opt out)
                 known_dt = next((d for d in in_dtypes if d is not None), None)
-                if known_dt is not None:
+                if known_dt is not None and op.infer_type is None:
                     for i in range(n_out):
                         if dtypes.get((node, i)) is None:
                             dtypes[(node, i)] = known_dt
