@@ -200,7 +200,10 @@ class TpuIciKVStore(KVStore):
             by_dev = {list(a.devices())[0]: a for a in arrays}
             if (self._updater is not None or type(stored) is not NDArray
                     or len(arrays) != len(vals)
-                    or len(arrays) < 2 or len(by_dev) != len(arrays)):
+                    or len(arrays) < 2 or len(by_dev) != len(arrays)
+                    # mixed-dtype copies would silently promote the whole
+                    # group's concat buffer — reduce such keys individually
+                    or len({a.dtype for a in arrays}) != 1):
                 fallback.append((k, v, o))
                 continue
             devs = tuple(sorted(by_dev, key=lambda d: d.id))
