@@ -107,8 +107,12 @@ class RowSparseNDArray(BaseSparseNDArray):
         if isinstance(other, RowSparseNDArray):
             if other is self:
                 raise MXNetError("cannot copy an array onto itself")
-            other._data_arr = self._data_arr.copy()
-            other._indices = self._indices.copy()
+            # payload moves to the DESTINATION's context; other._ctx stays
+            # authoritative (a cross-device copyto must not leave data
+            # stranded on the source device)
+            dst_ctx = other.context
+            other._data_arr = self._data_arr.copy().as_in_context(dst_ctx)
+            other._indices = self._indices.copy().as_in_context(dst_ctx)
             other._sshape = self._sshape
             return other
         if isinstance(other, NDArray):
@@ -204,9 +208,10 @@ class CSRNDArray(BaseSparseNDArray):
         if isinstance(other, CSRNDArray):
             if other is self:
                 raise MXNetError("cannot copy an array onto itself")
-            other._data_arr = self._data_arr.copy()
-            other._indices = self._indices.copy()
-            other._indptr = self._indptr.copy()
+            dst_ctx = other.context
+            other._data_arr = self._data_arr.copy().as_in_context(dst_ctx)
+            other._indices = self._indices.copy().as_in_context(dst_ctx)
+            other._indptr = self._indptr.copy().as_in_context(dst_ctx)
             other._sshape = self._sshape
             return other
         if isinstance(other, NDArray):

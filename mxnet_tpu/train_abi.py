@@ -62,6 +62,12 @@ class TrainSession:
         self._label_names = list(label_names)
         self._shapes = shapes
         self._staged = {}
+        # output shapes are valid right after create, before any forward —
+        # inferred from the symbol at bind time exactly like the predict
+        # ABI (Predictor._infer_out_shapes); C consumers size their buffers
+        # from MXTrainGetOutputShape before calling Forward
+        _, out_shapes, _ = sym.infer_shape(**shapes)
+        self._out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
 
     # -- buffer marshalling (C ABI) -----------------------------------------
 
@@ -104,8 +110,11 @@ class TrainSession:
         self._mod.forward(self._batch(need_labels=False), is_train=False)
 
     def get_output_shape(self, index=0):
-        outs = self._mod.get_outputs()
-        return tuple(outs[index].shape)
+        try:
+            outs = self._mod.get_outputs()
+            return tuple(outs[index].shape)
+        except Exception:
+            return self._out_shapes[index]  # no forward yet: bind-time shape
 
     def get_output_bytes(self, index=0):
         out = self._mod.get_outputs()[index]
