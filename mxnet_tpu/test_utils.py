@@ -14,7 +14,7 @@ from . import ndarray as nd
 from . import symbol as sym_mod
 from .executor import Executor
 
-_rng = np.random.RandomState(1234)
+_rng = np.random.RandomState(1234)  # module-local shape RNG
 
 
 def default_context():
@@ -30,16 +30,15 @@ def default_dtype():
 
 
 def random_arrays(*shapes):
-    arrays = [np.random.randn(*s).astype(default_dtype()) for s in shapes]
-    if len(arrays) == 1:
-        return arrays[0]
-    return arrays
+    out = tuple(np.random.randn(*s).astype(default_dtype())
+                for s in shapes)
+    return out[0] if len(out) == 1 else list(out)
 
 
 def random_sample(population, k):
-    population_copy = population[:]
-    np.random.shuffle(population_copy)
-    return population_copy[0:k]
+    shuffled = list(population)
+    np.random.shuffle(shuffled)
+    return shuffled[:k]
 
 
 def rand_shape_2d(dim0=10, dim1=10):
@@ -64,19 +63,24 @@ def rand_ndarray(shape, stype="default", density=None, dtype=None):
 
 
 def np_reduce(dat, axis, keepdims, numpy_reduce_func):
-    if isinstance(axis, int):
-        axis = [axis]
+    """Reference reduction helper for axis-reduce op checks: applies the
+    numpy reducer one axis at a time (the MXNet axis-list semantics), then
+    restores singleton dims when keepdims."""
+    if axis is None:
+        axes = tuple(range(dat.ndim))
+    elif isinstance(axis, int):
+        axes = (axis,)
     else:
-        axis = list(axis) if axis is not None else range(len(dat.shape))
-    ret = dat
-    for i in reversed(sorted(axis)):
-        ret = numpy_reduce_func(ret, axis=i)
+        axes = tuple(axis)
+    axes = tuple(a % dat.ndim for a in axes)
+    out = dat
+    # descending order keeps the remaining axis numbers valid as dims drop
+    for ax in sorted(axes, reverse=True):
+        out = numpy_reduce_func(out, axis=ax)
     if keepdims:
-        keepdims_shape = list(dat.shape)
-        for i in axis:
-            keepdims_shape[i] = 1
-        ret = ret.reshape(tuple(keepdims_shape))
-    return ret
+        out = out.reshape(tuple(1 if i in axes else d
+                                for i, d in enumerate(dat.shape)))
+    return out
 
 
 def same(a, b):
@@ -86,12 +90,9 @@ def same(a, b):
 def find_max_violation(a, b, rtol=None, atol=None):
     rtol = 1e-5 if rtol is None else rtol
     atol = 1e-20 if atol is None else atol
-    diff = np.abs(a - b)
-    tol = atol + rtol * np.abs(b)
-    violation = diff / (tol + 1e-20)
-    loc = np.argmax(violation)
-    idx = np.unravel_index(loc, violation.shape)
-    return idx, np.max(violation)
+    violation = np.abs(a - b) / (atol + rtol * np.abs(b) + 1e-20)
+    worst = np.unravel_index(np.argmax(violation), violation.shape)
+    return worst, violation[worst]
 
 
 def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
@@ -138,9 +139,7 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
     exe = sym.bind(ctx, args=inputs)
     exe.forward(is_train=is_train)
     outputs = [o.asnumpy() for o in exe.outputs]
-    if len(outputs) == 1:
-        outputs = outputs[0]
-    return outputs
+    return outputs[0] if len(outputs) == 1 else outputs
 
 
 def _parse_location(sym, location, ctx, dtype=np.float32):
@@ -225,24 +224,20 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     location = _parse_location(sym, location, ctx, dtype=dtype)
     location_npy = {k: v.asnumpy() for k, v in location.items()}
     aux_states = _parse_aux_states(sym, aux_states, ctx, dtype=dtype)
-    if aux_states is not None:
-        aux_states_npy = {k: v.asnumpy() for k, v in aux_states.items()}
+    aux_states_npy = None if aux_states is None else \
+        {k: v.asnumpy() for k, v in aux_states.items()}
+    # grad_nodes: None -> every argument; list -> those names; dict -> a
+    # per-name grad_req map
+    if isinstance(grad_nodes, dict):
+        grad_req = dict(grad_nodes)
+        grad_nodes = list(grad_req)
     else:
-        aux_states_npy = None
-    if grad_nodes is None:
-        grad_nodes = sym.list_arguments()
-        grad_req = {k: "write" for k in grad_nodes}
-    elif isinstance(grad_nodes, (list, tuple)):
-        grad_nodes = list(grad_nodes)
-        grad_req = {k: "write" for k in grad_nodes}
-    elif isinstance(grad_nodes, dict):
-        grad_req = grad_nodes.copy()
-        grad_nodes = grad_nodes.keys()
-    else:
-        raise ValueError
+        grad_nodes = list(grad_nodes) if grad_nodes is not None \
+            else sym.list_arguments()
+        grad_req = dict.fromkeys(grad_nodes, "write")
 
-    input_shape = {k: v.shape for k, v in location.items()}
-    _, out_shape, _ = sym.infer_shape(**input_shape)
+    _, out_shape, _ = sym.infer_shape(
+        **{k: v.shape for k, v in location.items()})
     proj = sym_mod.Variable("__random_proj")
     out = sym_mod.sum(sym * proj)
     out = sym_mod.MakeLoss(out)
@@ -267,20 +262,21 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
         use_forward_train=use_forward_train, dtype=dtype)
 
     for name in grad_nodes:
-        fd_grad = numeric_gradients[name]
-        orig_grad = args_grad_npy[name]
-        sym_grad = symbolic_grads[name]
-        if grad_req[name] == "write":
-            assert_almost_equal(fd_grad, sym_grad, rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "add":
-            assert_almost_equal(fd_grad, sym_grad - orig_grad, rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
-        elif grad_req[name] == "null":
-            assert_almost_equal(orig_grad, sym_grad, rtol, atol,
-                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        req = grad_req[name]
+        labels = ("NUMERICAL_%s" % name, "BACKWARD_%s" % name)
+        if req == "write":
+            assert_almost_equal(numeric_gradients[name],
+                                symbolic_grads[name], rtol, atol, labels)
+        elif req == "add":
+            assert_almost_equal(
+                numeric_gradients[name],
+                symbolic_grads[name] - args_grad_npy[name], rtol, atol,
+                labels)
+        elif req == "null":
+            assert_almost_equal(args_grad_npy[name], symbolic_grads[name],
+                                rtol, atol, labels)
         else:
-            raise ValueError
+            raise ValueError(req)
 
 
 def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
@@ -392,9 +388,8 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
                 size=arr.shape, scale=scale).astype(arr.dtype
                                                     if np.dtype(arr.dtype) != np.dtype(np.float16)
                                                     else np.float32)
-    for n, arr in exe_list[0].aux_dict.items():
-        if n not in aux_params:
-            aux_params[n] = 0
+    for n in exe_list[0].aux_dict:
+        aux_params.setdefault(n, 0)
     for exe in exe_list:
         for name, arr in exe.arg_dict.items():
             arr[:] = np.asarray(arg_params[name]).astype(arr.dtype)

@@ -7,141 +7,137 @@ import os
 import random
 import sys
 from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np
 
+def scan_images(root, recursive, exts):
+    """Yield (index, relative_path, label) for every image under `root`.
 
-def list_image(root, recursive, exts):
-    i = 0
-    if recursive:
-        cat = {}
-        for path, dirs, files in os.walk(root, followlinks=True):
-            dirs.sort()
-            files.sort()
-            for fname in files:
-                fpath = os.path.join(path, fname)
-                suffix = os.path.splitext(fname)[1].lower()
-                if os.path.isfile(fpath) and (suffix in exts):
-                    if path not in cat:
-                        cat[path] = len(cat)
-                    yield (i, os.path.relpath(fpath, root), cat[path])
-                    i += 1
-        for k, v in sorted(cat.items(), key=lambda x: x[1]):
-            print(os.path.relpath(k, root), v)
-    else:
-        for fname in sorted(os.listdir(root)):
-            fpath = os.path.join(root, fname)
-            suffix = os.path.splitext(fname)[1].lower()
-            if os.path.isfile(fpath) and (suffix in exts):
-                yield (i, os.path.relpath(fpath, root), 0)
-                i += 1
+    Non-recursive mode labels everything 0; recursive mode assigns one
+    label per directory in sorted-walk order and prints the mapping.
+    """
+    root = Path(root)
+    want = {e.lower() for e in exts}
+
+    def is_image(p):
+        return p.is_file() and p.suffix.lower() in want
+
+    if not recursive:
+        flat = (p for p in sorted(root.iterdir()) if is_image(p))
+        yield from ((i, str(p.relative_to(root)), 0)
+                    for i, p in enumerate(flat))
+        return
+
+    label_of = {}
+    idx = 0
+    for cur, subdirs, names in os.walk(root, followlinks=True):
+        subdirs.sort()
+        for p in (Path(cur) / n for n in sorted(names)):
+            if not is_image(p):
+                continue
+            label = label_of.setdefault(cur, len(label_of))
+            yield idx, str(p.relative_to(root)), label
+            idx += 1
+    for d, label in sorted(label_of.items(), key=lambda kv: kv[1]):
+        print(os.path.relpath(d, root), label)
 
 
 def write_list(path_out, image_list):
+    """One .lst line per item: index <tab> label(s) <tab> relative path."""
     with open(path_out, "w") as fout:
-        for i, item in enumerate(image_list):
-            line = "%d\t" % item[0]
-            for j in item[2:]:
-                line += "%f\t" % j
-            line += "%s\n" % item[1]
-            fout.write(line)
+        fout.writelines(
+            "\t".join([str(item[0])]
+                      + ["%f" % lab for lab in item[2:]]
+                      + [item[1]]) + "\n"
+            for item in image_list)
 
 
 def make_list(args):
-    image_list = list(list_image(args.root, args.recursive, args.exts))
+    items = list(scan_images(args.root, args.recursive, args.exts))
     if args.shuffle:
-        random.seed(100)
-        random.shuffle(image_list)
-    N = len(image_list)
-    chunk_size = (N + args.chunks - 1) // args.chunks
-    for i in range(args.chunks):
-        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
-        if args.chunks > 1:
-            str_chunk = "_%d" % i
-        else:
-            str_chunk = ""
-        sep = int(chunk_size * args.train_ratio)
-        sep_test = int(chunk_size * args.test_ratio)
+        random.seed(100)  # reference-deterministic list order
+        random.shuffle(items)
+    per_chunk = (len(items) + args.chunks - 1) // args.chunks
+    for c in range(args.chunks):
+        chunk = items[c * per_chunk:(c + 1) * per_chunk]
+        tag = f"_{c}" if args.chunks > 1 else ""
         if args.train_ratio == 1.0:
-            write_list(args.prefix + str_chunk + ".lst", chunk)
-        else:
-            if args.test_ratio:
-                write_list(args.prefix + str_chunk + "_test.lst",
-                           chunk[:sep_test])
-            if args.train_ratio + args.test_ratio < 1.0:
-                write_list(args.prefix + str_chunk + "_val.lst",
-                           chunk[sep_test + sep:])
-            write_list(args.prefix + str_chunk + "_train.lst",
-                       chunk[sep_test:sep_test + sep])
+            write_list(f"{args.prefix}{tag}.lst", chunk)
+            continue
+        n_test = int(per_chunk * args.test_ratio)
+        n_train = int(per_chunk * args.train_ratio)
+        if args.test_ratio:
+            write_list(f"{args.prefix}{tag}_test.lst", chunk[:n_test])
+        write_list(f"{args.prefix}{tag}_train.lst",
+                   chunk[n_test:n_test + n_train])
+        if args.train_ratio + args.test_ratio < 1.0:
+            write_list(f"{args.prefix}{tag}_val.lst",
+                       chunk[n_test + n_train:])
 
 
 def read_list(path_in):
+    """Parse a .lst back into (index, relpath, label...) items, skipping
+    malformed lines with a diagnostic."""
     with open(path_in) as fin:
-        while True:
-            line = fin.readline()
-            if not line:
-                break
-            line = [i.strip() for i in line.strip().split("\t")]
-            line_len = len(line)
-            if line_len < 3:
-                print("lst should have at least has three parts, but only "
-                      "has %s parts for %s" % (line_len, line))
+        for line in fin:
+            cols = [c.strip() for c in line.strip().split("\t")]
+            if len(cols) < 3:
+                print(f"lst line needs >=3 tab-separated fields, got "
+                      f"{len(cols)}: {cols}")
                 continue
             try:
-                item = [int(line[0])] + [line[-1]] + \
-                    [float(i) for i in line[1:-1]]
-            except Exception as e:
-                print("Parsing lst met error for %s, detail: %s" % (line, e))
-                continue
-            yield item
+                yield [int(cols[0]), cols[-1],
+                       *map(float, cols[1:-1])]
+            except ValueError as e:
+                print(f"skipping unparsable lst line {cols}: {e}")
+
+
+def _square_crop(img):
+    h, w = img.shape[:2]
+    side = min(h, w)
+    y0 = (h - side) // 2
+    x0 = (w - side) // 2
+    return img[y0:y0 + side, x0:x0 + side]
+
+
+def _shorter_side_resize(cv2, img, target):
+    h, w = img.shape[:2]
+    if h > w:
+        new_wh = (target, h * target // w)
+    else:
+        new_wh = (w * target // h, target)
+    return cv2.resize(img, new_wh)
 
 
 def image_encode(args, i, item, q_out):
     import cv2
-    fullpath = os.path.join(args.root, item[1])
-    if len(item) > 3 and args.pack_label:
-        header = recordio.IRHeader(0, item[2:], item[0], 0)
-    else:
-        header = recordio.IRHeader(0, item[2], item[0], 0)
+    path = os.path.join(args.root, item[1])
+    labels = item[2:] if (args.pack_label and len(item) > 3) else item[2]
+    header = recordio.IRHeader(0, labels, item[0], 0)
     if args.pass_through:
-        with open(fullpath, "rb") as fin:
-            img = fin.read()
-        return recordio.pack(header, img)
-    img = cv2.imread(fullpath, args.color)
+        return recordio.pack(header, Path(path).read_bytes())
+    img = cv2.imread(path, args.color)
     if img is None:
-        print("imread read blank (None) image for file: %s" % fullpath)
+        print(f"imread read blank (None) image for file: {path}")
         return None
     if args.center_crop:
-        if img.shape[0] > img.shape[1]:
-            margin = (img.shape[0] - img.shape[1]) // 2
-            img = img[margin:margin + img.shape[1], :]
-        else:
-            margin = (img.shape[1] - img.shape[0]) // 2
-            img = img[:, margin:margin + img.shape[0]]
+        img = _square_crop(img)
     if args.resize:
-        if img.shape[0] > img.shape[1]:
-            newsize = (args.resize,
-                       img.shape[0] * args.resize // img.shape[1])
-        else:
-            newsize = (img.shape[1] * args.resize // img.shape[0],
-                       args.resize)
-        img = cv2.resize(img, newsize)
-    ret, buf = cv2.imencode(args.encoding, img,
-                            [cv2.IMWRITE_JPEG_QUALITY, args.quality])
-    assert ret, "failed to encode image"
+        img = _shorter_side_resize(cv2, img, args.resize)
+    ok, buf = cv2.imencode(args.encoding, img,
+                           [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+    assert ok, "failed to encode image"
     return recordio.pack(header, buf.tobytes())
 
 
 def im2rec(args, path_lst):
-    fname = os.path.basename(path_lst)
-    fname_rec = os.path.splitext(fname)[0] + ".rec"
-    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    stem = os.path.splitext(os.path.basename(path_lst))[0]
     out_dir = args.out_dir or os.path.dirname(path_lst)
+    rec_path = os.path.join(out_dir, stem + ".rec")
     record = recordio.MXIndexedRecordIO(
-        os.path.join(out_dir, fname_idx),
-        os.path.join(out_dir, fname_rec), "w")
+        os.path.join(out_dir, stem + ".idx"), rec_path, "w")
     items = list(read_list(path_lst))
     with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
         packed = pool.map(lambda it: image_encode(args, it[0], it, None),
@@ -150,7 +146,7 @@ def im2rec(args, path_lst):
             if s is not None:
                 record.write_idx(item[0], s)
     record.close()
-    print("wrote", os.path.join(out_dir, fname_rec))
+    print("wrote", rec_path)
 
 
 if __name__ == "__main__":
@@ -186,13 +182,10 @@ if __name__ == "__main__":
     if args.list:
         make_list(args)
     else:
-        if os.path.isdir(args.prefix):
-            working_dir = args.prefix
-        else:
-            working_dir = os.path.dirname(args.prefix)
-        files = [os.path.join(working_dir, fname)
-                 for fname in os.listdir(working_dir or ".")
-                 if os.path.isfile(os.path.join(working_dir, fname))]
-        for f in files:
-            if f.startswith(args.prefix) and f.endswith(".lst"):
-                im2rec(args, f)
+        prefix_dir = args.prefix if os.path.isdir(args.prefix) \
+            else os.path.dirname(args.prefix)
+        for name in os.listdir(prefix_dir or "."):
+            full = os.path.join(prefix_dir, name)
+            if os.path.isfile(full) and full.startswith(args.prefix) \
+                    and full.endswith(".lst"):
+                im2rec(args, full)
