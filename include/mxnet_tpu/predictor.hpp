@@ -22,19 +22,22 @@
 
 namespace mxnet_tpu {
 
+#ifndef MXNET_TPU_COMMON_DEFS_
+#define MXNET_TPU_COMMON_DEFS_
 class Error : public std::runtime_error {
  public:
   explicit Error(const std::string &what) : std::runtime_error(what) {}
 };
+
+/* Device selector matching the reference's DeviceType enum. */
+enum class Device : int { kCPU = 1, kTPU = 2 };
+#endif  // MXNET_TPU_COMMON_DEFS_
 
 inline void check(int rc, const char *op) {
   if (rc != 0) {
     throw Error(std::string(op) + ": " + MXGetLastError());
   }
 }
-
-/* Device selector matching the reference's DeviceType enum. */
-enum class Device : int { kCPU = 1, kTPU = 2 };
 
 class Predictor {
  public:

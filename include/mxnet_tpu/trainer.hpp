@@ -1,0 +1,175 @@
+/*
+ * Header-only C++ TRAINING frontend over the C train ABI (capability
+ * parity: cpp-package/include/mxnet-cpp/executor.h + optimizer.h — the
+ * reference's RAII C++ layer that drives Forward/Backward + optimizer
+ * updates from C++; here one Step() is the whole fused
+ * forward+backward+update dispatch).
+ *
+ * RAII + exceptions over MXTrain*: build from a symbol JSON, stage float
+ * batches, Step() to train, Forward()/GetOutput() to evaluate,
+ * SaveCheckpoint() to emit the standard two-artifact checkpoint that the
+ * predict ABI and the Python frontends load.  Link against
+ * libmxnet_tpu_ctrain.so and the embedded Python runtime (see
+ * examples/train-c/ for the link line).
+ */
+#ifndef MXNET_TPU_TRAINER_HPP_
+#define MXNET_TPU_TRAINER_HPP_
+
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_train_api.h"
+
+namespace mxnet_tpu {
+
+#ifndef MXNET_TPU_COMMON_DEFS_
+#define MXNET_TPU_COMMON_DEFS_
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+/* Device selector matching the reference's DeviceType enum. */
+enum class Device : int { kCPU = 1, kTPU = 2 };
+#endif  // MXNET_TPU_COMMON_DEFS_
+
+namespace detail {
+inline void train_check(int rc, const char *op) {
+  if (rc != 0) {
+    throw Error(std::string(op) + ": " + MXTrainGetLastError());
+  }
+}
+}  // namespace detail
+
+class Trainer {
+ public:
+  /* symbol_json: JSON text (or a path the Python side can read).
+   * input_shapes: {"data": {N, C, H, W}, "softmax_label": {N}, ...} —
+   * keys ending in "label" bind as labels.
+   * opt_params: numeric hyper-parameters for the registered optimizer
+   * ("learning_rate", "momentum", "wd", ...). */
+  Trainer(const std::string &symbol_json,
+          const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+          const std::string &optimizer = "sgd",
+          const std::map<std::string, mx_float> &opt_params = {},
+          Device dev = Device::kCPU, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> dims;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(dims.size()));
+      sizes_[kv.first] = std::accumulate(kv.second.begin(), kv.second.end(),
+                                         mx_uint{1},
+                                         [](mx_uint a, mx_uint b) {
+                                           return a * b;
+                                         });
+    }
+    std::vector<const char *> opt_keys;
+    std::vector<mx_float> opt_vals;
+    for (const auto &kv : opt_params) {
+      opt_keys.push_back(kv.first.c_str());
+      opt_vals.push_back(kv.second);
+    }
+    detail::train_check(
+        MXTrainCreate(symbol_json.c_str(), static_cast<int>(dev), dev_id,
+                      static_cast<mx_uint>(keys.size()), keys.data(),
+                      indptr.data(), dims.data(), optimizer.c_str(),
+                      static_cast<mx_uint>(opt_keys.size()),
+                      opt_keys.data(), opt_vals.data(), &handle_),
+        "MXTrainCreate");
+  }
+
+  Trainer(const Trainer &) = delete;
+  Trainer &operator=(const Trainer &) = delete;
+  Trainer(Trainer &&other) noexcept
+      : handle_(other.handle_), sizes_(std::move(other.sizes_)) {
+    other.handle_ = nullptr;
+  }
+  Trainer &operator=(Trainer &&other) noexcept {
+    if (this != &other) {
+      Release();
+      handle_ = other.handle_;
+      sizes_ = std::move(other.sizes_);
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+
+  ~Trainer() { Release(); }
+
+  /* Stage one input buffer (size must equal the declared shape's volume). */
+  void SetInput(const std::string &key, const std::vector<mx_float> &data) {
+    SetInput(key, data.data(), static_cast<mx_uint>(data.size()));
+  }
+  void SetInput(const std::string &key, const mx_float *data, mx_uint size) {
+    auto it = sizes_.find(key);
+    if (it != sizes_.end() && it->second != size) {
+      throw Error("SetInput(" + key + "): size " + std::to_string(size) +
+                  " != declared " + std::to_string(it->second));
+    }
+    detail::train_check(MXTrainSetInput(handle_, key.c_str(), data, size),
+                        "MXTrainSetInput");
+  }
+
+  /* One training step on the staged inputs: forward + backward + update
+   * (one fused device dispatch on the hot path). */
+  void Step() { detail::train_check(MXTrainStep(handle_), "MXTrainStep"); }
+
+  /* Inference forward on the staged inputs (labels may be omitted). */
+  void Forward() {
+    detail::train_check(MXTrainForward(handle_), "MXTrainForward");
+  }
+
+  /* Valid immediately after construction (bind-time inference) and after
+   * any Forward/Step. */
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) const {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    detail::train_check(
+        MXTrainGetOutputShape(handle_, index, &shape, &ndim),
+        "MXTrainGetOutputShape");
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index = 0) const {
+    auto shape = GetOutputShape(index);
+    mx_uint volume = 1;
+    for (mx_uint d : shape) volume *= d;
+    std::vector<mx_float> out(volume);
+    detail::train_check(
+        MXTrainGetOutput(handle_, index, out.data(), volume),
+        "MXTrainGetOutput");
+    return out;
+  }
+
+  /* prefix-symbol.json + prefix-%04d.params, loadable by Predictor and
+   * the Python frontends. */
+  void SaveCheckpoint(const std::string &prefix, int epoch = 0) {
+    detail::train_check(
+        MXTrainSaveCheckpoint(handle_, prefix.c_str(), epoch),
+        "MXTrainSaveCheckpoint");
+  }
+
+  TrainerHandle handle() const { return handle_; }
+
+ private:
+  void Release() {
+    if (handle_ != nullptr) {
+      MXTrainFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+
+  TrainerHandle handle_ = nullptr;
+  std::map<std::string, mx_uint> sizes_;
+};
+
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_TRAINER_HPP_

@@ -637,3 +637,42 @@ def test_engine_ops_appear_in_profiler_trace(tmp_path):
     assert "engine_decode_augment" in names, names
     assert "engine_device_upload" in names, names
     assert "engine" in cats
+
+
+def test_cpp_lenet_trains_through_header_frontend(tmp_path):
+    """Compile examples/train-c/lenet_train.cc — a CONV net driven through
+    the RAII mxnet_tpu::Trainer header class (trainer.hpp, the analog of
+    cpp-package/include/mxnet-cpp/executor.h + example/lenet.cpp) — and
+    let it train to >97%% as an external binary."""
+    import subprocess
+    from mxnet_tpu.io_native import get_ctrain_lib, _CTRAIN_PATH
+
+    if get_ctrain_lib() is None:
+        pytest.skip("C train library unavailable")
+
+    d = mx.sym.var("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        d, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1"),
+        act_type="relu")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Activation(mx.sym.Convolution(
+        p1, kernel=(3, 3), num_filter=16, pad=(1, 1), name="c2"),
+        act_type="relu")
+    p2 = mx.sym.Pooling(c2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = mx.sym.Activation(mx.sym.FullyConnected(
+        p2, num_hidden=64, name="f1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        f1, num_hidden=10, name="f2"), name="softmax")
+    sym_path = os.path.join(str(tmp_path), "lenet-symbol.json")
+    net.save(sym_path)
+
+    exe, env = _build_embed_binary(
+        tmp_path, os.path.join("examples", "train-c", "lenet_train.cc"),
+        "mxnet_tpu_ctrain", _CTRAIN_PATH, "lenet_train")
+    ckpt = os.path.join(str(tmp_path), "lenet")
+    run = subprocess.run([exe, sym_path, ckpt], capture_output=True,
+                         text=True, timeout=600, env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "TRAINED-OK" in run.stdout, run.stdout
+    assert os.path.exists(ckpt + "-symbol.json")
+    assert os.path.exists(ckpt + "-%04d.params" % 10)
