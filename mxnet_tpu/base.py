@@ -49,9 +49,34 @@ def maybe_initialize_distributed_from_env():
     from jax._src import distributed
     if distributed.global_state.client is not None:
         return
-    jax.distributed.initialize(coordinator_address=addr,
-                               num_processes=int(nproc),
-                               process_id=int(pid))
+    initialize_distributed_with_retry(addr, int(nproc), int(pid))
+
+
+def initialize_distributed_with_retry(addr, nproc, pid, attempts=3,
+                                      timeout_s=300):
+    """jax.distributed.initialize with a bounded retry + backoff.
+
+    Under host contention the coordinator process can start seconds to
+    minutes after its workers; a transient connect failure (coordinator
+    not yet bound, or a stale port in TIME_WAIT) must not kill the worker
+    outright.  Non-transient failures (bad address) still raise after the
+    attempts are exhausted."""
+    import time
+    import jax
+    last = None
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=nproc,
+                process_id=pid, initialization_timeout=timeout_s)
+            return
+        except Exception as e:  # noqa: BLE001 — retried, then re-raised
+            last = e
+            _logger.warning(
+                "jax.distributed.initialize attempt %d/%d failed: %s",
+                attempt + 1, attempts, e)
+            time.sleep(2.0 * (attempt + 1))
+    raise last
 
 
 def get_env(name, default=None, typ=str):

@@ -9,8 +9,44 @@ matching the reference's cpu<->gpu consistency strategy (SURVEY.md §4.2).
 """
 import os
 
+import pytest
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# -- fast tier -------------------------------------------------------------
+# `pytest -m fast` is the <5-minute iteration tier (the full suite runs
+# ~40 min).  Modules here are the quick, broad-coverage ones; the heavy
+# sweeps (op sweep, consistency, models, parallel, dist-multiprocess) stay
+# full-suite only.
+_FAST_MODULES = {
+    "test_autograd", "test_fused_extra", "test_fused_optimizers",
+    "test_gluon_data", "test_io_metric_kvstore", "test_kvstore_ici",
+    "test_module", "test_ndarray", "test_namespaces", "test_optimizer",
+    "test_symbol", "test_elastic",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "fast: quick iteration tier (run with -m fast)")
+
+
+# long-running convergence tests inside otherwise-fast modules; they stay
+# in the full suite but out of the iteration tier
+_SLOW_WITHIN_FAST = {
+    "test_fused_dp_step_multi_device", "test_module_fit_learns",
+    "test_bf16_multi_precision_trains", "test_module_multi_device",
+    "test_reshape_preserves_f32_masters",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _FAST_MODULES \
+                and item.originalname not in _SLOW_WITHIN_FAST \
+                and item.name not in _SLOW_WITHIN_FAST:
+            item.add_marker(pytest.mark.fast)

@@ -14,13 +14,25 @@ import numpy as np
 import pytest
 
 _WORKER = r"""
-import os, sys
+import os, sys, time
 import numpy as np
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
-jax.distributed.initialize(
-    coordinator_address=sys.argv[1], num_processes=int(sys.argv[2]),
-    process_id=int(sys.argv[3]))
+# bounded retry + backoff: under host contention the coordinator can bind
+# late; a transient connect failure must not kill the worker outright
+last = None
+for attempt in range(3):
+    try:
+        jax.distributed.initialize(
+            coordinator_address=sys.argv[1], num_processes=int(sys.argv[2]),
+            process_id=int(sys.argv[3]), initialization_timeout=120)
+        last = None
+        break
+    except Exception as e:
+        last = e
+        time.sleep(2.0 * (attempt + 1))
+if last is not None:
+    raise last
 import mxnet_tpu as mx
 
 kv = mx.kv.create("dist_sync")
@@ -57,27 +69,25 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("nproc,local_devices", [(2, 1), (2, 4)])
-def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
-    """local_devices > 1 exercises the pod-like topology: several chips per
-    host, allreduce still counts each process's contribution once."""
+# failure signatures of the coordinator port being stolen between
+# _free_port()'s close and rank 0's bind (a real race when another suite
+# runs concurrently and opens ports) or of startup-skew connect loss —
+# worth a clean re-spawn on a fresh port rather than a flaky failure
+_TRANSIENT = ("Address already in use", "DEADLINE_EXCEEDED", "UNAVAILABLE",
+              "failed to connect", "Connection refused")
+
+
+def _spawn_workers(nproc, env):
     addr = "127.0.0.1:%d" % _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    if local_devices > 1:
-        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
-                            % local_devices)
-    procs = []
-    for rank in range(nproc):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER, addr, str(nproc), str(rank)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, addr, str(nproc), str(rank)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        for rank in range(nproc)]
     outs = []
-    deadline = 240
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=deadline)
+            out, _ = p.communicate(timeout=240)
             outs.append(out.decode())
         except subprocess.TimeoutExpired:
             # a worker hanging in a collective means its peer died: kill
@@ -91,9 +101,31 @@ def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
                     outs.append(leftover.decode())
                 except Exception:
                     outs.append("<no output captured>")
+            return procs, outs, True
+    return procs, outs, False
+
+
+@pytest.mark.parametrize("nproc,local_devices", [(2, 1), (2, 4)])
+def test_dist_sync_kvstore_multiprocess(tmp_path, nproc, local_devices):
+    """local_devices > 1 exercises the pod-like topology: several chips per
+    host, allreduce still counts each process's contribution once."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    if local_devices > 1:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                            % local_devices)
+    for attempt in range(3):
+        procs, outs, timed_out = _spawn_workers(nproc, env)
+        transient = timed_out or any(
+            p.returncode != 0 and any(s in out for s in _TRANSIENT)
+            for p, out in zip(procs, outs))
+        if transient and attempt < 2:
+            continue  # fresh port, clean respawn
+        if timed_out:
             raise AssertionError(
                 "worker timed out; all worker outputs:\n" +
                 "\n=====\n".join(outs))
+        break
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out)
         assert "WORKER_OK" in out, out
