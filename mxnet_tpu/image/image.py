@@ -34,9 +34,54 @@ def _cv2():
     return cv2
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an image byte buffer to an NDArray, HWC uint8
-    (ref: image.py:imdecode — RGB order by default, unlike raw cv2)."""
+# -- augmenter RNG ----------------------------------------------------------
+# Augmentation draws go through these accessors so a parallel decode worker
+# can install a PER-RECORD deterministic RNG on its own thread
+# (io.EnginePipelineIter seeds one per sample index): decode order across
+# threads then cannot change the augmentation a given record receives.
+# Without an installed RNG the process-global generators are used, matching
+# the reference's single-threaded python path.
+import threading as _threading
+
+_aug_tls = _threading.local()
+
+
+def _rand():
+    return getattr(_aug_tls, "rng", None) or random
+
+
+def _nprand():
+    return getattr(_aug_tls, "nprng", None) or np.random
+
+
+def seed_augmenter_rng(seed):
+    """Install (seed is not None) or clear (None) this thread's augmenter
+    RNG.  Used by parallel decode pipelines for per-record determinism."""
+    if seed is None:
+        _aug_tls.rng = None
+        _aug_tls.nprng = None
+    else:
+        _aug_tls.rng = random.Random(seed)
+        _aug_tls.nprng = np.random.RandomState(seed & 0x7FFFFFFF)
+
+
+def _augs_all_builtin(augs):
+    """True when every augmenter (including those nested in Sequential/
+    RandomOrder) is from this module — i.e. type-preserving, safe for the
+    all-numpy fast path.  User-supplied augmenters keep the historical
+    NDArray input contract."""
+    for a in augs:
+        if a.__class__.__module__ != __name__:
+            return False
+        if isinstance(a, (SequentialAug, RandomOrderAug)) \
+                and not _augs_all_builtin(a.ts):
+            return False
+    return True
+
+
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    """Decode to a HWC uint8 numpy array — the fast host path (no device
+    round-trip; nd_array would place the image on the default backend)."""
     cv2 = _cv2()
     if isinstance(buf, NDArray):
         buf = buf.asnumpy().astype(np.uint8)
@@ -47,7 +92,13 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
     if img.ndim == 2:
         img = img[:, :, None]
-    return nd_array(img, dtype=np.uint8)
+    return img
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an NDArray, HWC uint8
+    (ref: image.py:imdecode — RGB order by default, unlike raw cv2)."""
+    return nd_array(_imdecode_np(buf, flag, to_rgb), dtype=np.uint8)
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -56,12 +107,17 @@ def imread(filename, flag=1, to_rgb=True):
 
 
 def imresize(src, w, h, interp=2):
+    """Type-preserving resize: numpy in -> numpy out (the fast host decode
+    path runs the whole augmentation chain in numpy — per-image NDArray
+    ops would dispatch through jax and serialize on the GIL), NDArray in
+    -> NDArray out (public API)."""
     cv2 = _cv2()
-    img = src.asnumpy() if isinstance(src, NDArray) else src
+    was_nd = isinstance(src, NDArray)
+    img = src.asnumpy() if was_nd else src
     out = cv2.resize(img, (w, h), interpolation=interp)
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd_array(out, dtype=img.dtype)
+    return nd_array(out, dtype=img.dtype) if was_nd else out
 
 
 def scale_down(src_size, size):
@@ -93,8 +149,8 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
 def random_crop(src, size, interp=2):
     h, w = src.shape[:2]
     new_w, new_h = scale_down((w, h), size)
-    x0 = random.randint(0, w - new_w)
-    y0 = random.randint(0, h - new_h)
+    x0 = _rand().randint(0, w - new_w)
+    y0 = _rand().randint(0, h - new_h)
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
 
@@ -120,15 +176,15 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
     h, w = src.shape[:2]
     area = h * w
     for _ in range(10):
-        target_area = random.uniform(min_area, 1.0) * area
-        new_ratio = random.uniform(*ratio)
+        target_area = _rand().uniform(min_area, 1.0) * area
+        new_ratio = _rand().uniform(*ratio)
         new_w = int(round(np.sqrt(target_area * new_ratio)))
         new_h = int(round(np.sqrt(target_area / new_ratio)))
-        if random.random() < 0.5:
+        if _rand().random() < 0.5:
             new_h, new_w = new_w, new_h
         if new_w <= w and new_h <= h:
-            x0 = random.randint(0, w - new_w)
-            y0 = random.randint(0, h - new_h)
+            x0 = _rand().randint(0, w - new_w)
+            y0 = _rand().randint(0, h - new_h)
             out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
             return out, (x0, y0, new_w, new_h)
     return center_crop(src, size, interp)
@@ -166,7 +222,7 @@ class RandomOrderAug(Augmenter):
 
     def __call__(self, src):
         ts = list(self.ts)
-        random.shuffle(ts)
+        _rand().shuffle(ts)
         for t in ts:
             src = t(src)
         return src
@@ -232,7 +288,7 @@ class BrightnessJitterAug(Augmenter):
         self.brightness = brightness
 
     def __call__(self, src):
-        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        alpha = 1.0 + _rand().uniform(-self.brightness, self.brightness)
         return src * alpha
 
 
@@ -244,7 +300,7 @@ class ContrastJitterAug(Augmenter):
         self.contrast = contrast
 
     def __call__(self, src):
-        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        alpha = 1.0 + _rand().uniform(-self.contrast, self.contrast)
         arr = src.asnumpy() if isinstance(src, NDArray) else src
         gray = (arr * self._coef).sum()
         gray = (3.0 * (1.0 - alpha) / arr.size) * gray
@@ -259,10 +315,11 @@ class SaturationJitterAug(Augmenter):
         self.saturation = saturation
 
     def __call__(self, src):
-        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
-        arr = src.asnumpy() if isinstance(src, NDArray) else src
-        gray = (arr * self._coef).sum(axis=2, keepdims=True)
-        return src * alpha + nd_array(gray * (1.0 - alpha))
+        alpha = 1.0 + _rand().uniform(-self.saturation, self.saturation)
+        was_nd = isinstance(src, NDArray)
+        arr = src.asnumpy() if was_nd else src
+        gray = (arr * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return src * alpha + (nd_array(gray) if was_nd else gray)
 
 
 class HueJitterAug(Augmenter):
@@ -277,14 +334,16 @@ class HueJitterAug(Augmenter):
                                [1.0, -1.107, 1.705]], np.float32)
 
     def __call__(self, src):
-        alpha = random.uniform(-self.hue, self.hue)
+        alpha = _rand().uniform(-self.hue, self.hue)
         u = np.cos(alpha * np.pi)
         w = np.sin(alpha * np.pi)
         bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
                       np.float32)
         t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
-        arr = src.asnumpy() if isinstance(src, NDArray) else src
-        return nd_array(np.dot(arr, t))
+        was_nd = isinstance(src, NDArray)
+        arr = src.asnumpy() if was_nd else src
+        out = np.dot(arr, t)
+        return nd_array(out) if was_nd else out
 
 
 class ColorJitterAug(RandomOrderAug):
@@ -309,21 +368,26 @@ class LightingAug(Augmenter):
         self.eigvec = eigvec
 
     def __call__(self, src):
-        alpha = np.random.normal(0, self.alphastd, size=(3,))
-        rgb = np.dot(self.eigvec * alpha, self.eigval)
-        return src + nd_array(rgb.astype(np.float32))
+        alpha = _nprand().normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval).astype(np.float32)
+        return src + (nd_array(rgb) if isinstance(src, NDArray) else rgb)
 
 
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=mean, std=std)
-        self.mean = nd_array(mean) if mean is not None \
-            and not isinstance(mean, NDArray) else mean
-        self.std = nd_array(std) if std is not None \
-            and not isinstance(std, NDArray) else std
+        # keep numpy copies: the host decode path is all-numpy, the
+        # NDArray path converts on demand
+        self.mean = mean.asnumpy() if isinstance(mean, NDArray) else mean
+        self.std = std.asnumpy() if isinstance(std, NDArray) else std
 
     def __call__(self, src):
-        return color_normalize(src, self.mean, self.std)
+        if isinstance(src, NDArray):
+            mean = nd_array(self.mean) if self.mean is not None else None
+            std = nd_array(self.std) if self.std is not None else None
+            return color_normalize(src, mean, std)
+        out = src.astype(np.float32, copy=False)
+        return color_normalize(out, self.mean, self.std)
 
 
 class RandomGrayAug(Augmenter):
@@ -335,9 +399,11 @@ class RandomGrayAug(Augmenter):
                              [0.07, 0.07, 0.07]], np.float32)
 
     def __call__(self, src):
-        if random.random() < self.p:
-            arr = src.asnumpy() if isinstance(src, NDArray) else src
-            src = nd_array(np.dot(arr, self.mat))
+        if _rand().random() < self.p:
+            was_nd = isinstance(src, NDArray)
+            arr = src.asnumpy() if was_nd else src
+            out = np.dot(arr, self.mat)
+            src = nd_array(out) if was_nd else out
         return src
 
 
@@ -347,9 +413,11 @@ class HorizontalFlipAug(Augmenter):
         self.p = p
 
     def __call__(self, src):
-        if random.random() < self.p:
-            arr = src.asnumpy() if isinstance(src, NDArray) else src
-            src = nd_array(arr[:, ::-1].copy())
+        if _rand().random() < self.p:
+            was_nd = isinstance(src, NDArray)
+            arr = src.asnumpy() if was_nd else src
+            out = arr[:, ::-1]
+            src = nd_array(out.copy()) if was_nd else np.ascontiguousarray(out)
         return src
 
 
@@ -474,6 +542,7 @@ class ImageIter(DataIter):
             self.auglist = CreateAugmenter(data_shape, **kwargs)
         else:
             self.auglist = aug_list
+        self._all_builtin_augs = _augs_all_builtin(self.auglist)
         self.cur = 0
         self.reset()
 
@@ -512,7 +581,10 @@ class ImageIter(DataIter):
         try:
             while i < batch_size:
                 label, s = self.next_sample()
-                data = self.imdecode(s)
+                # builtin augmenters are type-preserving: all-numpy fast
+                # path; user augmenters keep the NDArray input contract
+                data = self.imdecode_np(s) if self._all_builtin_augs \
+                    else self.imdecode(s)
                 data = self.augmentation_transform(data)
                 arr = data.asnumpy() if isinstance(data, NDArray) else data
                 batch_data[i] = arr
@@ -535,6 +607,16 @@ class ImageIter(DataIter):
 
     def imdecode(self, s):
         return imdecode(s)
+
+    def imdecode_np(self, s):
+        """Numpy decode for the host batching path (augmenters are
+        type-preserving, so the whole per-image chain stays in numpy — no
+        per-image device round-trips).  A subclass overriding imdecode()
+        is honored through the NDArray route."""
+        if type(self).imdecode is not ImageIter.imdecode:
+            data = self.imdecode(s)
+            return data.asnumpy() if isinstance(data, NDArray) else data
+        return _imdecode_np(s)
 
     def read_image(self, fname):
         path = os.path.join(self.path_root, fname) if self.path_root \

@@ -618,16 +618,14 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
     if preprocess_threads and int(preprocess_threads) > 0:
         # the reference's preprocess_threads knob (iter_image_recordio_2.cc
         # decode thread pool) maps onto the native dependency engine:
-        # decode/augment and device upload become engine ops (see
-        # EnginePipelineIter).  Decode ops serialize on the iterator var
-        # (augmenter RNG is single-threaded state), so >2 workers buys
-        # nothing — cap the pool.  NOTE: with a seed, augmentation draws
-        # now run on engine threads; same-seed runs stay reproducible only
-        # if the main thread does not use the global RNGs mid-epoch.
+        # a serialized record-read op fans out to preprocess_threads
+        # concurrent decode/augment ops (per-record-index RNG keeps
+        # augmentation deterministic across thread interleavings), then an
+        # assemble+upload op per batch slot — see EnginePipelineIter.
         try:
             return EnginePipelineIter(it, ctx=ctx,
-                                      num_workers=min(
-                                          2, int(preprocess_threads)))
+                                      num_workers=int(preprocess_threads),
+                                      seed=seed)
         except RuntimeError:
             pass  # no native engine: DevicePrefetchIter below still uploads
     if ctx is not None:
@@ -830,30 +828,63 @@ _DATA_ITER_REGISTRY = {
 
 
 class EnginePipelineIter(DataIter):
-    """Engine-scheduled input pipeline: decode/augment and device upload run
-    as NativeEngine ops with var dependencies.
+    """Engine-scheduled input pipeline: record read, decode/augment, and
+    device upload run as NativeEngine ops with var dependencies.
 
-    This is the host-side analog of the reference's threaded iterator
-    stack + FnProperty copy lanes (SURVEY.md §2.1/§2.4: dmlc ThreadedIter
-    prefetch feeding engine-ordered CopyFromCPU ops): `produce` ops pull
-    and preprocess batches (serialized on the iterator var — augmenter RNG
-    stays single-threaded), `upload` ops issue the host->device transfer,
-    and the training loop only ever waits on a ready slot.  Spans appear in
+    This is the host-side analog of the reference's ImageRecordIOParser2
+    pipeline (SURVEY.md §2.1/§2.4: dmlc ThreadedIter prefetch feeding a
+    decode THREAD POOL, iter_image_recordio_2.cc:50, then engine-ordered
+    CopyFromCPU ops).  With num_workers > 1 and a sample-capable base
+    iterator the stages are:
+
+      read op     (serialized on the iterator var) pulls a batch of raw
+                  records — cheap, order-defining;
+      decode ops  one per worker, each decoding a stride-W shard of the
+                  batch CONCURRENTLY.  Each record's augmentation draws
+                  come from a per-record-index RNG
+                  (image.seed_augmenter_rng), so the augmentation a record
+                  receives is a pure function of (seed, epoch, index) —
+                  identical whatever the thread interleaving;
+      assemble op (after every shard) builds the DataBatch and issues the
+                  host->device transfer.
+
+    The training loop only ever waits on a ready slot.  Spans appear in
     the profiler's Chrome trace under the "engine" category.
     """
 
-    def __init__(self, base, depth=2, ctx=None, num_workers=2, engine=None):
+    def __init__(self, base, depth=2, ctx=None, num_workers=2, engine=None,
+                 seed=None):
         from .io_native import NativeEngine
         super().__init__(base.batch_size)
         self._base = base
-        self._engine = engine or NativeEngine(num_workers)
+        # workers beyond cores+2 only thrash the scheduler (measured: a
+        # 1-core host collapses from 780 to 300 img/s at 4 workers)
+        cap = (os.cpu_count() or 2) + 2
+        self._n_workers = max(1, min(int(num_workers), cap))
+        # +1 thread so the serialized read op overlaps the decode shards
+        self._engine = engine or NativeEngine(self._n_workers + 1)
         self._ctx = ctx
         self._iter_var = self._engine.new_var()
+        # the staged (read -> decode -> assemble) pipeline engages for ANY
+        # worker count when the base supports sample access — also at
+        # num_workers=1, so the per-record-seed augmentation stream is the
+        # same whatever the worker count
+        self._parallel = (hasattr(base, "next_sample")
+                          and hasattr(base, "imdecode")
+                          and hasattr(base, "augmentation_transform")
+                          and hasattr(base, "data_shape"))
         self._slots = [{"var": self._engine.new_var(), "batch": None,
-                        "stop": False, "error": None}
+                        "stop": False, "error": None,
+                        "shard_vars": tuple(self._engine.new_var()
+                                            for _ in range(self._n_workers))
+                        if self._parallel else ()}
                        for _ in range(max(1, depth))]
         self._idx = 0
         self._armed = False
+        self._seed = int(seed) if seed is not None \
+            else int.from_bytes(os.urandom(4), "little")
+        self._epoch = 0
+        self._sample_idx = 0
 
     @property
     def provide_data(self):
@@ -864,6 +895,9 @@ class EnginePipelineIter(DataIter):
         return self._base.provide_label
 
     def _arm(self, slot):
+        if self._parallel:
+            self._arm_parallel(slot)
+            return
         from . import profiler as _profiler
 
         def produce():
@@ -898,6 +932,310 @@ class EnginePipelineIter(DataIter):
             # while the NEXT slot's produce overlaps (the copy-lane analog)
             self._engine.push(upload, mutable_vars=(slot["var"],))
 
+    def _record_seed(self, gidx):
+        """Per-record augmentation seed: a pure function of
+        (iterator seed, epoch, running sample index)."""
+        return ((self._seed * 1000003 + self._epoch * 7919)
+                ^ (gidx * 2654435761)) & 0x7FFFFFFF
+
+    def _augment_plan(self):
+        """Split the base augmenter list into a per-image geometry stage
+        and a batch-level arithmetic stage, preferring the NATIVE kernel.
+
+        Python's GIL is the scaling wall the reference never had (its
+        decode pool is C++, iter_image_recordio_2.cc:50): per-image Python
+        work serializes worker threads no matter how many run.  Three
+        tiers, best available wins:
+
+        1. native: the standard train chain (short-side resize ->
+           random/center crop -> flip -> mean/std normalize) runs as ONE
+           C call per worker shard (src/image_decode.cc) writing f32 CHW
+           straight into the batch buffer — the GIL is released for the
+           whole shard and workers scale like the reference's pool;
+        2. geometry-only python: cv2 stages (which release the GIL)
+           per image, normalize ONCE per batch as contiguous ufuncs;
+        3. generic: any exotic augmenter list, per image.
+
+        Returns a dict plan or None (generic)."""
+        from .image import image as _im
+        augs = list(getattr(self._base, "auglist", ()))
+        mean = std = None
+        while augs and isinstance(augs[-1], (_im.CastAug,
+                                             _im.ColorNormalizeAug)):
+            a = augs.pop()
+            if isinstance(a, _im.ColorNormalizeAug):
+                mean, std = a.mean, a.std
+        geom = (_im.ResizeAug, _im.ForceResizeAug, _im.RandomCropAug,
+                _im.CenterCropAug, _im.RandomSizedCropAug,
+                _im.HorizontalFlipAug)
+        if not all(isinstance(a, geom) for a in augs):
+            return None
+        plan = {"geom": augs, "mean": mean, "std": std, "native": False,
+                "seq": None}
+        # seq eligibility: 3-channel, and the aug sequence is at most
+        # resize? -> one crop? -> flip?.  seq-able chains draw their
+        # randomness as u01 triples from the per-record RNG, so the python
+        # and native implementations of the SAME seq produce the SAME
+        # stream — augmentation must not depend on whether the native
+        # kernel compiled on this host.
+        c = self._base.data_shape[0]
+        seq = {"resize": 0, "interp": 2, "crop_mode": 0, "flip_p": -1.0}
+        stage = 0  # 0: expect resize/crop/flip, advance monotonically
+        ok = c == 3
+        for a in augs:
+            if isinstance(a, _im.ResizeAug) and stage == 0:
+                seq["resize"], seq["interp"] = int(a.size), int(a.interp)
+                stage = 1
+            elif isinstance(a, _im.RandomCropAug) and stage <= 1:
+                seq["crop_mode"], seq["interp"] = 1, int(a.interp)
+                stage = 2
+            elif isinstance(a, _im.CenterCropAug) and stage <= 1:
+                seq["crop_mode"], seq["interp"] = 2, int(a.interp)
+                stage = 2
+            elif isinstance(a, _im.HorizontalFlipAug) and stage <= 2:
+                seq["flip_p"] = float(a.p)
+                stage = 3
+            else:
+                ok = False
+                break
+        if ok:
+            plan["seq"] = seq
+            from .io_native import get_imgdec_lib
+            plan["native"] = get_imgdec_lib() is not None
+        return plan
+
+    def _arm_parallel(self, slot):
+        from . import profiler as _profiler
+        base = self._base
+        W = self._n_workers
+        B = self.batch_size
+        c, h, w = base.data_shape
+        lw = getattr(base, "label_width", 1)
+        plan = getattr(self, "_plan_cache", "unset")
+        if plan == "unset":
+            plan = self._plan_cache = self._augment_plan()
+
+        def read():
+            try:
+                with _profiler.record_span("engine_read",
+                                           category="engine"):
+                    raw = []
+                    try:
+                        while len(raw) < B:
+                            label, s = base.next_sample()
+                            raw.append((label, s, self._sample_idx))
+                            self._sample_idx += 1
+                    except StopIteration:
+                        pass
+                slot["raw"] = raw
+                slot["pad"] = B - len(raw)
+                slot["stop"] = not raw
+                if raw:
+                    # geometry stage emits uint8 CHW per image (the
+                    # per-image transpose is a 150KB cache-friendly copy
+                    # done on the PARALLEL workers; a batch-level NHWC->
+                    # NCHW transpose would be one giant strided copy in
+                    # the serial assemble); batch stage casts+normalizes
+                    # in contiguous passes.  The native kernel writes
+                    # normalized f32 directly (see _augment_plan).
+                    if plan and plan["native"]:
+                        dt = np.float32  # native writes normalized f32
+                    elif plan:
+                        dt = np.uint8    # batch stage casts+normalizes
+                    else:
+                        dt = np.float32
+                    slot["data"] = np.zeros((B, c, h, w), dt)
+                    slot["label"] = np.zeros(
+                        (B, lw) if lw > 1 else (B,), np.float32)
+            except Exception as e:  # surfaced on the consumer thread
+                slot["error"] = e
+
+        # the read op serializes on the iterator var (stream position is
+        # the only single-threaded state left); decode fans out after it
+        self._engine.push(read, mutable_vars=(self._iter_var, slot["var"]))
+
+        def _u01(gidx):
+            """The seq tiers' randomness: three uniforms per record (crop
+            x, crop y, flip), identical for the python and native
+            implementations."""
+            import random as _pyrandom
+            rng = _pyrandom.Random(self._record_seed(gidx))
+            return rng.random(), rng.random(), rng.random()
+
+        def decode_seq_py(lo, hi):
+            """Python implementation of the seq plan — consumes the SAME
+            u01 draws as the native kernel, emits u8 CHW (cv2 stages
+            release the GIL; normalize runs batch-level in assemble)."""
+            from .image import image as _im
+            seq = plan["seq"]
+            raw = slot["raw"]
+            for j in range(lo, hi):
+                label, s, gidx = raw[j]
+                ux, uy, uflip = _u01(gidx)
+                img = base.imdecode_np(s) if hasattr(base, "imdecode_np") \
+                    else base.imdecode(s).asnumpy()
+                if seq["resize"]:
+                    img = _im.resize_short(img, seq["resize"],
+                                           seq["interp"])
+                ih, iw = img.shape[:2]
+                if seq["crop_mode"]:
+                    cw, ch = _im.scale_down((iw, ih), (w, h))
+                    if seq["crop_mode"] == 1:
+                        x0 = min(int(ux * (iw - cw + 1)), iw - cw)
+                        y0 = min(int(uy * (ih - ch + 1)), ih - ch)
+                    else:
+                        x0, y0 = (iw - cw) // 2, (ih - ch) // 2
+                    img = img[y0:y0 + ch, x0:x0 + cw]
+                    if (cw, ch) != (w, h):
+                        img = _im.imresize(img, w, h, seq["interp"])
+                elif (ih, iw) != (h, w):
+                    img = _im.imresize(img, w, h, seq["interp"])
+                if seq["flip_p"] >= 0 and uflip < seq["flip_p"]:
+                    img = img[:, ::-1]
+                slot["data"][j] = img.transpose(2, 0, 1)
+                slot["label"][j] = label
+
+        def decode_native(lo, hi):
+            """One C call for the contiguous shard [lo, hi): decode +
+            geometry + normalize into the f32 CHW batch buffer, GIL-free
+            for the whole span."""
+            import ctypes
+            from .base import MXNetError
+            from .io_native import get_imgdec_lib
+            lib = get_imgdec_lib()
+            seq = plan["seq"]
+            raw = slot["raw"]
+            n = hi - lo
+            bufs = (ctypes.c_void_p * n)()
+            lens = (ctypes.c_int64 * n)()
+            keep = []
+            u01 = np.empty((n, 3), np.float32)
+            for t in range(n):
+                label, s, gidx = raw[lo + t]
+                b = s if isinstance(s, bytes) else bytes(s)
+                keep.append(b)
+                bufs[t] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                lens[t] = len(b)
+                u01[t] = _u01(gidx)
+                slot["label"][lo + t] = label
+            f32p = ctypes.POINTER(ctypes.c_float)
+
+            def fp(a):
+                return a.ctypes.data_as(f32p) if a is not None else None
+
+            mean = np.ascontiguousarray(plan["mean"], np.float32).reshape(-1) \
+                if plan["mean"] is not None else None
+            std = np.ascontiguousarray(plan["std"], np.float32).reshape(-1) \
+                if plan["std"] is not None else None
+            out = slot["data"][lo:hi]  # contiguous f32 view
+            err = ctypes.create_string_buffer(256)
+            rc = lib.img_decode_chain(
+                bufs, lens, n, seq["resize"], seq["interp"],
+                seq["crop_mode"], fp(u01), seq["flip_p"], h, w,
+                fp(mean), fp(std), out.ctypes.data_as(f32p), err, 256)
+            if rc != 0:
+                raise MXNetError("native decode failed: %s"
+                                 % err.value.decode())
+
+        def make_decode(k):
+            def decode():
+                from .image import image as _image
+                try:
+                    raw = slot.get("raw") or ()
+                    if slot["error"] is not None or not raw:
+                        return
+                    chunk = (len(raw) + W - 1) // W
+                    lo = min(k * chunk, len(raw))
+                    hi = min(lo + chunk, len(raw))
+                    if lo == hi:
+                        return
+                    with _profiler.record_span("engine_decode_augment",
+                                               category="engine"):
+                        if plan and plan["seq"]:
+                            if plan["native"]:
+                                decode_native(lo, hi)
+                            else:
+                                decode_seq_py(lo, hi)
+                            return
+                        for j in range(lo, hi):
+                            label, s, gidx = raw[j]
+                            _image.seed_augmenter_rng(self._record_seed(gidx))
+                            if plan:
+                                # plannable but not seq-able (e.g. random-
+                                # sized crop): geometry augmenters per
+                                # image, normalize batch-level
+                                data = base.imdecode_np(s) if hasattr(
+                                    base, "imdecode_np") \
+                                    else base.imdecode(s).asnumpy()
+                                for a in plan["geom"]:
+                                    data = a(data)
+                            else:
+                                # generic: full augmenter list per image;
+                                # numpy when every augmenter is builtin,
+                                # else the NDArray contract for
+                                # user-supplied augmenters
+                                if getattr(base, "_all_builtin_augs",
+                                           False) and \
+                                        hasattr(base, "imdecode_np"):
+                                    data = base.imdecode_np(s)
+                                else:
+                                    data = base.imdecode(s)
+                                data = base.augmentation_transform(data)
+                                if hasattr(data, "asnumpy"):
+                                    data = data.asnumpy()
+                            slot["data"][j] = data.transpose(2, 0, 1)
+                            slot["label"][j] = label
+                except Exception as e:
+                    slot["error"] = e
+            return decode
+
+        for k in range(W):
+            self._engine.push(make_decode(k), const_vars=(slot["var"],),
+                              mutable_vars=(slot["shard_vars"][k],))
+
+        dev = self._ctx.jax_device() if self._ctx is not None else None
+
+        def assemble():
+            if slot["error"] is not None or slot.get("stop") or \
+                    slot.get("raw") is None:
+                return
+            try:
+                with _profiler.record_span("engine_device_upload",
+                                           category="engine"):
+                    from .context import cpu as _cpu
+                    from .ndarray import array as nd_array
+                    data = slot["data"]  # already CHW
+                    if plan and not plan["native"]:
+                        # contiguous whole-batch passes: u8 -> f32
+                        # (+ mean/std) — big single ufuncs instead of
+                        # per-image numpy under the GIL (the native
+                        # kernel already wrote normalized f32)
+                        mean, std = plan["mean"], plan["std"]
+                        if mean is not None:
+                            data = np.subtract(
+                                data, np.asarray(mean, np.float32)
+                                .reshape(1, -1, 1, 1), dtype=np.float32)
+                        else:
+                            data = data.astype(np.float32)
+                        if std is not None:
+                            data /= np.asarray(std, np.float32) \
+                                .reshape(1, -1, 1, 1)
+                    # batches are CPU-resident (reference iterator
+                    # contract); the consumer/train loop owns the upload
+                    batch = DataBatch(
+                        [nd_array(data, ctx=_cpu(0))],
+                        [nd_array(slot["label"], ctx=_cpu(0))],
+                        pad=slot["pad"])
+                    if dev is not None:
+                        batch = _upload_batch(batch, dev)
+                    slot["batch"] = batch
+                    slot["raw"] = slot["data"] = slot["label"] = None
+            except Exception as e:
+                slot["error"] = e
+
+        self._engine.push(assemble, const_vars=slot["shard_vars"],
+                          mutable_vars=(slot["var"],))
+
     def _arm_all(self):
         for s in self._slots:
             s["batch"], s["stop"], s["error"] = None, False, None
@@ -930,3 +1268,5 @@ class EnginePipelineIter(DataIter):
         self._base.reset()
         self._armed = False
         self._idx = 0
+        self._epoch += 1
+        self._sample_idx = 0

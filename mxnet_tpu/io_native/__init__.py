@@ -193,6 +193,8 @@ class NativeEngine:
     """Host-side dependency engine (ref semantics: Engine::Push/WaitForVar/
     WaitForAll, include/mxnet/engine.h:96-291)."""
 
+    _live = None  # weak set of engines, closed via atexit (see below)
+
     def __init__(self, num_workers=2):
         lib = get_lib()
         if lib is None:
@@ -202,6 +204,24 @@ class NativeEngine:
         self._keep = {}  # op id -> callback keepalive
         self._next = 0
         self._cb_lock = threading.Lock()
+        # engines destroyed during interpreter finalization deadlock: the
+        # C++ destructor joins worker threads whose Python callbacks can no
+        # longer acquire the GIL.  Close every live engine from atexit
+        # (before finalization) instead of relying on gc-at-shutdown.
+        if NativeEngine._live is None:
+            import atexit
+            import weakref
+            NativeEngine._live = weakref.WeakSet()
+            atexit.register(NativeEngine._close_all)
+        NativeEngine._live.add(self)
+
+    @classmethod
+    def _close_all(cls):
+        for eng in list(cls._live or ()):
+            try:
+                eng.close()
+            except Exception:
+                pass
 
     def new_var(self):
         return self._lib.engine_new_var(self._h)
@@ -236,6 +256,13 @@ class NativeEngine:
 
     def close(self):
         if self._h:
+            import sys
+            if sys.is_finalizing():
+                # too late to join threads running Python callbacks; the
+                # process is exiting — leak the handle instead of
+                # deadlocking in the destructor
+                self._h = None
+                return
             self._lib.engine_destroy(self._h)
             self._h = None
 
@@ -384,3 +411,51 @@ def _declare_ctrain(lib):
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.MXTrainFree.restype = ctypes.c_int
     lib.MXTrainFree.argtypes = [ctypes.c_void_p]
+
+
+# ---------------------------------------------------------------------------
+# Native image decode+augment kernel (src/image_decode.cc) — separate .so
+# because it links OpenCV; consumers fall back to the python path when the
+# toolchain or OpenCV dev headers are unavailable
+# ---------------------------------------------------------------------------
+
+_IMGDEC_PATH = os.path.join(os.path.dirname(__file__),
+                            "libmxnet_tpu_imgdec.so")
+_imgdec_lib = None
+_imgdec_tried = False
+
+
+def get_imgdec_lib():
+    global _imgdec_lib, _imgdec_tried
+    with _lock:
+        if _imgdec_lib is not None or _imgdec_tried:
+            return _imgdec_lib
+        _imgdec_tried = True
+        src = os.path.join(_SRC_DIR, "image_decode.cc")
+        try:
+            if not os.path.exists(_IMGDEC_PATH) or (
+                    os.path.exists(src) and os.path.getmtime(src)
+                    > os.path.getmtime(_IMGDEC_PATH)):
+                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                       "-I/usr/include/opencv4", "-o", _IMGDEC_PATH, src,
+                       "-lopencv_core", "-lopencv_imgcodecs",
+                       "-lopencv_imgproc"]
+                subprocess.run(cmd, check=True, capture_output=True)
+            lib = ctypes.CDLL(_IMGDEC_PATH)
+            u8pp = ctypes.POINTER(ctypes.c_void_p)
+            f32p = ctypes.POINTER(ctypes.c_float)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.img_decode_chain.restype = ctypes.c_int
+            lib.img_decode_chain.argtypes = [
+                u8pp, i64p, ctypes.c_int,            # bufs, lens, n
+                ctypes.c_int, ctypes.c_int,          # resize_short, interp
+                ctypes.c_int,                        # crop_mode
+                f32p, ctypes.c_float,                # u01, flip_p
+                ctypes.c_int, ctypes.c_int,          # out_h, out_w
+                f32p, f32p,                          # mean, std
+                f32p,                                # out
+                ctypes.c_char_p, ctypes.c_int]       # err, errlen
+            _imgdec_lib = lib
+        except Exception:
+            _imgdec_lib = None
+        return _imgdec_lib

@@ -1,0 +1,139 @@
+// Native image decode + augment kernel for the input pipeline.
+//
+// TPU-native rebuild of the reference's decode thread pool + default
+// augmenter (src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2 and
+// src/io/image_aug_default.cc): jpeg decode, short-side resize, random/
+// center crop, horizontal flip, mean/std normalize straight into the f32
+// CHW batch buffer.  One C call handles a whole worker shard so the
+// Python engine op releases the GIL for the entire decode — CPython
+// threads cannot scale per-image Python work (the GIL), which is exactly
+// why the reference keeps this stage in C++.
+//
+// Randomness comes in as precomputed u01 draws per record (derived from
+// the per-record seed on the Python side), keeping augmentation a pure
+// function of (seed, record index) regardless of thread interleaving.
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <opencv2/core.hpp>
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+namespace {
+
+// the engine supplies the worker parallelism; OpenCV's own pool nested
+// under it just oversubscribes the host (catastrophically on small hosts)
+const bool kCvSingleThread = [] {
+  cv::setNumThreads(0);
+  return true;
+}();
+
+void set_err(char* err, int errlen, const char* msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, errlen, "%s", msg);
+  }
+}
+
+// python image.scale_down: shrink the crop target to fit the image
+void scale_down(int sw, int sh, int* w, int* h) {
+  double fw = *w, fh = *h;
+  if (sh < fh) {
+    fw = fw * sh / fh;
+    fh = sh;
+  }
+  if (sw < fw) {
+    fh = fh * sw / fw;
+    fw = sw;
+  }
+  *w = static_cast<int>(fw);
+  *h = static_cast<int>(fh);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode records [0, n) from bufs/lens and write f32 CHW rows into out.
+// resize_short: 0 = skip; crop_mode: 0 none, 1 random, 2 center.
+// u01: n*3 uniform draws (ux, uy, uflip) per record.
+// flip_p < 0 disables the flip stage.  mean/std: length-3 or null.
+// interp: OpenCV interpolation code (same ints as the python layer).
+// Returns 0, or -1 with a message in err.
+int img_decode_chain(const uint8_t* const* bufs, const int64_t* lens,
+                     int n, int resize_short, int interp, int crop_mode,
+                     const float* u01, float flip_p, int out_h, int out_w,
+                     const float* mean, const float* stdv, float* out,
+                     char* err, int errlen) {
+  for (int i = 0; i < n; ++i) {
+    cv::Mat raw(1, static_cast<int>(lens[i]), CV_8U,
+                const_cast<uint8_t*>(bufs[i]));
+    cv::Mat img = cv::imdecode(raw, cv::IMREAD_COLOR);
+    if (img.empty()) {
+      set_err(err, errlen, "invalid image data");
+      return -1;
+    }
+    cv::cvtColor(img, img, cv::COLOR_BGR2RGB);
+
+    if (resize_short > 0) {
+      int h = img.rows, w = img.cols, nh, nw;
+      if (h > w) {
+        nh = static_cast<int>(static_cast<int64_t>(resize_short) * h / w);
+        nw = resize_short;
+      } else {
+        nh = resize_short;
+        nw = static_cast<int>(static_cast<int64_t>(resize_short) * w / h);
+      }
+      cv::resize(img, img, cv::Size(nw, nh), 0, 0, interp);
+    }
+
+    if (crop_mode != 0) {
+      int cw = out_w, ch = out_h;
+      scale_down(img.cols, img.rows, &cw, &ch);
+      int x0, y0;
+      if (crop_mode == 1) {
+        // randint(0, w-cw) inclusive from the u01 draw
+        x0 = static_cast<int>(u01[i * 3 + 0] * (img.cols - cw + 1));
+        y0 = static_cast<int>(u01[i * 3 + 1] * (img.rows - ch + 1));
+        x0 = std::min(x0, img.cols - cw);
+        y0 = std::min(y0, img.rows - ch);
+      } else {
+        x0 = (img.cols - cw) / 2;
+        y0 = (img.rows - ch) / 2;
+      }
+      img = img(cv::Rect(x0, y0, cw, ch));
+      if (cw != out_w || ch != out_h) {
+        cv::resize(img, img, cv::Size(out_w, out_h), 0, 0, interp);
+      }
+    } else if (img.cols != out_w || img.rows != out_h) {
+      cv::resize(img, img, cv::Size(out_w, out_h), 0, 0, interp);
+    }
+
+    if (flip_p >= 0.0f && u01[i * 3 + 2] < flip_p) {
+      cv::flip(img, img, 1);
+    }
+
+    // split + convertTo lands each channel directly in the CHW output
+    // with the affine normalize fused ((x - mean)/std = x*a + b)
+    float* row = out + static_cast<int64_t>(i) * 3 * out_h * out_w;
+    cv::Mat planes[3];
+    cv::split(img, planes);
+    for (int c = 0; c < 3; ++c) {
+      double a = 1.0, b = 0.0;
+      if (stdv) {
+        a = 1.0 / stdv[c];
+      }
+      if (mean) {
+        b = -mean[c] * a;
+      }
+      cv::Mat dst(out_h, out_w, CV_32F,
+                  row + static_cast<int64_t>(c) * out_h * out_w);
+      planes[c].convertTo(dst, CV_32F, a, b);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
