@@ -377,15 +377,20 @@ class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=mean, std=std)
         # keep numpy copies: the host decode path is all-numpy, the
-        # NDArray path converts on demand
+        # NDArray path uses a lazily-built device copy (cached — this runs
+        # once per image in the pipeline hot loop)
         self.mean = mean.asnumpy() if isinstance(mean, NDArray) else mean
         self.std = std.asnumpy() if isinstance(std, NDArray) else std
+        self._nd_mean = None
+        self._nd_std = None
 
     def __call__(self, src):
         if isinstance(src, NDArray):
-            mean = nd_array(self.mean) if self.mean is not None else None
-            std = nd_array(self.std) if self.std is not None else None
-            return color_normalize(src, mean, std)
+            if self._nd_mean is None and self.mean is not None:
+                self._nd_mean = nd_array(self.mean)
+            if self._nd_std is None and self.std is not None:
+                self._nd_std = nd_array(self.std)
+            return color_normalize(src, self._nd_mean, self._nd_std)
         out = src.astype(np.float32, copy=False)
         return color_normalize(out, self.mean, self.std)
 

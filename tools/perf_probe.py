@@ -117,7 +117,16 @@ def run_fwd(exe, train_mode, tag, cast=None):
     report(tag, sec, flops)
 
 
-def run_train(exe, tag, compute_dtype=None, lr=0.01, momentum=0.9):
+def _conv_saveable(prim, *_, **__):
+    """Remat policy: keep only MXU-product tensors (conv/dot outputs) as
+    backward residuals; recompute the elementwise/BN chains between them.
+    On a bandwidth-bound step this trades spare MXU FLOPs for the HBM
+    store+reload of every BN/ReLU intermediate."""
+    return prim.name in ("conv_general_dilated", "dot_general")
+
+
+def run_train(exe, tag, compute_dtype=None, lr=0.01, momentum=0.9,
+              remat=None):
     """Full SGD+momentum step; optionally cast params+data to compute_dtype
     inside the step (f32 master weights, grads arrive f32 via the cast vjp)."""
     import jax
@@ -144,6 +153,8 @@ def run_train(exe, tag, compute_dtype=None, lr=0.01, momentum=0.9):
             outs, new_aux = prog.evaluate(m, aux_map, (), True)
             return outs, tuple(new_aux[n] for n in aux_names)
 
+        if remat is not None:
+            f = jax.checkpoint(f, policy=remat)
         (outs, new_aux), vjp_fn = jax.vjp(f, list(params))
         heads = [jnp.ones_like(o) for o in outs]
         zeros_aux = tuple(jnp.zeros_like(a) for a in new_aux)
@@ -383,7 +394,7 @@ def main():
     print("backend:", jax.default_backend(),
           jax.devices()[0].device_kind, flush=True)
     if which & {"infer", "fwd_train", "train_f32", "train_bf16",
-                "fwd_bf16"}:
+                "fwd_bf16", "train_bf16_remat", "train_f32_remat"}:
         exe = build()
         if "infer" in which:
             run_fwd(exe, False, "infer")
@@ -397,6 +408,12 @@ def main():
         if "train_bf16" in which:
             import jax.numpy as jnp
             run_train(exe, "train_bf16", compute_dtype=jnp.bfloat16)
+        if "train_bf16_remat" in which:
+            import jax.numpy as jnp
+            run_train(exe, "train_bf16_remat", compute_dtype=jnp.bfloat16,
+                      remat=_conv_saveable)
+        if "train_f32_remat" in which:
+            run_train(exe, "train_f32_remat", remat=_conv_saveable)
     if "conv" in which:
         conv_micro()
     for spec in sorted(which):
