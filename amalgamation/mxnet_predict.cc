@@ -294,9 +294,12 @@ std::map<std::string, Tensor> load_params(const void *buf, size_t len) {
     t.resize(shape);
     size_t count = (size_t)t.size();
     const uint8_t *src = r.p;
+    size_t elem = (dtype == "float64" || dtype == "int64") ? 8
+                  : (dtype == "float16") ? 2
+                  : (dtype == "uint8" || dtype == "int8") ? 1 : 4;
+    if ((size_t)nbytes != count * elem)
+      throw std::runtime_error("params: size mismatch for " + name);
     if (dtype == "float32" || dtype == "bfloat16") {
-      if ((size_t)nbytes != count * 4)
-        throw std::runtime_error("params: size mismatch for " + name);
       std::memcpy(t.data.data(), src, (size_t)nbytes);
     } else if (dtype == "float64") {
       for (size_t j = 0; j < count; ++j) {
@@ -484,24 +487,32 @@ void pooling(const Tensor &x, Tensor &y, const std::string &type, long kh,
     }
 }
 
-void softmax_axis1(Tensor &t) {
-  // softmax over axis 1, independent at every (batch, spatial...) position
-  const long C = t.shape[1];
-  long outer = t.shape[0];
-  long inner = 1;
-  for (size_t i = 2; i < t.shape.size(); ++i) inner *= t.shape[i];
+void softmax_axis(Tensor &t, long axis, bool log_mode) {
+  // softmax over `axis`, independent at every other position; log_mode
+  // computes x - max - log(sum(exp(x - max))) directly (stable for large
+  // logit gaps where log(softmax(x)) would underflow to -inf)
+  const long nd = (long)t.shape.size();
+  if (axis < 0) axis += nd;
+  if (axis < 0 || axis >= nd)
+    throw std::runtime_error("softmax: axis out of range");
+  const long C = t.shape[(size_t)axis];
+  long outer = 1, inner = 1;
+  for (long d = 0; d < axis; ++d) outer *= t.shape[(size_t)d];
+  for (long d = axis + 1; d < nd; ++d) inner *= t.shape[(size_t)d];
   for (long o = 0; o < outer; ++o)
     for (long in = 0; in < inner; ++in) {
       float *base = &t.data[(size_t)o * C * inner + in];
       float mx = -INFINITY;
       for (long c = 0; c < C; ++c) mx = std::max(mx, base[c * inner]);
       float sum = 0.0f;
-      for (long c = 0; c < C; ++c) {
-        float e = std::exp(base[c * inner] - mx);
-        base[c * inner] = e;
-        sum += e;
+      for (long c = 0; c < C; ++c) sum += std::exp(base[c * inner] - mx);
+      if (log_mode) {
+        const float lse = std::log(sum) + mx;
+        for (long c = 0; c < C; ++c) base[c * inner] -= lse;
+      } else {
+        for (long c = 0; c < C; ++c)
+          base[c * inner] = std::exp(base[c * inner] - mx) / sum;
       }
-      for (long c = 0; c < C; ++c) base[c * inner] /= sum;
     }
 }
 
@@ -562,9 +573,11 @@ class Interp {
     }
     outputs_.clear();
     for (auto &h : g_->heads) {
-      if (vals_[h.first].empty())
-        throw std::runtime_error("head " + std::to_string(h.first) +
-                                 " was never computed");
+      if (vals_[h.first].empty() ||
+          (size_t)h.second >= vals_[h.first].size())
+        throw std::runtime_error(
+            "head " + std::to_string(h.first) + " output slot " +
+            std::to_string(h.second) + " was never computed");
       outputs_.push_back(&vals_[h.first][(size_t)h.second]);
     }
   }
@@ -677,22 +690,28 @@ class Interp {
       const Tensor &x = in(n, 0);
       std::string act = attr_str(n.attrs, "act_type", "relu");
       y = x;
-      for (float &v : y.data) {
-        if (act == "relu") v = std::max(v, 0.0f);
-        else if (act == "sigmoid") v = 1.0f / (1.0f + std::exp(-v));
-        else if (act == "tanh") v = std::tanh(v);
-        else if (act == "softrelu") v = std::log1p(std::exp(v));
-        else throw std::runtime_error("Activation: unsupported " + act);
+      if (act == "relu") {
+        for (float &v : y.data) v = std::max(v, 0.0f);
+      } else if (act == "sigmoid") {
+        for (float &v : y.data) v = 1.0f / (1.0f + std::exp(-v));
+      } else if (act == "tanh") {
+        for (float &v : y.data) v = std::tanh(v);
+      } else if (act == "softrelu") {
+        for (float &v : y.data) v = std::log1p(std::exp(v));
+      } else {
+        throw std::runtime_error("Activation: unsupported " + act);
       }
     } else if (op == "LeakyReLU") {
       const Tensor &x = in(n, 0);
       std::string act = attr_str(n.attrs, "act_type", "leaky");
       float slope = (float)attr_num(n.attrs, "slope", 0.25);
       y = x;
-      for (float &v : y.data) {
-        if (act == "leaky") v = v > 0 ? v : slope * v;
-        else if (act == "elu") v = v > 0 ? v : slope * (std::exp(v) - 1.0f);
-        else throw std::runtime_error("LeakyReLU: unsupported " + act);
+      if (act == "leaky") {
+        for (float &v : y.data) v = v > 0 ? v : slope * v;
+      } else if (act == "elu") {
+        for (float &v : y.data) v = v > 0 ? v : slope * (std::exp(v) - 1.0f);
+      } else {
+        throw std::runtime_error("LeakyReLU: unsupported " + act);
       }
     } else if (op == "Pooling" || op == "Pooling_v1") {
       const Tensor &x = in(n, 0);
@@ -740,6 +759,9 @@ class Interp {
       long axis = (long)attr_num(n.attrs, "dim", 1);
       size_t k = n.inputs.size();
       const Tensor &first = in(n, 0);
+      if (axis < 0) axis += (long)first.shape.size();
+      if (axis < 0 || axis >= (long)first.shape.size())
+        throw std::runtime_error("Concat: dim out of range");
       std::vector<long> shape = first.shape;
       long cat = 0;
       for (size_t j = 0; j < k; ++j) cat += in(n, j).shape[axis];
@@ -783,15 +805,28 @@ class Interp {
       float hi = (float)attr_num(n.attrs, "a_max", INFINITY);
       y = x;
       for (float &v : y.data) v = std::min(std::max(v, lo), hi);
-    } else if (op == "SoftmaxOutput" || op == "Softmax" || op == "softmax") {
-      y = in(n, 0);
-      if (!shape_only_) softmax_axis1(y);
-    } else if (op == "log_softmax") {
+    } else if (op == "SoftmaxOutput" || op == "Softmax") {
+      // loss head; forward semantics mirror ops/nn.py _softmax_fwd:
+      // multi_output -> axis 1; preserve_shape -> last axis; default ->
+      // softmax over the flattened non-batch dims
       y = in(n, 0);
       if (!shape_only_) {
-        softmax_axis1(y);
-        for (float &v : y.data) v = std::log(v);
+        if (attr_bool(n.attrs, "multi_output", false)) {
+          softmax_axis(y, 1, false);
+        } else if (attr_bool(n.attrs, "preserve_shape", false)) {
+          softmax_axis(y, (long)y.shape.size() - 1, false);
+        } else {
+          std::vector<long> orig = y.shape;
+          y.shape = {orig[0], y.size() / orig[0]};
+          softmax_axis(y, 1, false);
+          y.shape = orig;
+        }
       }
+    } else if (op == "softmax" || op == "log_softmax") {
+      y = in(n, 0);
+      if (!shape_only_)
+        softmax_axis(y, (long)attr_num(n.attrs, "axis", -1),
+                     op == "log_softmax");
     } else {
       throw std::runtime_error(
           "amalgamation: op '" + op + "' (node " + n.name +
@@ -985,8 +1020,14 @@ int main(int argc, char **argv) {
     std::fclose(f);
     return buf;
   };
-  std::string json = slurp(argv[1]);
-  std::string params = slurp(argv[2]);
+  std::string json, params;
+  try {
+    json = slurp(argv[1]);
+    params = slurp(argv[2]);
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "%s\n", e.what());
+    return 1;
+  }
   mx_uint shape[4] = {(mx_uint)std::atoi(argv[3]), (mx_uint)std::atoi(argv[4]),
                       (mx_uint)std::atoi(argv[5]), (mx_uint)std::atoi(argv[6])};
   mx_uint indptr[2] = {0, 4};

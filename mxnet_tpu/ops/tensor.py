@@ -251,7 +251,9 @@ def _reshape_shape(data_shape, target):
         if t == 0:
             out.append(src[i]); i += 1
         elif t == -1:
-            out.append(-1); j += 1; continue
+            # ref InferReshapeShape: every code consumes one source dim,
+            # so a later 0 copies the dim at the advanced cursor
+            out.append(-1); i += 1
         elif t == -2:
             out.extend(src[i:]); i = len(src)
         elif t == -3:
@@ -266,7 +268,7 @@ def _reshape_shape(data_shape, target):
             out.extend([d1, d2]); j += 3
             continue
         else:
-            out.append(int(t))
+            out.append(int(t)); i += 1
         j += 1
     if -1 in out:
         known = int(np.prod([d for d in out if d != -1])) or 1
@@ -506,7 +508,10 @@ register("Pad", _pad, num_inputs=1, aliases=("pad",),
 # ---------------------------------------------------------------------------
 
 def _sort(x, axis=-1, is_ascend=True):
-    ax = x.ndim - 1 if axis is None else int(axis)
+    if axis is None:  # ref: axis=None sorts the flattened array
+        x, ax = x.reshape(-1), 0
+    else:
+        ax = int(axis)
     out = jnp.sort(x, axis=ax)
     return out if is_ascend else jnp.flip(out, axis=ax)
 
@@ -516,7 +521,10 @@ register("sort", _sort, num_inputs=1,
 
 
 def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
-    ax = x.ndim - 1 if axis is None else int(axis)
+    if axis is None:  # ref: argsort over the flattened array
+        x, ax = x.reshape(-1), 0
+    else:
+        ax = int(axis)
     out = jnp.argsort(x, axis=ax)
     if not is_ascend:
         out = jnp.flip(out, axis=ax)
@@ -529,21 +537,25 @@ register("argsort", _argsort, num_inputs=1,
 
 
 def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
-    ax = x.ndim - 1 if axis is None else int(axis) % x.ndim
+    if axis is None:  # ref: axis=None ranks the flattened array
+        x, ax = x.reshape(-1), 0
+    else:
+        ax = int(axis) % x.ndim
     xm = jnp.moveaxis(x, ax, -1)
-    vals, idx = lax.top_k(-xm if is_ascend else xm, int(k))
+    vals, idx_last = lax.top_k(-xm if is_ascend else xm, int(k))
     if is_ascend:
         vals = -vals
+    if ret_typ == "mask":
+        # 1 at each selected position, input shape (ref: ReturnType kMask)
+        n = xm.shape[-1]
+        hit = jnp.any(idx_last[..., :, None] == jnp.arange(n), axis=-2)
+        return jnp.moveaxis(hit, -1, ax).astype(x.dtype)
     vals = jnp.moveaxis(vals, -1, ax)
-    idx = jnp.moveaxis(idx, -1, ax)
+    idx = jnp.moveaxis(idx_last, -1, ax)
     if ret_typ == "value":
         return vals
     if ret_typ == "both":
         return vals, idx.astype(np_dtype(dtype))
-    if ret_typ == "mask":
-        xm_shape = x.shape
-        mask = jnp.zeros(np.prod(xm_shape), x.dtype)
-        return mask.reshape(xm_shape)  # mask mode rarely used; placeholder
     return idx.astype(np_dtype(dtype))
 
 

@@ -440,6 +440,220 @@ case("_shuffle", [np.arange(24, dtype=np.float32).reshape(8, 3)],
 
 
 # ---------------------------------------------------------------------------
+# edge-case battery: tricky parameterizations checked against NUMPY
+# expectations, not just imperative/symbolic agreement (the reference's
+# test_operator.py exercises these attr corners one by one; here each gets
+# an explicit oracle via `check=`)
+# ---------------------------------------------------------------------------
+
+def expect(fn):
+    """check= adapter: fn(outs) -> (got, want) compared to 1e-5."""
+    def chk(outs):
+        got, want = fn(outs)
+        np.testing.assert_allclose(got, np.asarray(want, np.float32),
+                                   rtol=1e-5, atol=1e-6)
+    return chk
+
+
+_A = signed(2, 3, 4)
+
+# reductions: axis tuple / negative axis / exclude / axis=None
+case("sum", [_A], attrs={"axis": (0, 2)}, grad=True,
+     check=expect(lambda o: (o[0], _A.sum((0, 2)))))
+case("sum", [_A], attrs={"axis": -1}, grad=True,
+     check=expect(lambda o: (o[0], _A.sum(-1))))
+case("sum", [_A], attrs={"axis": 1, "exclude": True}, grad=True,
+     check=expect(lambda o: (o[0], _A.sum((0, 2)))))
+case("sum", [_A], grad=True,
+     check=expect(lambda o: (o[0], _A.sum())))
+case("mean", [_A], attrs={"axis": (1, 2), "keepdims": True}, grad=True,
+     check=expect(lambda o: (o[0], _A.mean((1, 2), keepdims=True))))
+case("max", [_A], attrs={"axis": (0, 1)}, grad=False,
+     check=expect(lambda o: (o[0], _A.max((0, 1)))))
+# norm in the reference's generation is a FULL L2 reduce — no axis attr
+# (broadcast_reduce_op_value.cc); axis/ord arrived in later MXNet
+case("norm", [_A], grad=True,
+     check=expect(lambda o: (o[0], np.linalg.norm(_A.ravel()))))
+
+# ordering: flattened (axis=None), mask mode, ascending, k edges
+_O = np.array([[3.0, 1.0, 4.0, 1.5], [9.0, 2.0, 6.0, 5.0]], np.float32)
+case("topk", [_O], attrs={"axis": None, "k": 3}, grad=False,
+     mode="imperative",
+     check=expect(lambda o: (o[0], [4.0, 6.0, 7.0])))  # flat indices of top3
+case("topk", [_O], attrs={"axis": 1, "k": 2, "ret_typ": "mask"}, grad=False,
+     check=expect(lambda o: (o[0], [[1, 0, 1, 0], [1, 0, 1, 0]])))
+case("topk", [_O], attrs={"axis": 1, "k": 2, "ret_typ": "value",
+                          "is_ascend": True}, grad=False,
+     check=expect(lambda o: (o[0], [[1.0, 1.5], [2.0, 5.0]])))
+case("topk", [_O], attrs={"axis": 0, "k": 1, "ret_typ": "both"}, grad=False,
+     check=lambda outs: (
+         np.testing.assert_allclose(outs[0], [[9.0, 2.0, 6.0, 5.0]]),
+         np.testing.assert_allclose(outs[1], [[1, 1, 1, 1]])))
+case("sort", [_O], attrs={"axis": None}, grad=False, mode="imperative",
+     check=expect(lambda o: (o[0], np.sort(_O, axis=None))))
+case("sort", [_O], attrs={"axis": 0, "is_ascend": False}, grad=False,
+     check=expect(lambda o: (o[0], -np.sort(-_O, axis=0))))
+case("argsort", [_O], attrs={"axis": None}, grad=False, mode="imperative",
+     check=expect(lambda o: (o[0], np.argsort(_O, axis=None))))
+case("argmax", [_O], grad=False,
+     check=expect(lambda o: (o[0], _O.argmax())))  # axis=None flattens
+case("argmax", [_O], attrs={"axis": 1, "keepdims": True}, grad=False,
+     check=expect(lambda o: (o[0], _O.argmax(1, keepdims=True))))
+
+# Reshape special codes (ref matrix_op-inl.h: 0 copy, -1 infer, -2 copy
+# rest, -3 merge two, -4 split)
+_R = signed(2, 3, 4)
+case("Reshape", [_R], attrs={"shape": (0, -1)},
+     check=expect(lambda o: (o[0], _R.reshape(2, 12))))
+case("Reshape", [_R], attrs={"shape": (-1, 0)},
+     check=expect(lambda o: (o[0], _R.reshape(8, 3))))
+case("Reshape", [_R], attrs={"shape": (-2,)},
+     check=expect(lambda o: (o[0], _R)))
+case("Reshape", [_R], attrs={"shape": (-3, 0)},
+     check=expect(lambda o: (o[0], _R.reshape(6, 4))))
+case("Reshape", [_R], attrs={"shape": (-4, 1, 2, 0, 0)},
+     check=expect(lambda o: (o[0], _R.reshape(1, 2, 3, 4))))
+case("Reshape", [signed(6, 4)], attrs={"shape": (-4, 2, -1, 0)},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 3, 4)))
+
+# take modes: out-of-range indices clip vs wrap (ref indexing_op.h)
+_T = np.arange(12, dtype=np.float32).reshape(4, 3)
+_TI = np.array([-1.0, 0.0, 5.0], np.float32)
+case("take", [_T, _TI], attrs={"mode": "clip"}, grad=False,
+     check=expect(lambda o: (o[0], _T[[0, 0, 3]])))
+case("take", [_T, _TI], attrs={"mode": "wrap"}, grad=False,
+     check=expect(lambda o: (o[0], _T[[-1 % 4, 0, 5 % 4]])))
+case("take", [_T, np.array([1.0, 0.0], np.float32)],
+     attrs={"axis": 1}, grad=True, grad_nodes=["in0"],
+     check=expect(lambda o: (o[0], _T[:, [1, 0]])))
+
+# slice with step / negative bounds (ref matrix_op slice with step)
+_S = np.arange(20, dtype=np.float32).reshape(4, 5)
+case("slice", [_S], attrs={"begin": (0, 4), "end": (4, 0), "step": (1, -2)},
+     grad=False,
+     check=expect(lambda o: (o[0], _S[0:4, 4:0:-2])))
+case("slice", [_S], attrs={"begin": (1, 2), "end": (-1, -1)},
+     grad=False,  # negative ends (ref slice supports negative bounds)
+     check=expect(lambda o: (o[0], _S[1:-1, 2:-1])))
+case("slice_axis", [_S], attrs={"axis": -1, "begin": -3, "end": None},
+     grad=False,
+     check=expect(lambda o: (o[0], _S[:, -3:])))
+
+# softmax numerics + attrs
+_L = np.array([[1e4, 1e4 - 1, 0.0], [-1e4, 0.0, 1.0]], np.float32)
+case("log_softmax", [_L], attrs={"axis": 1}, grad=False,
+     check=lambda outs: np.testing.assert_allclose(
+         outs[0][0, :2], [-0.31326, -1.31326], rtol=1e-4))
+case("softmax", [signed(3, 4)], attrs={"axis": 0}, grad=True,
+     check=lambda outs: np.testing.assert_allclose(
+         outs[0].sum(0), np.ones((4,)), rtol=1e-5))
+case("softmax", [_O], attrs={"temperature": 2.0}, grad=False,
+     check=expect(lambda o: (
+         o[0],
+         np.exp(_O / 2.0) / np.exp(_O / 2.0).sum(1, keepdims=True))))
+
+# one_hot attrs
+case("one_hot", [np.array([0.0, 2.0], np.float32)],
+     attrs={"depth": 3, "on_value": 5.0, "off_value": -1.0}, grad=False,
+     check=expect(lambda o: (o[0], [[5, -1, -1], [-1, -1, 5]])))
+
+# dot / batch_dot transpose flags
+_DA, _DB = signed(3, 4), signed(3, 5)
+case("dot", [_DA, _DB], attrs={"transpose_a": True},
+     check=expect(lambda o: (o[0], _DA.T @ _DB)))
+case("dot", [signed(4, 3), signed(5, 3)], attrs={"transpose_b": True},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (4, 5)))
+_BA, _BB = signed(2, 3, 4), signed(2, 3, 5)
+case("batch_dot", [_BA, _BB], attrs={"transpose_a": True},
+     check=expect(lambda o: (o[0],
+                             np.einsum("bij,bik->bjk", _BA, _BB))))
+
+# FullyConnected flatten=False keeps leading axes
+case("FullyConnected", [signed(2, 3, 4), signed(5, 4), signed(5)],
+     attrs={"num_hidden": 5, "flatten": False},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 3, 5)))
+
+# negative-axis layout ops
+case("Concat", [signed(2, 3), signed(2, 5)], attrs={"dim": -1},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 8)))
+case("stack", [signed(2, 3), signed(2, 3)], attrs={"axis": -1},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 3, 2)))
+case("expand_dims", [signed(2, 3)], attrs={"axis": -1},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 3, 1)))
+_R2 = signed(2, 3)
+case("repeat", [_R2], attrs={"repeats": 2},  # axis=None: flatten, repeat
+     check=expect(lambda o: (o[0], np.repeat(_R2, 2))))
+case("tile", [signed(2, 3)], attrs={"reps": (2, 1, 3)},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (2, 2, 9)))
+case("reverse", [_S], attrs={"axis": (0, 1)}, grad=False,
+     check=expect(lambda o: (o[0], _S[::-1, ::-1])))
+case("squeeze", [signed(1, 3, 1)], attrs={},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (3,)))
+case("transpose", [signed(2, 3, 4)], attrs={},
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (4, 3, 2)))
+
+# clip half-open ranges are rejected upstream in the reference; both
+# bounds always arrive — but the values may sit exactly ON data points
+case("clip", [np.array([-1.0, -0.5, 0.0, 0.5, 1.0], np.float32)],
+     attrs={"a_min": -0.5, "a_max": 0.5}, grad=False,
+     check=expect(lambda o: (o[0], [-0.5, -0.5, 0.0, 0.5, 0.5])))
+
+# SequenceMask value attr + axis
+_SEQ = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+case("SequenceMask", [_SEQ, np.array([2.0, 3.0], np.float32)],
+     attrs={"use_sequence_length": True, "value": -7.0}, grad=False,
+     check=lambda outs: (
+         np.testing.assert_allclose(outs[0][2:, 0], -7.0),
+         np.testing.assert_allclose(outs[0][3:, 1], -7.0),
+         np.testing.assert_allclose(outs[0][:2], _SEQ[:2])))
+
+# Pooling 'full' (ceil) convention output size (ref pooling-inl.h)
+case("Pooling", [pos(1, 1, 5, 5)],
+     attrs={"kernel": (2, 2), "stride": (2, 2),
+            "pooling_convention": "full", "pool_type": "max"}, grad=False,
+     check=lambda outs: np.testing.assert_equal(outs[0].shape, (1, 1, 3, 3)))
+
+# Convolution 1D / 3D / depthwise / dilated.  atol 1e-2 throughout: finite
+# differences on conv are noisy at tiny-|g| points (see the stem case note)
+case("Convolution", [signed(2, 3, 8), signed(4, 3, 3), signed(4)],
+     attrs={"kernel": (3,), "num_filter": 4}, rtol=8e-2, atol=1e-2)
+case("Convolution", [signed(1, 2, 4, 4, 4), signed(3, 2, 2, 2, 2),
+                     signed(3)],
+     attrs={"kernel": (2, 2, 2), "num_filter": 3}, rtol=8e-2, atol=1e-2)
+case("Convolution", [signed(1, 4, 5, 5), signed(4, 1, 3, 3), signed(4)],
+     attrs={"kernel": (3, 3), "num_filter": 4, "num_group": 4}, rtol=8e-2,
+     atol=1e-2)
+case("Convolution", [signed(1, 2, 7, 7), signed(3, 2, 3, 3), signed(3)],
+     attrs={"kernel": (3, 3), "num_filter": 3, "dilate": (2, 2)}, rtol=8e-2,
+     atol=1e-2)
+# stem shape (C_in=3): exercises the MXU channel-padding path.  atol 1e-2:
+# finite differences on a strided conv are noisy at tiny-|g| points (the
+# unpadded C_in=8 control shows the identical deviation; raw jax.grad
+# matches central differences to 1e-3 at the flagged points)
+case("Convolution", [signed(2, 3, 8, 8), signed(4, 3, 3, 3), signed(4)],
+     attrs={"kernel": (3, 3), "num_filter": 4, "stride": (2, 2),
+            "pad": (1, 1)}, rtol=8e-2, atol=1e-2)
+
+# BatchNorm use_global_stats under train (ref batch_norm-inl.h): moving
+# stats are used even when is_train=True
+_BNX, _BNG, _BNB = signed(2, 3, 4, 4), pos(3), signed(3)
+_BNM, _BNV = signed(3), pos(3)
+case("BatchNorm", [_BNX, _BNG, _BNB, _BNM, _BNV],
+     attrs={"use_global_stats": True, "fix_gamma": False, "eps": 1e-3},
+     grad=False, train=True, mode="imperative",
+     check=lambda outs: np.testing.assert_allclose(
+         outs[0],
+         (_BNX - _BNM.reshape(1, 3, 1, 1))
+         / np.sqrt(_BNV.reshape(1, 3, 1, 1) + 1e-3)
+         * _BNG.reshape(1, 3, 1, 1) + _BNB.reshape(1, 3, 1, 1),
+         rtol=2e-5, atol=1e-5))
+
+# where: condition enters as float mask; gradient only to branches
+case("where", [np.array([1.0, 0.0, 1.0], np.float32),
+               signed(3), signed(3)],
+     grad=True, grad_nodes=["in1", "in2"])
+
+# ---------------------------------------------------------------------------
 # harness
 # ---------------------------------------------------------------------------
 
