@@ -1,0 +1,105 @@
+/*
+ * Core C ABI: NDArray + imperative op invoke + Symbol JSON (capability
+ * parity with the NDArray/op/symbol groups of include/mxnet/c_api.h —
+ * MXNDArrayCreateEx, MXNDArraySyncCopy*, MXNDArraySave/Load, MXImperativeInvoke,
+ * MXSymbolCreateFromJSON...).  Together with c_predict_api.h (inference),
+ * c_train_api.h (training) and the recordio/engine ABIs this is the seam
+ * every non-Python frontend builds on.
+ *
+ * Implementation (src/c_api.cc) embeds the CPython runtime exactly like
+ * the predict/train ABIs; all entry points are GIL-safe from any host
+ * thread and report failures via -1 + MXGetLastError().
+ *
+ * Deviation from the reference, by design: ops are invoked BY NAME
+ * (MXImperativeInvokeByName) rather than through AtomicSymbolCreator
+ * handles — the registry is name-keyed here, and name dispatch removes a
+ * whole handle-lifetime class of bugs for C consumers.  Attr values are
+ * strings, parsed with the same rules as symbol JSON attrs.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+
+const char *MXGetLastError();
+int MXGetVersion(int *out);
+
+/* -- NDArray ----------------------------------------------------------- */
+
+/* dtype codes follow the reference enum: 0=float32 1=float64 2=float16
+ * 3=uint8 4=int32 5=int8 6=int64 12=bfloat16 */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+/* *out_pdata stays valid until the next call on this handle's thread. */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size_bytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size_bytes);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+/* out_names has out_name_size entries (0 for unnamed containers); both
+ * arrays stay valid until the next MXNDArrayLoad on this thread (other
+ * calls, including invokes and listings, do NOT clobber them; the loaded
+ * handles themselves are owned by the caller and outlive everything
+ * until MXNDArrayFree). */
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* -- operator registry + imperative invoke ------------------------------ */
+
+/* List every registered op name; valid until the next listing call
+ * (MXListAllOpNames / MXSymbolList*) on this thread. */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/* Invoke op `op_name` on `inputs`; outputs are returned as new handles in
+ * *outputs (caller frees each with MXNDArrayFree), *num_outputs set to
+ * the count.  The output handle ARRAY stays valid until the next
+ * MXImperativeInvokeByName on this thread — copy the handles out before
+ * the next invoke; the handles themselves are caller-owned. */
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals);
+
+/* -- Symbol ------------------------------------------------------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+/* *out_json stays valid until the next call on this symbol's thread. */
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array);
+int MXSymbolFree(SymbolHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -1,0 +1,152 @@
+"""Python side of the core C ABI (src/c_api.cc).
+
+The embedding pattern is the same as the predict/train ABIs: the .so
+holds C entry points and the GIL dance, while all marshalling lives here
+(ref surface: include/mxnet/c_api.h NDArray/op/symbol groups —
+MXNDArrayCreateEx, MXNDArraySyncCopy*, MXNDArraySave/Load,
+MXImperativeInvoke, MXSymbolCreateFromJSON...).  Every helper takes/returns
+plain ints, bytes and tuples so the C side never touches framework types.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .base import MXNetError
+
+# reference dtype enum (mshadow/base.h TypeFlag): the C ABI speaks these
+_DTYPE_TO_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "int8": 5, "int64": 6, "bfloat16": 12}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    from .context import Context
+    return Context(int(dev_type), int(dev_id))
+
+
+def create(shape, dev_type, dev_id, dtype_code):
+    from . import ndarray as nd
+    dtype = _CODE_TO_DTYPE.get(int(dtype_code))
+    if dtype is None:
+        raise MXNetError("unknown dtype code %d" % dtype_code)
+    return nd.zeros(tuple(int(d) for d in shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=dtype)
+
+
+def get_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def get_dtype_code(arr):
+    from .base import dtype_name
+    name = dtype_name(arr.dtype)
+    if name not in _DTYPE_TO_CODE:
+        raise MXNetError("dtype %s has no C ABI code" % name)
+    return _DTYPE_TO_CODE[name]
+
+
+def get_context(arr):
+    return int(arr.context.device_typeid), int(arr.context.device_id)
+
+
+def copy_from_cpu(arr, src_addr, nbytes):
+    """Blocking host->array copy; src is a raw C pointer.  Validates from
+    shape/dtype metadata only — the destination's current contents are
+    never fetched (a device->host transfer just to overwrite it)."""
+    dtype = np.dtype(arr.dtype)
+    want = int(np.prod(arr.shape)) * dtype.itemsize
+    if int(nbytes) != want:
+        raise MXNetError("SyncCopyFromCPU: size mismatch (want %d bytes, "
+                         "got %d)" % (want, nbytes))
+    buf = (ctypes.c_char * int(nbytes)).from_address(int(src_addr))
+    view = np.frombuffer(bytes(buf), dtype=dtype).reshape(arr.shape)
+    arr[:] = view
+
+
+def copy_to_cpu(arr, dst_addr, nbytes):
+    """Blocking array->host copy; dst is a raw C pointer."""
+    npa = np.ascontiguousarray(arr.asnumpy())
+    raw = npa.tobytes()
+    if len(raw) != int(nbytes):
+        raise MXNetError("SyncCopyToCPU: size mismatch (have %d bytes, "
+                         "buffer %d)" % (len(raw), nbytes))
+    ctypes.memmove(int(dst_addr), raw, len(raw))
+
+
+def wait_to_read(arr):
+    arr.wait_to_read()
+
+
+def wait_all():
+    from .ndarray import waitall
+    waitall()
+
+
+def save(fname, arrs, keys):
+    from . import ndarray as nd
+    if keys:
+        nd.save(fname, dict(zip(keys, arrs)))
+    else:
+        nd.save(fname, list(arrs))
+
+
+def load(fname):
+    """-> (list_of_arrays, list_of_names ([] for unnamed containers))."""
+    from . import ndarray as nd
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [data[k] for k in names], names
+    return list(data), []
+
+
+def slice_(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def at(arr, idx):
+    return arr[int(idx)]
+
+
+def list_op_names():
+    from .ops import registry
+    return sorted(registry.op_registry().keys())
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    """Invoke a registered op by name on NDArray handles.
+
+    Attr values arrive as strings (the reference's C convention); the
+    registry's normalize_attrs parses them exactly like symbol JSON attrs.
+    Returns a list of output NDArrays."""
+    from .ndarray import _invoke
+    attrs = dict(zip(keys, vals))
+    out = _invoke(op_name, list(inputs), attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def symbol_from_json(json_str):
+    from .symbol import load_json
+    return load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())

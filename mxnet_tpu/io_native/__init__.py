@@ -459,3 +459,96 @@ def get_imgdec_lib():
         except Exception:
             _imgdec_lib = None
         return _imgdec_lib
+
+
+# ---------------------------------------------------------------------------
+# Core C ABI (src/c_api.cc) — NDArray + imperative invoke + Symbol JSON
+# (parity target: the NDArray/op/symbol groups of include/mxnet/c_api.h);
+# same CPython-embedding architecture as the predict/train ABIs
+# ---------------------------------------------------------------------------
+
+_CAPI_PATH = os.path.join(os.path.dirname(__file__), "libmxnet_tpu_capi.so")
+_capi_lib = None
+_capi_tried = False
+
+
+def get_capi_lib():
+    """Load (building if needed) the core C ABI library; None if the
+    toolchain or Python headers are unavailable."""
+    global _capi_lib, _capi_tried
+    with _lock:
+        if _capi_lib is not None or _capi_tried:
+            return _capi_lib
+        _capi_tried = True
+        try:
+            _capi_lib = _load_embed_lib("c_api.cc", _CAPI_PATH, _declare_capi)
+        except Exception:
+            _capi_lib = None
+        return _capi_lib
+
+
+def _declare_capi(lib):
+    u32 = ctypes.c_uint32
+    u32p = ctypes.POINTER(u32)
+    vp = ctypes.c_void_p
+    vpp = ctypes.POINTER(vp)
+    ip = ctypes.POINTER(ctypes.c_int)
+    sp = ctypes.POINTER(ctypes.c_char_p)
+    spp = ctypes.POINTER(sp)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXGetVersion.restype = ctypes.c_int
+    lib.MXGetVersion.argtypes = [ip]
+    lib.MXNDArrayCreateEx.restype = ctypes.c_int
+    lib.MXNDArrayCreateEx.argtypes = [u32p, u32, ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_int, vpp]
+    lib.MXNDArrayCreate.restype = ctypes.c_int
+    lib.MXNDArrayCreate.argtypes = [u32p, u32, ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, vpp]
+    lib.MXNDArrayFree.restype = ctypes.c_int
+    lib.MXNDArrayFree.argtypes = [vp]
+    lib.MXNDArrayGetShape.restype = ctypes.c_int
+    lib.MXNDArrayGetShape.argtypes = [vp, u32p, ctypes.POINTER(u32p)]
+    lib.MXNDArrayGetDType.restype = ctypes.c_int
+    lib.MXNDArrayGetDType.argtypes = [vp, ip]
+    lib.MXNDArrayGetContext.restype = ctypes.c_int
+    lib.MXNDArrayGetContext.argtypes = [vp, ip, ip]
+    lib.MXNDArraySyncCopyFromCPU.restype = ctypes.c_int
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.restype = ctypes.c_int
+    lib.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    lib.MXNDArrayWaitToRead.restype = ctypes.c_int
+    lib.MXNDArrayWaitToRead.argtypes = [vp]
+    lib.MXNDArrayWaitAll.restype = ctypes.c_int
+    lib.MXNDArrayWaitAll.argtypes = []
+    lib.MXNDArraySlice.restype = ctypes.c_int
+    lib.MXNDArraySlice.argtypes = [vp, u32, u32, vpp]
+    lib.MXNDArrayAt.restype = ctypes.c_int
+    lib.MXNDArrayAt.argtypes = [vp, u32, vpp]
+    lib.MXNDArrayReshape.restype = ctypes.c_int
+    lib.MXNDArrayReshape.argtypes = [vp, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int), vpp]
+    lib.MXNDArraySave.restype = ctypes.c_int
+    lib.MXNDArraySave.argtypes = [ctypes.c_char_p, u32, vpp, sp]
+    lib.MXNDArrayLoad.restype = ctypes.c_int
+    lib.MXNDArrayLoad.argtypes = [ctypes.c_char_p, u32p, ctypes.POINTER(vpp),
+                                  u32p, spp]
+    lib.MXListAllOpNames.restype = ctypes.c_int
+    lib.MXListAllOpNames.argtypes = [u32p, spp]
+    lib.MXImperativeInvokeByName.restype = ctypes.c_int
+    lib.MXImperativeInvokeByName.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, vpp, ip, ctypes.POINTER(vpp),
+        ctypes.c_int, sp, sp]
+    lib.MXSymbolCreateFromJSON.restype = ctypes.c_int
+    lib.MXSymbolCreateFromJSON.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXSymbolCreateFromFile.restype = ctypes.c_int
+    lib.MXSymbolCreateFromFile.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXSymbolSaveToJSON.restype = ctypes.c_int
+    lib.MXSymbolSaveToJSON.argtypes = [vp, sp]
+    lib.MXSymbolListOutputs.restype = ctypes.c_int
+    lib.MXSymbolListOutputs.argtypes = [vp, u32p, spp]
+    lib.MXSymbolListArguments.restype = ctypes.c_int
+    lib.MXSymbolListArguments.argtypes = [vp, u32p, spp]
+    lib.MXSymbolListAuxiliaryStates.restype = ctypes.c_int
+    lib.MXSymbolListAuxiliaryStates.argtypes = [vp, u32p, spp]
+    lib.MXSymbolFree.restype = ctypes.c_int
+    lib.MXSymbolFree.argtypes = [vp]

@@ -1,0 +1,514 @@
+// Core C ABI implementation: NDArray + imperative invoke + Symbol JSON
+// (capability parity target: the NDArray/op/symbol groups of
+// src/c_api/c_api.cc — MXNDArrayCreateEx, MXNDArraySyncCopy*,
+// MXNDArraySave/Load, MXImperativeInvokeEx, MXSymbolCreateFromJSON).
+//
+// Same embedding architecture as src/c_predict_api.cc: the .so holds the
+// C entry points and the GIL discipline; every marshalling detail lives
+// in mxnet_tpu/capi_support.py.  Handles own a Python object reference;
+// MXNDArrayFree/MXSymbolFree drop it.
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+}
+
+namespace {
+
+thread_local std::string last_error;
+
+// thread-local return buffers (the reference's MXAPIThreadLocalEntry),
+// one family per entry-point group so the documented lifetimes hold
+// independently: a Load result survives invokes and listings, and vice
+// versa
+thread_local std::vector<mx_uint> tl_shape;
+thread_local std::vector<std::string> tl_list_strings;
+thread_local std::vector<const char *> tl_list_cstrs;
+thread_local std::vector<void *> tl_invoke_handles;
+thread_local std::vector<void *> tl_load_handles;
+thread_local std::vector<std::string> tl_load_strings;
+thread_local std::vector<const char *> tl_load_cstrs;
+thread_local std::string tl_json;
+
+std::once_flag py_init_once;
+
+class GIL {
+ public:
+  GIL() {
+    std::call_once(py_init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        PyEval_SaveThread();
+      }
+    });
+    state_ = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_err_from_python() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptb = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptb);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptb);
+  last_error = "python error";
+  if (pvalue) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) {
+        last_error = msg;
+      } else {
+        PyErr_Clear();
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptb);
+}
+
+// call mxnet_tpu.capi_support.<fn>(*args); returns new ref or null
+PyObject *support_call(const char *fn, PyObject *args) {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.capi_support");
+  if (!mod) {
+    set_err_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    set_err_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!res) set_err_from_python();
+  return res;
+}
+
+PyObject *uint_tuple(const mx_uint *data, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(data[i]));
+  return t;
+}
+
+PyObject *str_list(const char **data, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(data[i] ? data[i] : ""));
+  return l;
+}
+
+// stash a list of unicode into the given string buffers
+void stash_str_list(PyObject *list, std::vector<std::string> &strings,
+                    std::vector<const char *> &cstrs, mx_uint *out_size,
+                    const char ***out_array) {
+  Py_ssize_t n = PyList_Size(list);
+  strings.clear();
+  strings.reserve((size_t)n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    strings.emplace_back(s ? s : "");
+  }
+  cstrs.clear();
+  for (const auto &s : strings) cstrs.push_back(s.c_str());
+  *out_size = (mx_uint)n;
+  *out_array = cstrs.data();
+}
+
+#define API_BEGIN() try {
+#define API_END()                       \
+  }                                     \
+  catch (const std::exception &e) {     \
+    last_error = e.what();              \
+    return -1;                          \
+  }                                     \
+  return 0;
+
+#define CHECK_NULL(p, what)            \
+  if ((p) == nullptr) {                \
+    last_error = "null " what;         \
+    return -1;                         \
+  }
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  CHECK_NULL(out, "output pointer");
+  *out = 10001;  // mirrors the reference's MXNET_VERSION (1.0.1)
+  return 0;
+}
+
+// -- NDArray ---------------------------------------------------------------
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)delay_alloc;  // XLA owns allocation; arrays materialize lazily anyway
+  CHECK_NULL(out, "output pointer");
+  if (ndim > 0) CHECK_NULL(shape, "shape");
+  GIL gil;
+  PyObject *res = support_call(
+      "create", Py_BuildValue("(NiiI)", uint_tuple(shape, ndim), dev_type,
+                              dev_id, (unsigned)dtype));
+  if (!res) return -1;
+  *out = res;  // handle owns the reference
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;  // reference tolerates null frees
+  GIL gil;
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out_dim, "output pointer");
+  CHECK_NULL(out_pdata, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "get_shape", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  tl_shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i)
+    tl_shape.push_back(
+        (mx_uint)PyLong_AsUnsignedLong(PyTuple_GetItem(res, i)));
+  Py_DECREF(res);
+  *out_dim = (mx_uint)tl_shape.size();
+  *out_pdata = tl_shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "get_dtype_code", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out_dev_type, "output pointer");
+  CHECK_NULL(out_dev_id, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "get_context", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GetItem(res, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GetItem(res, 1));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size_bytes) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(data, "data");
+  GIL gil;
+  PyObject *res = support_call(
+      "copy_from_cpu", Py_BuildValue("(OKK)", (PyObject *)handle,
+                                     (unsigned long long)(uintptr_t)data,
+                                     (unsigned long long)size_bytes));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size_bytes) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(data, "data");
+  GIL gil;
+  PyObject *res = support_call(
+      "copy_to_cpu", Py_BuildValue("(OKK)", (PyObject *)handle,
+                                   (unsigned long long)(uintptr_t)data,
+                                   (unsigned long long)size_bytes));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  GIL gil;
+  PyObject *res = support_call(
+      "wait_to_read", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  GIL gil;
+  PyObject *res = support_call("wait_all", PyTuple_New(0));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "slice_", Py_BuildValue("(OII)", (PyObject *)handle, begin, end));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "at", Py_BuildValue("(OI)", (PyObject *)handle, idx));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out, "output pointer");
+  if (ndim > 0) CHECK_NULL(dims, "dims");
+  GIL gil;
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  PyObject *res = support_call(
+      "reshape", Py_BuildValue("(ON)", (PyObject *)handle, t));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  CHECK_NULL(fname, "filename");
+  if (num_args > 0) CHECK_NULL(args, "arrays");
+  GIL gil;
+  PyObject *arrs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *h = (PyObject *)args[i];
+    Py_INCREF(h);
+    PyList_SET_ITEM(arrs, i, h);
+  }
+  PyObject *names;
+  if (keys != nullptr) {
+    names = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(names, i,
+                      PyUnicode_FromString(keys[i] ? keys[i] : ""));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *res = support_call(
+      "save", Py_BuildValue("(sNN)", fname, arrs, names));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  CHECK_NULL(fname, "filename");
+  CHECK_NULL(out_size, "output pointer");
+  CHECK_NULL(out_arr, "output pointer");
+  CHECK_NULL(out_name_size, "output pointer");
+  CHECK_NULL(out_names, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("load", Py_BuildValue("(s)", fname));
+  if (!res) return -1;
+  PyObject *arrs = PyTuple_GetItem(res, 0);
+  PyObject *names = PyTuple_GetItem(res, 1);
+  // previous load's handles belong to the caller now; just repoint the
+  // thread-local table
+  tl_load_handles.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(arrs); ++i) {
+    PyObject *h = PyList_GetItem(arrs, i);
+    Py_INCREF(h);  // handle ownership transfers to the caller
+    tl_load_handles.push_back(h);
+  }
+  mx_uint nsz = 0;
+  const char **nptr = nullptr;
+  stash_str_list(names, tl_load_strings, tl_load_cstrs, &nsz, &nptr);
+  Py_DECREF(res);
+  *out_size = (mx_uint)tl_load_handles.size();
+  *out_arr = tl_load_handles.data();
+  *out_name_size = nsz;
+  *out_names = nptr;
+  return 0;
+}
+
+// -- op registry + invoke --------------------------------------------------
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  CHECK_NULL(out_size, "output pointer");
+  CHECK_NULL(out_array, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("list_op_names", PyTuple_New(0));
+  if (!res) return -1;
+  stash_str_list(res, tl_list_strings, tl_list_cstrs, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals) {
+  CHECK_NULL(op_name, "op name");
+  CHECK_NULL(num_outputs, "output pointer");
+  CHECK_NULL(outputs, "output pointer");
+  if (num_inputs > 0) CHECK_NULL(inputs, "inputs");
+  if (num_params > 0) {
+    CHECK_NULL(param_keys, "param keys");
+    CHECK_NULL(param_vals, "param vals");
+  }
+  GIL gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *h = (PyObject *)inputs[i];
+    Py_INCREF(h);
+    PyList_SET_ITEM(ins, i, h);
+  }
+  PyObject *res = support_call(
+      "imperative_invoke",
+      Py_BuildValue("(sNNN)", op_name, ins, str_list(param_keys, num_params),
+                    str_list(param_vals, num_params)));
+  if (!res) return -1;
+  tl_invoke_handles.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject *h = PyList_GetItem(res, i);
+    Py_INCREF(h);  // caller owns each output handle
+    tl_invoke_handles.push_back(h);
+  }
+  Py_DECREF(res);
+  *num_outputs = (int)tl_invoke_handles.size();
+  *outputs = tl_invoke_handles.data();
+  return 0;
+}
+
+// -- Symbol ----------------------------------------------------------------
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  CHECK_NULL(json, "json");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("symbol_from_json",
+                               Py_BuildValue("(s)", json));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  CHECK_NULL(fname, "filename");
+  CHECK_NULL(out, "output pointer");
+  API_BEGIN();
+  FILE *f = fopen(fname, "rb");
+  if (!f) {
+    last_error = std::string("cannot open ") + fname;
+    return -1;
+  }
+  std::string buf;
+  char chunk[65536];
+  size_t n;
+  while ((n = fread(chunk, 1, sizeof(chunk), f)) > 0) buf.append(chunk, n);
+  fclose(f);
+  return MXSymbolCreateFromJSON(buf.c_str(), out);
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  CHECK_NULL(handle, "SymbolHandle");
+  CHECK_NULL(out_json, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("symbol_to_json",
+                               Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  const char *s = PyUnicode_AsUTF8(res);
+  tl_json = s ? s : "";
+  Py_DECREF(res);
+  *out_json = tl_json.c_str();
+  return 0;
+}
+
+static int symbol_str_list(SymbolHandle handle, const char *fn,
+                           mx_uint *out_size, const char ***out_array) {
+  CHECK_NULL(handle, "SymbolHandle");
+  CHECK_NULL(out_size, "output pointer");
+  CHECK_NULL(out_array, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(fn, Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  stash_str_list(res, tl_list_strings, tl_list_cstrs, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  return symbol_str_list(handle, "symbol_list_outputs", out_size, out_array);
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  return symbol_str_list(handle, "symbol_list_arguments", out_size,
+                         out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array) {
+  return symbol_str_list(handle, "symbol_list_aux", out_size, out_array);
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+}  // extern "C"

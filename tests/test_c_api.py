@@ -1,0 +1,261 @@
+"""Core C ABI tests (include/mxnet_tpu/c_api.h over src/c_api.cc).
+
+Parity model: the reference's NDArray/op/symbol C API groups
+(src/c_api/c_api.cc, c_api_ndarray.cc, c_api_symbolic.cc) — every
+non-Python frontend is built on exactly these calls."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io_native import get_capi_lib
+
+pytestmark = pytest.mark.fast
+
+lib = get_capi_lib()
+if lib is None:
+    pytest.skip("toolchain/Python headers unavailable", allow_module_level=True)
+
+
+def _err():
+    return lib.MXGetLastError().decode()
+
+
+def _create(shape, dtype=0, dev_type=1, dev_id=0):
+    arr = (ctypes.c_uint32 * len(shape))(*shape)
+    h = ctypes.c_void_p()
+    rc = lib.MXNDArrayCreateEx(arr, len(shape), dev_type, dev_id, 0, dtype,
+                               ctypes.byref(h))
+    assert rc == 0, _err()
+    return h
+
+
+def _to_np(h, shape, np_dtype=np.float32):
+    out = np.empty(shape, np_dtype)
+    rc = lib.MXNDArraySyncCopyToCPU(h, out.ctypes.data_as(ctypes.c_void_p),
+                                    out.nbytes)
+    assert rc == 0, _err()
+    return out
+
+
+def _from_np(h, a):
+    a = np.ascontiguousarray(a)
+    rc = lib.MXNDArraySyncCopyFromCPU(h, a.ctypes.data_as(ctypes.c_void_p),
+                                      a.nbytes)
+    assert rc == 0, _err()
+
+
+def test_version_and_error_surface():
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value == 10001
+    # null-handle probing must error, not crash (ported consumers do this)
+    assert lib.MXNDArrayGetDType(None, ctypes.byref(ctypes.c_int())) == -1
+    assert "null" in _err()
+
+
+def test_ndarray_roundtrip_and_metadata():
+    h = _create((2, 3))
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(ndim.value)] == [2, 3]
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0 and dt.value == 0
+    devt, devi = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXNDArrayGetContext(h, ctypes.byref(devt),
+                                   ctypes.byref(devi)) == 0
+    assert devt.value == 1 and devi.value == 0
+
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _from_np(h, src)
+    assert lib.MXNDArrayWaitToRead(h) == 0
+    np.testing.assert_array_equal(_to_np(h, (2, 3)), src)
+    # size mismatch is an error, not a partial copy
+    bad = np.zeros(4, np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, bad.ctypes.data_as(ctypes.c_void_p), bad.nbytes) == -1
+    assert "size mismatch" in _err()
+    lib.MXNDArrayFree(h)
+
+
+def test_dtype_codes():
+    for code, npdt in [(1, np.float64), (4, np.int32), (6, np.int64),
+                       (3, np.uint8)]:
+        h = _create((4,), dtype=code)
+        src = np.arange(4).astype(npdt)
+        _from_np(h, src)
+        np.testing.assert_array_equal(_to_np(h, (4,), npdt), src)
+        lib.MXNDArrayFree(h)
+
+
+def test_slice_at_reshape():
+    h = _create((4, 3))
+    _from_np(h, np.arange(12, dtype=np.float32).reshape(4, 3))
+    s = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(h, 1, 3, ctypes.byref(s)) == 0, _err()
+    np.testing.assert_array_equal(
+        _to_np(s, (2, 3)), np.arange(12, dtype=np.float32).reshape(4, 3)[1:3])
+    a = ctypes.c_void_p()
+    assert lib.MXNDArrayAt(h, 2, ctypes.byref(a)) == 0, _err()
+    np.testing.assert_array_equal(_to_np(a, (3,)),
+                                  np.array([6, 7, 8], np.float32))
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(6, 2)
+    assert lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)) == 0, _err()
+    np.testing.assert_array_equal(
+        _to_np(r, (6, 2)), np.arange(12, dtype=np.float32).reshape(6, 2))
+    for x in (s, a, r, h):
+        lib.MXNDArrayFree(x)
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs.params").encode()
+    h1, h2 = _create((2, 2)), _create((3,))
+    _from_np(h1, np.eye(2, dtype=np.float32))
+    _from_np(h2, np.array([1, 2, 3], np.float32))
+    keys = (ctypes.c_char_p * 2)(b"arg:w", b"aux:s")
+    handles = (ctypes.c_void_p * 2)(h1, h2)
+    assert lib.MXNDArraySave(f, 2, handles, keys) == 0, _err()
+
+    n = ctypes.c_uint32()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    nn = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(f, ctypes.byref(n), ctypes.byref(arrs),
+                             ctypes.byref(nn), ctypes.byref(names)) == 0, _err()
+    assert n.value == 2 and nn.value == 2
+    loaded = {names[i].decode(): ctypes.c_void_p(arrs[i])
+              for i in range(n.value)}
+    np.testing.assert_array_equal(_to_np(loaded["arg:w"], (2, 2)),
+                                  np.eye(2, dtype=np.float32))
+    np.testing.assert_array_equal(_to_np(loaded["aux:s"], (3,)),
+                                  np.array([1, 2, 3], np.float32))
+    # interop: the Python side reads the same container
+    d = mx.nd.load(f.decode())
+    assert set(d) == {"arg:w", "aux:s"}
+    for h in loaded.values():
+        lib.MXNDArrayFree(h)
+    for h in (h1, h2):
+        lib.MXNDArrayFree(h)
+
+
+def test_list_ops_and_imperative_invoke():
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = {arr[i].decode() for i in range(n.value)}
+    assert {"dot", "Convolution", "softmax", "_plus_scalar"} <= names
+
+    h = _create((2, 3))
+    _from_np(h, np.ones((2, 3), np.float32))
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(b"scalar")
+    vals = (ctypes.c_char_p * 1)(b"2.5")
+    ins = (ctypes.c_void_p * 1)(h)
+    rc = lib.MXImperativeInvokeByName(b"_plus_scalar", 1, ins,
+                                      ctypes.byref(n_out), ctypes.byref(outs),
+                                      1, keys, vals)
+    assert rc == 0, _err()
+    assert n_out.value == 1
+    out_h = ctypes.c_void_p(outs[0])
+    np.testing.assert_allclose(_to_np(out_h, (2, 3)), 3.5)
+    lib.MXNDArrayFree(out_h)
+
+    # multi-output op through the same entry point
+    h2 = _create((2, 4))
+    _from_np(h2, np.arange(8, dtype=np.float32).reshape(2, 4))
+    ins2 = (ctypes.c_void_p * 1)(h2)
+    keys2 = (ctypes.c_char_p * 2)(b"k", b"ret_typ")
+    vals2 = (ctypes.c_char_p * 2)(b"2", b"both")
+    rc = lib.MXImperativeInvokeByName(b"topk", 1, ins2, ctypes.byref(n_out),
+                                      ctypes.byref(outs), 2, keys2, vals2)
+    assert rc == 0, _err()
+    assert n_out.value == 2
+    for i in range(2):
+        lib.MXNDArrayFree(ctypes.c_void_p(outs[i]))
+    # unknown op reports cleanly
+    rc = lib.MXImperativeInvokeByName(b"not_a_real_op", 1, ins,
+                                      ctypes.byref(n_out), ctypes.byref(outs),
+                                      0, None, None)
+    assert rc == -1
+    assert "not_a_real_op" in _err()
+    for x in (h, h2):
+        lib.MXNDArrayFree(x)
+
+
+def test_symbol_json_roundtrip():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    js = net.tojson().encode()
+    s = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(js, ctypes.byref(s)) == 0, _err()
+    out_json = ctypes.c_char_p()
+    assert lib.MXSymbolSaveToJSON(s, ctypes.byref(out_json)) == 0, _err()
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListOutputs(s, ctypes.byref(n), ctypes.byref(arr)) == 0
+    assert [arr[i].decode() for i in range(n.value)] == ["fc_output"]
+    assert lib.MXSymbolListArguments(s, ctypes.byref(n),
+                                     ctypes.byref(arr)) == 0
+    assert [arr[i].decode() for i in range(n.value)] == \
+        ["data", "fc_weight", "fc_bias"]
+    assert lib.MXSymbolListAuxiliaryStates(s, ctypes.byref(n),
+                                           ctypes.byref(arr)) == 0
+    assert n.value == 0
+    lib.MXSymbolFree(s)
+    # bad json errors cleanly
+    s2 = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(b"{not json", ctypes.byref(s2)) == -1
+
+
+def test_c_program_compiles_and_runs(tmp_path):
+    """A pure-C consumer of the ABI: compile with gcc, link nothing but
+    the .so + libpython, run end-to-end (create -> invoke -> read)."""
+    import subprocess
+    from mxnet_tpu.io_native import _CAPI_PATH
+    c_src = tmp_path / "use_capi.c"
+    c_src.write_text(r'''
+#include <stdio.h>
+#include "mxnet_tpu/c_api.h"
+int main(void) {
+  mx_uint shape[2] = {2, 2};
+  NDArrayHandle a = 0;
+  if (MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &a) != 0) {
+    fprintf(stderr, "create: %s\n", MXGetLastError());
+    return 1;
+  }
+  float vals[4] = {1, 2, 3, 4};
+  if (MXNDArraySyncCopyFromCPU(a, vals, sizeof(vals)) != 0) return 1;
+  NDArrayHandle ins[1] = {a};
+  NDArrayHandle *outs = 0;
+  int n_out = 0;
+  const char *k[1] = {"scalar"};
+  const char *v[1] = {"10"};
+  if (MXImperativeInvokeByName("_mul_scalar", 1, ins, &n_out, &outs,
+                               1, k, v) != 0) {
+    fprintf(stderr, "invoke: %s\n", MXGetLastError());
+    return 1;
+  }
+  float out[4];
+  if (MXNDArraySyncCopyToCPU(outs[0], out, sizeof(out)) != 0) return 1;
+  printf("%g %g %g %g\n", out[0], out[1], out[2], out[3]);
+  MXNDArrayFree(outs[0]);
+  MXNDArrayFree(a);
+  return 0;
+}
+''')
+    # reuse the proven libpython link recipe (LDVERSION fallback,
+    # LIBS/SYSLIBS flags, sitepackages PYTHONPATH) from test_native
+    from test_native import _build_embed_binary
+    exe, env = _build_embed_binary(tmp_path, str(c_src), "mxnet_tpu_capi",
+                                   _CAPI_PATH, "use_capi")
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([str(exe)], capture_output=True, text=True,
+                         env=env, timeout=240)
+    assert res.returncode == 0, res.stderr
+    assert res.stdout.split() == ["10", "20", "30", "40"]
