@@ -1,0 +1,73 @@
+/* Core C ABI walkthrough (include/mxnet_tpu/c_api.h): create arrays,
+ * chain operator invokes by name, save/load the checkpoint container,
+ * and read the result back — the calls every non-Python frontend sits
+ * on (ref parity: the NDArray/op/symbol groups of include/mxnet/c_api.h).
+ *
+ * Build (after `python -c "from mxnet_tpu.io_native import get_capi_lib;
+ * get_capi_lib()"` has produced the .so):
+ *
+ *   gcc -O2 ndarray_ops.c -I ../../include \
+ *       ../../mxnet_tpu/io_native/libmxnet_tpu_capi.so \
+ *       -L /usr/local/lib -lpython3.12 \
+ *       -Wl,-rpath,../../mxnet_tpu/io_native -Wl,-rpath,/usr/local/lib \
+ *       -o ndarray_ops
+ *   JAX_PLATFORMS=cpu PYTHONPATH=../.. ./ndarray_ops /tmp/y.params
+ */
+#include <stdio.h>
+#include <string.h>
+#include "mxnet_tpu/c_api.h"
+
+#define CK(x)                                                   \
+  if ((x) != 0) {                                               \
+    fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError());     \
+    return 1;                                                   \
+  }
+
+int main(int argc, char **argv) {
+  const char *save_path = argc > 1 ? argv[1] : "/tmp/capi_demo.params";
+
+  /* x = [[1,2],[3,4]];  y = dot(x, x) + 0.5 */
+  mx_uint shape[2] = {2, 2};
+  NDArrayHandle x = 0;
+  CK(MXNDArrayCreateEx(shape, 2, /*cpu*/ 1, 0, 0, /*f32*/ 0, &x));
+  float vals[4] = {1, 2, 3, 4};
+  CK(MXNDArraySyncCopyFromCPU(x, vals, sizeof(vals)));
+
+  NDArrayHandle ins[2] = {x, x};
+  NDArrayHandle *outs = 0;
+  int n_out = 0;
+  CK(MXImperativeInvokeByName("dot", 2, ins, &n_out, &outs, 0, 0, 0));
+  NDArrayHandle d = outs[0];
+
+  const char *k[1] = {"scalar"};
+  const char *v[1] = {"0.5"};
+  NDArrayHandle ins2[1] = {d};
+  CK(MXImperativeInvokeByName("_plus_scalar", 1, ins2, &n_out, &outs, 1, k,
+                              v));
+  NDArrayHandle y = outs[0];
+
+  /* checkpoint-container round trip */
+  const char *keys[1] = {"arg:y"};
+  NDArrayHandle saves[1] = {y};
+  CK(MXNDArraySave(save_path, 1, saves, keys));
+
+  mx_uint nl = 0, nn = 0;
+  NDArrayHandle *loaded = 0;
+  const char **names = 0;
+  CK(MXNDArrayLoad(save_path, &nl, &loaded, &nn, &names));
+  if (nl != 1 || nn != 1 || strcmp(names[0], "arg:y") != 0) {
+    fprintf(stderr, "FAIL load metadata\n");
+    return 1;
+  }
+  float out[4];
+  CK(MXNDArraySyncCopyToCPU(loaded[0], out, sizeof(out)));
+  /* dot([[1,2],[3,4]], itself) + 0.5 = [[7.5,10.5],[15.5,22.5]] */
+  printf("y = [[%g, %g], [%g, %g]]\n", out[0], out[1], out[2], out[3]);
+
+  MXNDArrayFree(loaded[0]);
+  MXNDArrayFree(y);
+  MXNDArrayFree(d);
+  MXNDArrayFree(x);
+  printf("ok\n");
+  return 0;
+}

@@ -61,7 +61,9 @@ def copy_from_cpu(arr, src_addr, nbytes):
         raise MXNetError("SyncCopyFromCPU: size mismatch (want %d bytes, "
                          "got %d)" % (want, nbytes))
     buf = (ctypes.c_char * int(nbytes)).from_address(int(src_addr))
-    view = np.frombuffer(bytes(buf), dtype=dtype).reshape(arr.shape)
+    # frombuffer reads through the buffer protocol copy-free; the single
+    # necessary copy happens in the assignment below
+    view = np.frombuffer(buf, dtype=dtype).reshape(arr.shape)
     arr[:] = view
 
 

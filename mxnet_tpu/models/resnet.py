@@ -117,14 +117,10 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     return sym.SoftmaxOutput(data=fc1, name="softmax")
 
 
-def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
-               dtype="float32", **kwargs):
-    """Build a ResNet symbol by depth for the given image shape."""
-    image_shape = [int(x) for x in image_shape.split(",")] \
-        if isinstance(image_shape, str) else list(image_shape)
-    nchannel, height, width = image_shape
+def depth_config(num_layers, height):
+    """(units, filter_list, bottle_neck) for a given depth and input size;
+    shared by the v1 (models/resnet_v1.py) and v2 builders."""
     if height <= 28:
-        num_stages = 3
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
             per_unit = [(num_layers - 2) // 9]
             filter_list = [16, 64, 128, 256]
@@ -136,7 +132,7 @@ def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
         else:
             raise ValueError("no experiments done on num_layers %d" %
                              num_layers)
-        units = per_unit * num_stages
+        units = per_unit * 3
     else:
         if num_layers >= 50:
             filter_list = [64, 256, 512, 1024, 2048]
@@ -144,7 +140,6 @@ def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
         else:
             filter_list = [64, 64, 128, 256, 512]
             bottle_neck = False
-        num_stages = 4
         unit_map = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                     101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
                     200: [3, 24, 36, 3], 269: [3, 30, 48, 8]}
@@ -152,6 +147,17 @@ def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
             raise ValueError("no experiments done on num_layers %d" %
                              num_layers)
         units = unit_map[num_layers]
+    return units, filter_list, bottle_neck
+
+
+def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
+               dtype="float32", **kwargs):
+    """Build a ResNet symbol by depth for the given image shape."""
+    image_shape = [int(x) for x in image_shape.split(",")] \
+        if isinstance(image_shape, str) else list(image_shape)
+    nchannel, height, width = image_shape
+    units, filter_list, bottle_neck = depth_config(num_layers, height)
+    num_stages = len(units)
 
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,

@@ -110,8 +110,15 @@ PyObject *uint_tuple(const mx_uint *data, mx_uint n) {
 
 PyObject *str_list(const char **data, int n) {
   PyObject *l = PyList_New(n);
-  for (int i = 0; i < n; ++i)
-    PyList_SET_ITEM(l, i, PyUnicode_FromString(data[i] ? data[i] : ""));
+  for (int i = 0; i < n; ++i) {
+    const char *c = data[i] ? data[i] : "";
+    PyObject *u = PyUnicode_FromString(c);
+    if (!u) {  // non-UTF-8 bytes: fall back to latin-1 (never fails)
+      PyErr_Clear();
+      u = PyUnicode_DecodeLatin1(c, (Py_ssize_t)std::strlen(c), nullptr);
+    }
+    PyList_SET_ITEM(l, i, u);
+  }
   return l;
 }
 
@@ -124,6 +131,7 @@ void stash_str_list(PyObject *list, std::vector<std::string> &strings,
   strings.reserve((size_t)n);
   for (Py_ssize_t i = 0; i < n; ++i) {
     const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (s == nullptr) PyErr_Clear();  // never leave a pending exception
     strings.emplace_back(s ? s : "");
   }
   cstrs.clear();

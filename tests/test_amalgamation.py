@@ -187,6 +187,26 @@ def test_model_zoo_resnet18(lib, tmp_path):
     assert (got.argmax(1) == want.argmax(1)).all()
 
 
+def test_model_zoo_mobilenet(lib, tmp_path):
+    """MobileNet: exercises the depthwise (num_group == channels) conv
+    path of the single-file interpreter."""
+    from mxnet_tpu.models import mobilenet
+    sym = mobilenet.get_symbol(num_classes=6, alpha=0.25)
+    shape = (2, 3, 32, 32)
+    exe, rng = _init_exe(sym, shape, seed=3)
+    blob = _params_blob(exe, tmp_path)
+
+    x = rng.uniform(0, 1, shape).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    want = exe.forward(is_train=False)[0].asnumpy()
+
+    h = _create(lib, sym, blob, {"data": shape})
+    got = _forward(lib, h, "data", x)
+    lib.MXPredFree(h)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-4)
+    assert (got.argmax(1) == want.argmax(1)).all()
+
+
 def test_output_shape_before_forward(lib, tmp_path):
     """GetOutputShape must be valid straight after create (C hosts size
     their buffers before the first Forward)."""

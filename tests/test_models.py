@@ -114,3 +114,95 @@ def test_baseline_symbol_families_forward():
         assert out.shape == (1, 7)
         assert np.isfinite(out).all()
         assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)  # softmax head
+
+
+def _fwd_smoke(sym, dshape, n_cls):
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=dshape)
+    rng = np.random.RandomState(1)
+    for name, arr in exe.arg_dict.items():
+        if name != "softmax_label":
+            arr[:] = rng.normal(0, 0.05, arr.shape).astype(np.float32)
+    for name, arr in exe.aux_dict.items():
+        # sane inference statistics: unit variance, zero mean (a zero
+        # moving_var would amplify ~sqrt(1/eps)x per BN layer and overflow
+        # 50-deep nets)
+        arr[:] = (np.ones if "var" in name else np.zeros)(
+            arr.shape, np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (dshape[0], n_cls)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_googlenet_symbol():
+    from mxnet_tpu.models import googlenet
+    sym = googlenet.get_symbol(num_classes=1000)
+    _, out, _ = sym.infer_shape(data=(2, 3, 224, 224))
+    assert out[0] == (2, 1000)
+    _fwd_smoke(googlenet.get_symbol(num_classes=7), (1, 3, 64, 64), 7)
+
+
+def test_mobilenet_symbol():
+    from mxnet_tpu.models import mobilenet
+    sym = mobilenet.get_symbol(num_classes=1000)
+    arg_shapes, out, _ = sym.infer_shape(data=(2, 3, 224, 224))
+    assert out[0] == (2, 1000)
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    assert shapes["sep1_dw_weight"] == (32, 1, 3, 3)  # depthwise: (C,1,3,3)
+    _fwd_smoke(mobilenet.get_symbol(num_classes=5, alpha=0.25),
+               (1, 3, 32, 32), 5)
+
+
+def test_resnet_v1_symbol():
+    from mxnet_tpu.models import resnet_v1
+    sym = resnet_v1.get_symbol(num_classes=1000, num_layers=50,
+                               image_shape="3,224,224")
+    _, out, _ = sym.infer_shape(data=(2, 3, 224, 224))
+    assert out[0] == (2, 1000)
+    _fwd_smoke(resnet_v1.get_symbol(num_classes=4, num_layers=18,
+                                    image_shape="3,32,32"),
+               (1, 3, 32, 32), 4)
+
+
+def test_resnext_symbol():
+    from mxnet_tpu.models import resnext
+    sym = resnext.get_symbol(num_classes=1000, num_layers=50,
+                             image_shape="3,224,224")
+    shapes, out, _ = sym.infer_shape(data=(2, 3, 224, 224))
+    assert out[0] == (2, 1000)
+    sdict = dict(zip(sym.list_arguments(), shapes))
+    # ResNeXt-50 32x4d: stage-1 grouped conv is 128-wide, 32 groups
+    assert sdict["stage1_unit1_conv2_weight"] == (128, 4, 3, 3)
+    _fwd_smoke(resnext.get_symbol(num_classes=4, num_layers=50,
+                                  image_shape="3,64,64"),
+               (1, 3, 64, 64), 4)
+
+
+def test_inception_v4_symbol_shapes():
+    from mxnet_tpu.models import inception_v4
+    sym = inception_v4.get_symbol(num_classes=1000)
+    _, out, _ = sym.infer_shape(data=(2, 3, 299, 299))
+    assert out[0] == (2, 1000)
+
+
+def test_inception_resnet_v2_symbol_shapes():
+    from mxnet_tpu.models import inception_resnet_v2
+    sym = inception_resnet_v2.get_symbol(num_classes=1000)
+    _, out, _ = sym.infer_shape(data=(2, 3, 299, 299))
+    assert out[0] == (2, 1000)
+
+
+def test_new_symbol_models_train_step():
+    """One fused train step on the cheapest new family: the train-mode
+    path (BN batch stats, s2d stem rewrite) compiles and runs."""
+    from mxnet_tpu.models import mobilenet
+    sym = mobilenet.get_symbol(num_classes=3, alpha=0.25)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (8, 3, 32, 32)).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4)
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01})
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=4),
+                    mx.metric.Accuracy())
+    assert 0.0 <= dict(acc)["accuracy"] <= 1.0
