@@ -61,10 +61,11 @@ def copy_from_cpu(arr, src_addr, nbytes):
         raise MXNetError("SyncCopyFromCPU: size mismatch (want %d bytes, "
                          "got %d)" % (want, nbytes))
     buf = (ctypes.c_char * int(nbytes)).from_address(int(src_addr))
-    # frombuffer reads through the buffer protocol copy-free; the single
-    # necessary copy happens in the assignment below
+    # one explicit owned copy: the assignment below may zero-copy alias on
+    # the CPU backend, and the C caller is free to reuse its buffer the
+    # moment this returns — the ABI's contract is copy-on-call
     view = np.frombuffer(buf, dtype=dtype).reshape(arr.shape)
-    arr[:] = view
+    arr[:] = view.copy()
 
 
 def copy_to_cpu(arr, dst_addr, nbytes):

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import pickle
 
+import numpy as np
+
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros as nd_zeros
 from .. import optimizer as opt
@@ -28,6 +30,8 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._merge_owner = {}   # key -> merge-buffer context ('device')
+        self._owner_load = {}    # context -> assigned bytes
 
     @property
     def type(self):
@@ -48,22 +52,55 @@ class KVStore:
                 raise MXNetError("key %r already initialized" % (k,))
             self._stored[k] = v.copy() if isinstance(v, NDArray) else v
 
-    def _reduce(self, vals):
+    def _merge_ctx(self, key, vals):
+        """Merge-buffer owner for a key.  'device' stores spread keys
+        across the participating devices, least-loaded-first by byte count
+        (ref: CommDevice::InitMergeBuffer, comm.h:731 — the scatter that
+        keeps one GPU from serializing every reduction); 'local' stores
+        keep the reference's stage-on-one-context behavior."""
+        if "device" not in self._type:
+            return vals[0].context
+        owner = self._merge_owner.get(key)
+        if owner is None:
+            ctxs = list(dict.fromkeys(v.context for v in vals))
+            owner = min(ctxs, key=lambda c: self._owner_load.get(c, 0))
+            nbytes = int(np.prod(vals[0].shape)) \
+                * np.dtype(vals[0].dtype).itemsize
+            self._owner_load[owner] = \
+                self._owner_load.get(owner, 0) + nbytes
+            self._merge_owner[key] = owner
+        return owner
+
+    def _reduce(self, vals, key=None):
         if isinstance(vals, NDArray):
             return vals
         if len(vals) == 1:
             return vals[0]
-        # device-style reduce: accumulate on the first device's context
-        ctx0 = vals[0].context
-        acc = vals[0].copy()
-        for v in vals[1:]:
-            acc += v.as_in_context(ctx0)
-        return acc
+        if any(getattr(v, "stype", "default") != "default" for v in vals):
+            # sparse values keep the simple serial accumulate
+            ctx0 = vals[0].context
+            acc = vals[0].copy()
+            for v in vals[1:]:
+                acc += v.as_in_context(ctx0)
+            return acc
+        owner = self._merge_ctx(key, vals)
+        # copies to the owner dispatch in parallel; the adds form a
+        # balanced tree so the dependency chain is log2(n) deep (the
+        # engine/XLA overlaps independent pair-sums)
+        moved = [v if v.context == owner else v.as_in_context(owner)
+                 for v in vals]
+        while len(moved) > 1:
+            nxt = [moved[i] + moved[i + 1]
+                   for i in range(0, len(moved) - 1, 2)]
+            if len(moved) % 2:
+                nxt.append(moved[-1])
+            moved = nxt
+        return moved[0]
 
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            merged = self._reduce(v)
+            merged = self._reduce(v, key=k)
             stored = self._stored.get(k)
             if stored is None:
                 raise MXNetError("key %r has not been initialized" % (k,))

@@ -199,7 +199,7 @@ class DistKVStore(KVStore):
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            merged = self._reduce(v)  # local devices first
+            merged = self._reduce(v, key=k)  # local devices first
             if self._gc is not None:
                 codes = self._gc.quantize(k, merged._h.array)
                 deq = self._gc.dequantize(codes, merged.shape,

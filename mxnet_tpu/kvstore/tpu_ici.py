@@ -109,7 +109,7 @@ class TpuIciKVStore(KVStore):
     def num_workers(self):
         return jax.process_count()
 
-    def _reduce(self, vals):
+    def _reduce(self, vals, key=None):
         if isinstance(vals, NDArray):
             return vals
         if len(vals) == 1:

@@ -346,3 +346,32 @@ def test_image_record_iter_shuffle_and_shard(tmp_path):
                                std_b=255.)
     b = next(iter(it))
     assert float(np.abs(b.data[0].asnumpy()).max()) <= 1.0
+
+
+@pytest.mark.fast
+def test_device_store_spreads_merge_owners():
+    """'device' stores scatter per-key merge buffers across devices
+    (ref: CommDevice::InitMergeBuffer comm.h:731) instead of serializing
+    every reduction through one context; the reduce itself is a balanced
+    tree and stays numerically exact."""
+    kv = mx.kv.create("device")
+    ctxs = [mx.cpu(i) for i in range(4)]
+    rng = np.random.RandomState(0)
+    keys = ["w%d" % i for i in range(8)]
+    vals = {}
+    for k in keys:
+        base = rng.normal(0, 1, (16, 4)).astype(np.float32)
+        vals[k] = [mx.nd.array(base + i, ctx=c) for i, c in enumerate(ctxs)]
+        kv.init(k, mx.nd.zeros((16, 4), ctx=ctxs[0]))
+    for k in keys:
+        kv.push(k, vals[k])
+    # numerics: sum of the four device copies
+    for k in keys:
+        out = mx.nd.zeros((16, 4), ctx=ctxs[0])
+        kv.pull(k, out=out)
+        want = sum(v.asnumpy() for v in vals[k])
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    # ownership spread: 8 equal-size keys over 4 devices -> every context
+    # owns at least one merge buffer
+    owners = set(kv._merge_owner.values())
+    assert len(owners) == len(ctxs), kv._merge_owner
