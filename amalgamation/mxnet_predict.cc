@@ -743,7 +743,14 @@ class Interp {
       long known = 1, minus_one = -1;
       for (size_t d = 0; d < spec.size(); ++d) {
         long s = spec[d];
-        if (s == 0) s = x.shape[d];
+        if (s == 0) {
+          if (d >= x.shape.size())
+            throw std::runtime_error(
+                "Reshape " + n.name + ": spec code 0 at position " +
+                std::to_string(d) + " but input has only " +
+                std::to_string(x.shape.size()) + " dims");
+          s = x.shape[d];
+        }
         if (s == -1) { minus_one = (long)ns.size(); ns.push_back(1); continue; }
         if (s < -1)
           throw std::runtime_error("Reshape: unsupported spec code " +

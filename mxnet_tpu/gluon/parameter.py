@@ -1,15 +1,21 @@
-"""Gluon Parameter / ParameterDict (ref: python/mxnet/gluon/parameter.py:43).
+"""Gluon Parameter / ParameterDict.
 
-Deferred initialization, per-context replicas and grad_req semantics follow
-the reference; data lives in jax.Arrays via NDArray handles.
+API parity with the reference (python/mxnet/gluon/parameter.py) on a
+different internal design: each Parameter owns a flat list of per-context
+*replica slots* (context, data, grad) instead of parallel ctx-keyed dicts,
+and deferred initialization is a single pending-record consumed either by
+the first forward (shape now known) or by loading saved values. Data
+lives in jax.Arrays behind NDArray handles; replicas on a TPU mesh are
+what the kvstore all-reduces over ICI.
 """
 from __future__ import annotations
 
-from collections import OrderedDict
+import warnings
+from collections import OrderedDict, namedtuple
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, np_dtype
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray, zeros as nd_zeros, array as nd_array
 from .. import autograd
@@ -18,307 +24,361 @@ from ..initializer import InitDesc
 
 
 class DeferredInitializationError(MXNetError):
-    """Error for unfinished deferred initialization."""
+    """Raised when a deferred Parameter is touched before its first forward."""
+
+
+# A deferred-init record: which initializer to run, on which contexts,
+# which fallback to use when ``init`` is None, and an optional concrete
+# payload (set when values were loaded before the shape was known).
+_Pending = namedtuple("_Pending", ["init", "contexts", "fallback", "payload"])
+
+_GRAD_REQS = ("write", "add", "null")
+
+
+def _as_context_list(ctx):
+    if ctx is None:
+        return None
+    if isinstance(ctx, Context):
+        return [ctx]
+    return list(ctx)
+
+
+def _shapes_compatible(want, have):
+    """Merge two shapes where 0 is a wildcard; None if they conflict."""
+    if want is None:
+        return tuple(have)
+    if len(want) != len(have):
+        return None
+    merged = []
+    for w, h in zip(want, have):
+        if w and h and w != h:
+            return None
+        merged.append(w or h)
+    return tuple(merged)
 
 
 class Parameter:
-    """A container holding parameter blocks on one or more contexts."""
+    """One logical tensor, replicated across one or more contexts.
+
+    ``grad_req`` chooses gradient bookkeeping: 'write' (fresh each
+    backward), 'add' (accumulate; caller zero_grads), 'null' (no grad).
+    """
 
     def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
-                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype="default", grad_stype="default"):
-        self._var = None
-        self._data = None
-        self._grad = None
-        self._ctx_list = None
-        self._ctx_map = None
-        self._deferred_init = ()
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
         self.name = name
-        self._differentiable = differentiable
+        self._slots = None          # list of [ctx, data, grad] after init
+        self._pending = None        # _Pending while deferred
+        self._var = None
         self._allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
         self._grad_req = None
-        if isinstance(shape, int):
-            shape = (shape,)
-        self.shape = shape
+        self.shape = (shape,) if isinstance(shape, int) else shape
         self.dtype = dtype
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
         self.grad_req = grad_req
         if isinstance(init, str):
-            # accept registry names ("zeros", "xavier", ...) anywhere an
-            # initializer is expected (ref: mx.init registry semantics)
-            from ..initializer import _INITIALIZER_REGISTRY
-            klass = _INITIALIZER_REGISTRY.get(init.lower())
-            if klass is None:
-                raise ValueError("unknown initializer %r" % init)
-            init = klass()
+            init = init_mod.create(init)
         self.init = init
 
     def __repr__(self):
-        s = "Parameter {name} (shape={shape}, dtype={dtype})"
-        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+        return "Parameter {} (shape={}, dtype={})".format(
+            self.name, self.shape, self.dtype)
 
+    # -- grad_req --------------------------------------------------------
     @property
     def grad_req(self):
         return self._grad_req
 
     @grad_req.setter
     def grad_req(self, req):
-        assert req in ("write", "add", "null"), \
-            "grad_req must be one of 'write', 'add', or 'null', but got %s" % req
+        if req not in _GRAD_REQS:
+            raise AssertionError(
+                "grad_req must be one of %s, but got %s" % (_GRAD_REQS, req))
         if not self._differentiable:
             req = "null"
-        if self._grad_req == req:
+        if req == self._grad_req:
             return
         self._grad_req = req
-        if req == "null" and self._grad is not None:
-            self._grad = None
-            if self._data is not None:
-                for d in self._data.values():
-                    d._grad = None
-        elif self._data is not None:
-            self._init_grad()
-
-    def _check_and_get(self, arr_dict, ctx):
-        if arr_dict is not None:
-            if ctx is list:
-                return list(arr_dict.values())
-            if ctx is None:
-                if len(arr_dict) == 1:
-                    return list(arr_dict.values())[0]
-                ctx = current_context()
-            if ctx in arr_dict:
-                return arr_dict[ctx]
-            raise RuntimeError(
-                "Parameter %s was not initialized on context %s. "
-                "It was only initialized on %s." %
-                (self.name, str(ctx), str(self._ctx_list)))
-        if self._deferred_init:
-            raise DeferredInitializationError(
-                "Parameter %s has not been initialized yet because "
-                "initialization was deferred. Actual initialization happens "
-                "during the first forward pass." % self.name)
-        raise RuntimeError(
-            "Parameter %s has not been initialized. Note that you should "
-            "initialize parameters and create Trainer with Block.collect_params() "
-            "instead of Block.params because the later does not include "
-            "Parameters of nested child Blocks" % self.name)
-
-    def _load_init(self, data, ctx):
-        if self.shape:
-            for self_dim, data_dim in zip(self.shape, data.shape):
-                assert self_dim == 0 or self_dim == data_dim, \
-                    "Failed loading Parameter %s from saved params: " \
-                    "shape incompatible expected %s vs saved %s" % (
-                        self.name, str(self.shape), str(data.shape))
-        if self.dtype is not None:
-            from ..base import np_dtype
-            want = np_dtype(self.dtype)
-            if np_dtype(data.dtype) != want:
-                data = data.astype(want)
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if self._data is None:
-            if self._deferred_init:
-                assert ctx is None or set(ctx) == set(self._deferred_init[1]), \
-                    "Failed to load Parameter %s on %s because it was " \
-                    "previous initialized on %s." % (
-                        self.name, str(ctx), str(self.list_ctx()))
-                ctx = self._deferred_init[1]
-            elif ctx is None:
-                ctx = [cpu()]
-            self._init_impl(data, ctx)
+        if self._slots is None:
+            return
+        if req == "null":
+            for slot in self._slots:
+                slot[2] = None
+                slot[1]._grad = None
         else:
-            assert ctx is None or set(ctx) == set(self.list_ctx()), \
-                "Failed to load Parameter %s on %s because it was " \
-                "previous initialized on %s." % (
-                    self.name, str(ctx), str(self.list_ctx()))
-            self.set_data(data)
-        self._deferred_init = ()
+            self._attach_grads()
+
+    # -- backwards-compat spellings used across the package --------------
+    @property
+    def _deferred_init(self):
+        return self._pending or ()
+
+    @property
+    def _data(self):
+        """ctx→data view of the replica slots (None before init)."""
+        if self._slots is None:
+            return None
+        return OrderedDict((slot[0], slot[1]) for slot in self._slots)
 
     def _finish_deferred_init(self):
-        if not self._deferred_init:
-            return
-        init, ctx, default_init, data = self._deferred_init
-        self._deferred_init = ()
-        assert self.shape is not None and np.prod(self.shape) > 0, \
-            "Cannot initialize Parameter %s because it has invalid shape: %s." \
-            % (self.name, str(self.shape))
-        with autograd.pause():
-            if data is None:
-                data = nd_zeros(self.shape, ctx=cpu(), dtype=self.dtype)
-                (init if init is not None else default_init)(
-                    InitDesc(self.name, {"__init__": ""}), data)
-            self._init_impl(data, ctx)
+        self._materialize()
 
-    def _init_impl(self, data, ctx_list):
-        self._ctx_list = list(ctx_list)
-        self._ctx_map = {ctx: i for i, ctx in enumerate(self._ctx_list)}
-        if not isinstance(data, NDArray):
-            data = nd_array(data, dtype=self.dtype)
-        self._data = OrderedDict(
-            (ctx, data.copyto(ctx)) for ctx in self._ctx_list)
-        self.shape = tuple(data.shape)
-        self._init_grad()
-
-    def _init_grad(self):
-        if self.grad_req == "null":
-            self._grad = None
-            return
-        self._grad = OrderedDict(
-            (ctx, nd_zeros(self.shape, ctx=ctx, dtype=self.dtype))
-            for ctx in self._ctx_list)
-        for ctx in self._ctx_list:
-            d = self._data[ctx]
-            autograd.mark_variables([d], [self._grad[ctx]], self.grad_req)
-
-    def _reduce(self):
-        """Average gradients/data from all contexts to cpu."""
-        data = self.list_data()
-        out = data[0].copyto(cpu())
-        for d in data[1:]:
-            out += d.copyto(cpu())
-        out /= len(data)
-        return out
-
+    # -- initialization --------------------------------------------------
     def initialize(self, init=None, ctx=None, default_init=None,
                    force_reinit=False):
         if default_init is None:
             default_init = init_mod.Uniform()
-        if self._data is not None and not force_reinit:
-            import warnings
-            warnings.warn("Parameter %s is already initialized, ignoring. "
-                          "Set force_reinit=True to re-initialize." % self.name,
-                          stacklevel=2)
+        if self._slots is not None and not force_reinit:
+            warnings.warn(
+                "Parameter %s is already initialized, ignoring. "
+                "Set force_reinit=True to re-initialize." % self.name,
+                stacklevel=2)
             return
-        self._data = self._grad = None
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if init is None:
-            init = default_init if self.init is None else self.init
-        if self.shape is None or np.prod(self.shape) <= 0:
-            if self._allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
-            raise ValueError("Cannot initialize Parameter %s because it has "
-                             "invalid shape: %s." % (self.name, str(self.shape)))
-        self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
+        if not self._shape_known() and not self._allow_deferred_init:
+            raise ValueError(
+                "Parameter %s has unknown shape %s and deferred init is "
+                "not allowed; pass the shape or run a forward first"
+                % (self.name, (self.shape,)))
+        self._slots = None
+        contexts = _as_context_list(ctx) or [current_context()]
+        # keep the *explicit* choice (call-level or param-level) distinct
+        # from the fallback: explicit initializers apply as the weight
+        # rule; the fallback goes through name-suffix dispatch so
+        # gamma/beta/moving stats land on their canonical constants
+        explicit = init if init is not None else self.init
+        self._pending = _Pending(explicit, contexts, default_init, None)
+        if self._shape_known():
+            self._materialize()
 
-    def reset_ctx(self, ctx):
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if self._data:
-            data = self._reduce()
-            with autograd.pause():
-                self._init_impl(data, ctx)
-        elif self._deferred_init:
-            init, _, default_init, data = self._deferred_init
-            self._deferred_init = (init, ctx, default_init, data)
+    def _shape_known(self):
+        return self.shape is not None and int(np.prod(self.shape)) > 0
+
+    def _materialize(self):
+        """Consume the pending record: build data + grads on every ctx."""
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        if not self._shape_known():
+            raise AssertionError(
+                "Parameter %s still has unknown shape %s at materialize "
+                "time" % (self.name, (self.shape,)))
+        with autograd.pause():
+            payload = pending.payload
+            if payload is None:
+                payload = nd_zeros(self.shape, ctx=cpu(), dtype=self.dtype)
+                explicit = pending.init
+                if explicit is None:
+                    # no explicit choice: suffix dispatch on the fallback
+                    pending.fallback(
+                        InitDesc(self.name, {"__init__": ""}), payload)
+                elif isinstance(explicit, init_mod.Initializer):
+                    # an explicitly chosen initializer applies as the
+                    # weight rule whatever the name
+                    explicit._init_weight(
+                        InitDesc(self.name, {"__init__": ""}), payload)
+                else:  # Load / Mixed route by name
+                    explicit(self.name, payload)
+            self._place(payload, pending.contexts)
+
+    def _place(self, value, contexts):
+        """Replicate ``value`` onto ``contexts`` and attach gradients."""
+        if not isinstance(value, NDArray):
+            value = nd_array(value, dtype=self.dtype)
+        self.shape = tuple(value.shape)
+        self._slots = [[ctx, value.copyto(ctx), None] for ctx in contexts]
+        self._attach_grads()
+
+    def _attach_grads(self):
+        if self.grad_req == "null":
+            return
+        for slot in self._slots:
+            grad = nd_zeros(self.shape, ctx=slot[0], dtype=self.dtype)
+            slot[2] = grad
+            autograd.mark_variables([slot[1]], [grad], self.grad_req)
+
+    def _load_init(self, data, ctx):
+        """Fill from a loaded array, validating shape/ctx agreement."""
+        if self.shape and _shapes_compatible(self.shape, data.shape) is None:
+            raise AssertionError(
+                "loaded value for Parameter %s has shape %s but %s is "
+                "required" % (self.name, data.shape, (self.shape,)))
+        if self.dtype is not None and \
+                np_dtype(data.dtype) != np_dtype(self.dtype):
+            data = data.astype(np_dtype(self.dtype))
+        contexts = _as_context_list(ctx)
+        if self._slots is not None:
+            if contexts is not None and \
+                    set(contexts) != set(self.list_ctx()):
+                raise AssertionError(
+                    "cannot load Parameter %s on %s: it already lives on %s"
+                    % (self.name, contexts, self.list_ctx()))
+            self.set_data(data)
         else:
-            raise ValueError("Cannot reset context for Parameter %s because it "
-                             "has not been initialized." % self.name)
+            if self._pending:
+                if contexts is not None and \
+                        set(contexts) != set(self._pending.contexts):
+                    raise AssertionError(
+                        "cannot load Parameter %s on %s: it already lives "
+                        "on %s" % (self.name, contexts, self.list_ctx()))
+                contexts = self._pending.contexts
+            self._place(data, contexts or [cpu()])
+        self._pending = None
 
-    def set_data(self, data):
-        assert self._data is not None, \
-            "Parameter %s has not been initialized" % self.name
-        for arr in self._data.values():
-            if isinstance(data, NDArray):
-                data.copyto(arr)
-            else:
-                arr[:] = data
+    # -- accessors -------------------------------------------------------
+    def _slot_for(self, ctx):
+        if self._slots is None:
+            if self._pending is not None:
+                raise DeferredInitializationError(
+                    "Parameter %s awaits deferred initialization; it gets "
+                    "a shape (and values) on the first forward pass"
+                    % self.name)
+            raise RuntimeError(
+                "Parameter %s has not been initialized. Initialize via "
+                "Block.collect_params().initialize(...) — note that "
+                "Block.params alone omits the children's parameters"
+                % self.name)
+        if ctx is None:
+            if len(self._slots) == 1:
+                return self._slots[0]
+            ctx = current_context()
+        for slot in self._slots:
+            if slot[0] == ctx:
+                return slot
+        raise RuntimeError(
+            "Parameter %s was not initialized on context %s. "
+            "It was only initialized on %s."
+            % (self.name, ctx, self.list_ctx()))
+
+    def _require_grad(self):
+        if self._slots is not None and self.grad_req == "null":
+            raise RuntimeError(
+                "Parameter %s carries no gradient because grad_req='null'"
+                % self.name)
 
     def data(self, ctx=None):
-        return self._check_and_get(self._data, ctx)
-
-    def list_data(self):
-        return self._check_and_get(self._data, list)
+        return self._slot_for(ctx)[1]
 
     def grad(self, ctx=None):
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter %s "
-                "because grad_req='null'" % self.name)
-        return self._check_and_get(self._grad, ctx)
+        self._require_grad()
+        return self._slot_for(ctx)[2]
+
+    def list_data(self):
+        if self._slots is None:
+            self._slot_for(None)  # raises the initialization error
+        return [slot[1] for slot in self._slots]
 
     def list_grad(self):
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter %s "
-                "because grad_req='null'" % self.name)
-        return self._check_and_get(self._grad, list)
+        self._require_grad()
+        if self._slots is None:
+            self._slot_for(None)
+        return [slot[2] for slot in self._slots]
 
     def list_ctx(self):
-        if self._data is None:
-            if self._deferred_init:
-                return self._deferred_init[1]
-            raise RuntimeError("Parameter %s has not been initialized" % self.name)
-        return self._ctx_list
+        if self._slots is None:
+            if self._pending is not None:
+                return self._pending.contexts
+            raise RuntimeError(
+                "Parameter %s has not been initialized" % self.name)
+        return [slot[0] for slot in self._slots]
+
+    # -- mutation --------------------------------------------------------
+    def set_data(self, data):
+        if self._slots is None:
+            raise AssertionError(
+                "Parameter %s has not been initialized" % self.name)
+        for slot in self._slots:
+            if isinstance(data, NDArray):
+                data.copyto(slot[1])
+            else:
+                slot[1][:] = data
 
     def zero_grad(self):
-        if self._grad is None:
+        if self._slots is None:
             return
-        for g in self._grad.values():
-            g[:] = 0
+        for slot in self._slots:
+            if slot[2] is not None:
+                slot[2][:] = 0
+
+    def reset_ctx(self, ctx):
+        contexts = _as_context_list(ctx) or [current_context()]
+        if self._slots is not None:
+            merged = self._reduce()
+            with autograd.pause():
+                self._place(merged, contexts)
+        elif self._pending is not None:
+            self._pending = self._pending._replace(contexts=contexts)
+        else:
+            raise ValueError(
+                "Parameter %s cannot move to a new context before it is "
+                "initialized" % self.name)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._slots is None:
+            return
+        with autograd.pause():
+            for slot in self._slots:
+                slot[1] = slot[1].astype(dtype)
+                slot[2] = None
+            self._attach_grads()
+
+    def _reduce(self):
+        """Mean of all replicas, on cpu (the checkpoint representation)."""
+        replicas = self.list_data()
+        total = replicas[0].copyto(cpu())
+        for other in replicas[1:]:
+            total += other.copyto(cpu())
+        return total / len(replicas)
 
     def var(self):
         if self._var is None:
             from .. import symbol
-            self._var = symbol.var(self.name, shape=self.shape,
-                                   dtype=self.dtype, lr_mult=self.lr_mult,
-                                   wd_mult=self.wd_mult, init=self.init)
+            self._var = symbol.var(
+                self.name, shape=self.shape, dtype=self.dtype,
+                lr_mult=self.lr_mult, wd_mult=self.wd_mult, init=self.init)
         return self._var
-
-    def cast(self, dtype):
-        self.dtype = dtype
-        if self._data is None:
-            return
-        with autograd.pause():
-            self._data = OrderedDict(
-                (ctx, d.astype(dtype)) for ctx, d in self._data.items())
-            if self._grad is not None:
-                self._init_grad()
 
 
 class Constant(Parameter):
-    """A constant parameter (grad_req='null')."""
+    """A non-trainable Parameter pinned to a fixed value."""
 
     def __init__(self, name, value):
         if not isinstance(value, NDArray):
             value = nd_array(value)
         self.value = value
 
-        class Init(init_mod.Initializer):
+        class _Pinned(init_mod.Initializer):
             def _init_weight(self, _, arr):
                 value.copyto(arr)
 
         super().__init__(name, grad_req="null", shape=value.shape,
-                         dtype=value.dtype, init=Init())
+                         dtype=value.dtype, init=_Pinned())
 
 
 class ParameterDict:
-    """Dictionary of Parameters (ref: parameter.py:~480)."""
+    """Ordered name→Parameter mapping with prefix and sharing semantics."""
 
     def __init__(self, prefix="", shared=None):
         self._prefix = prefix
         self._params = OrderedDict()
         self._shared = shared
 
+    # -- mapping protocol ------------------------------------------------
     def __getitem__(self, key):
         return self._params[key]
 
-    def __repr__(self):
-        s = "{name}(\n{content}\n)"
-        name = self._prefix + " " if self._prefix else ""
-        return s.format(name=name, content="\n".join(
-            [repr(v).replace("\n", "\n  ") for v in self.values()]))
-
     def __iter__(self):
         return iter(self._params)
+
+    def __repr__(self):
+        head = self._prefix + " " if self._prefix else ""
+        body = "\n".join(repr(p).replace("\n", "\n  ")
+                         for p in self.values())
+        return "{}(\n{}\n)".format(head, body)
 
     def items(self):
         return self._params.items()
@@ -333,52 +393,44 @@ class ParameterDict:
     def prefix(self):
         return self._prefix
 
-    def _get_impl(self, name):
-        if name in self._params:
-            return self._params[name]
-        if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._shared._params[name]
-        return None
+    # -- retrieval / creation --------------------------------------------
+    def _lookup(self, name):
+        """Find locally, then adopt from the shared dict."""
+        found = self._params.get(name)
+        if found is None and self._shared is not None:
+            found = self._shared._params.get(name)
+            if found is not None:
+                self._params[name] = found
+        return found
 
     def get(self, name, **kwargs):
+        """Get-or-create, reconciling attributes with any existing entry."""
         name = self._prefix + name
-        param = self._get_impl(name)
+        param = self._lookup(name)
         if param is None:
             param = Parameter(name, **kwargs)
             self._params[name] = param
-        else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == "shape" and v is not None and len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 * dim2 != 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 == 0:
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param.shape = tuple(inferred_shape)
-                            continue
-                    assert v is None or v == existing, \
-                        "Cannot retrieve Parameter %s because desired " \
-                        "attribute does not match with stored for attribute " \
-                        "%s: desired %s vs stored %s." % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
+            return param
+        for attr, want in kwargs.items():
+            have = getattr(param, attr, None)
+            if have is None:
+                setattr(param, attr, want)
+                continue
+            if attr == "shape" and want is not None:
+                merged = _shapes_compatible(tuple(want), have)
+                if merged is not None:
+                    param.shape = merged
+                    continue
+            if want is not None and want != have:
+                raise AssertionError(
+                    "Parameter %s already exists with %s=%s; cannot "
+                    "re-get it with %s=%s"
+                    % (name, attr, have, attr, want))
         return param
 
     def get_constant(self, name, value=None):
         name = self._prefix + name
-        param = self._get_impl(name)
+        param = self._lookup(name)
         if param is None:
             if value is None:
                 raise KeyError("No constant named %s." % name)
@@ -387,68 +439,71 @@ class ParameterDict:
         return param
 
     def update(self, other):
-        for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    "Cannot update self with other because they have different " \
-                    "Parameters with the same name %s" % k
-            else:
-                self._params[k] = v
+        for name, param in other.items():
+            mine = self._params.get(name)
+            if mine is not None and mine is not param:
+                raise AssertionError(
+                    "cannot merge ParameterDicts: both hold a distinct "
+                    "Parameter named %s" % name)
+            self._params[name] = param
 
+    # -- bulk operations -------------------------------------------------
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
         if init is None:
             init = init_mod.Uniform()
         if verbose:
             init.set_verbosity(verbose=verbose)
-        for _, v in self.items():
-            v.initialize(None, ctx, init, force_reinit=force_reinit)
+        for param in self.values():
+            param.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for i in self.values():
-            i.zero_grad()
+        for param in self.values():
+            param.zero_grad()
 
     def reset_ctx(self, ctx):
-        for i in self.values():
-            i.reset_ctx(ctx)
+        for param in self.values():
+            param.reset_ctx(ctx)
 
     def setattr(self, name, value):
-        for i in self.values():
-            setattr(i, name, value)
+        for param in self.values():
+            setattr(param, name, value)
 
+    # -- persistence -----------------------------------------------------
     def save(self, filename, strip_prefix=""):
         from ..ndarray import save as nd_save
-        arg_dict = {}
+        out = {}
         for param in self.values():
-            weight = param._reduce()
             if not param.name.startswith(strip_prefix):
                 raise ValueError(
-                    "Prefix %s is to be striped before saving, but Parameter "
-                    "%s does not start with %s." % (
-                        strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd_save(filename, arg_dict)
+                    "cannot strip prefix %r: Parameter %s does not carry it"
+                    % (strip_prefix, param.name))
+            out[param.name[len(strip_prefix):]] = param._reduce()
+        nd_save(filename, out)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
         from ..ndarray import load as nd_load
         if restore_prefix:
             for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is %s but Parameter name %s does not " \
-                    "start with it" % (restore_prefix, name)
-        lprefix = len(restore_prefix)
-        loaded = nd_load(filename)
-        arg_dict = {restore_prefix + k: v for k, v in loaded.items()}
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is %r but Parameter %s does not "
+                        "start with it" % (restore_prefix, name))
+        loaded = {restore_prefix + k: v
+                  for k, v in nd_load(filename).items()}
         if not allow_missing:
-            for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter %s is missing in file %s" % (
-                        name[lprefix:], filename)
-        for name in arg_dict:
+            absent = [n for n in self.keys() if n not in loaded]
+            if absent:
+                raise AssertionError(
+                    "file %s is missing parameters %s (pass "
+                    "allow_missing=True to skip them)" % (filename, absent))
+        for name, value in loaded.items():
             if name not in self._params:
-                assert ignore_extra, \
-                    "Parameter %s loaded from file %s is not present in " \
-                    "ParameterDict" % (name[lprefix:], filename)
+                if not ignore_extra:
+                    raise AssertionError(
+                        "file %s contains %s which this ParameterDict does "
+                        "not hold (pass ignore_extra=True to drop it)"
+                        % (filename, name))
                 continue
-            self[name]._load_init(arg_dict[name], ctx)
+            self._params[name]._load_init(value, ctx)

@@ -1,38 +1,97 @@
-"""Weight initializers (ref: python/mxnet/initializer.py)."""
+"""Weight initializers.
+
+API parity with the reference registry (python/mxnet/initializer.py) on a
+different chassis: name-based dispatch is a declarative suffix→rule table
+shared by the modern (InitDesc) and legacy (bare string) entry points, and
+the kernels are vectorized numpy (e.g. the bilinear upsampling kernel is
+an outer product of two triangle profiles rather than a scalar loop).
+Initialization runs on the host by design — it happens once, before the
+jitted step, so device transfer cost is irrelevant and host numpy keeps
+the RNG independent from the on-device functional PRNG.
+"""
 from __future__ import annotations
 
 import json
 import logging
-import math
 import re
 
 import numpy as np
 
-from .base import MXNetError
-from .ndarray import NDArray, array, zeros
-from . import random as _random
-import jax
+from .ndarray import NDArray
 
 
 class InitDesc(str):
-    """Name + attrs descriptor passed to initializers."""
+    """A parameter name carrying its symbol attrs and the global default."""
 
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        self = super().__new__(cls, name)
+        self.attrs = attrs or {}
+        self.global_init = global_init
+        return self
 
 
-_INITIALIZER_REGISTRY = {}
+_REGISTRY = {}
 
 
-def register(klass):
-    _INITIALIZER_REGISTRY[klass.__name__.lower()] = klass
-    return klass
+def register(*aliases):
+    """Register an Initializer class under its lowercase name + aliases."""
+    def _add(cls, extra=()):
+        for key in (cls.__name__.lower(), *extra):
+            _REGISTRY[key] = cls
+        return cls
+
+    if len(aliases) == 1 and isinstance(aliases[0], type):
+        return _add(aliases[0])
+    return lambda cls: _add(cls, aliases)
+
+
+def _from_dumps(blob):
+    """Rebuild an initializer from its ``dumps()`` JSON blob."""
+    kind, kwargs = json.loads(blob)
+    return _REGISTRY[kind.lower()](**kwargs)
+
+
+def create(name, **kwargs):
+    """Instantiate a registered initializer by name."""
+    cls = _REGISTRY.get(str(name).lower())
+    if cls is None:
+        raise ValueError("unknown initializer %r; registered: %s"
+                         % (name, sorted(_REGISTRY)))
+    return cls(**kwargs)
+
+
+# Suffix dispatch shared by modern and legacy paths. Order matters: first
+# match wins. Each entry is (name-suffixes, handler-method-name).
+# "parameters" routes to the weight handler because fused-RNN flat vectors
+# are weights (the FusedRNN initializer unpacks them per-gate).
+_SUFFIX_RULES = (
+    (("weight", "parameters"), "_init_weight"),
+    (("bias",), "_init_bias"),
+    (("gamma",), "_init_gamma"),
+    (("beta",), "_init_beta"),
+    (("min",), "_init_zero"),
+    (("max",), "_init_one"),
+    (("moving_mean", "running_mean", "moving_avg"), "_init_zero"),
+    (("moving_var", "running_var"), "_init_one"),
+    (("moving_inv_var",), "_init_zero"),
+)
+
+# Extra prefix rules only the legacy (pre-InitDesc) path honors.
+_LEGACY_PREFIX_RULES = (
+    ("upsampling", None, "_init_bilinear"),
+    ("stn_loc", "weight", "_init_zero"),
+    ("stn_loc", "bias", "_init_loc_bias"),
+)
+
+
+def _triangle(n, f, c):
+    """1-D bilinear interpolation profile of length n."""
+    return 1.0 - np.abs(np.arange(n) / f - c)
 
 
 class Initializer:
+    """Base initializer: routes a named array to the right fill rule."""
+
     def __init__(self, **kwargs):
         self._kwargs = kwargs
         self._verbose = False
@@ -40,184 +99,148 @@ class Initializer:
 
     def set_verbosity(self, verbose=False, print_func=None):
         self._verbose = verbose
-        self._print_func = print_func or (lambda x: None)
+        self._print_func = print_func or (lambda _: None)
         return self
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def _dispatch(self, name, arr, prefix_rules=()):
+        for prefix, suffix, handler in prefix_rules:
+            if name.startswith(prefix) and \
+                    (suffix is None or name.endswith(suffix)):
+                getattr(self, handler)(name, arr)
+                return
+        for suffixes, handler in _SUFFIX_RULES:
+            if name.endswith(suffixes):
+                getattr(self, handler)(name, arr)
+                return
+        self._init_default(name, arr)
 
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
-            self._legacy_init(desc, arr)
+            # legacy entry point: bare string name
+            if not isinstance(desc, str) or not isinstance(arr, NDArray):
+                raise TypeError("name must be string, arr must be NDArray")
+            self._dispatch(desc, arr, prefix_rules=_LEGACY_PREFIX_RULES)
             return
         if desc.global_init is None:
             desc.global_init = self
-        init = desc.attrs.get("__init__", "")
-        if init:
-            klass, kwargs = json.loads(init)
-            _INITIALIZER_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
+        override = desc.attrs.get("__init__", "")
+        if override:
+            # per-parameter initializer attached via symbol attrs wins
+            _from_dumps(override)._init_weight(desc, arr)
         else:
-            if desc.endswith("weight") or desc.endswith("parameters"):
-                # "parameters" = fused-RNN flat vectors (FusedRNN initializer
-                # unpacks them per-gate; ref: mx.init.FusedRNN)
-                self._init_weight(desc, arr)
-            elif desc.endswith("bias"):
-                self._init_bias(desc, arr)
-            elif desc.endswith("gamma"):
-                self._init_gamma(desc, arr)
-            elif desc.endswith("beta"):
-                self._init_beta(desc, arr)
-            elif desc.endswith("min"):
-                self._init_zero(desc, arr)
-            elif desc.endswith("max"):
-                self._init_one(desc, arr)
-            elif desc.endswith("moving_mean") or desc.endswith("running_mean") \
-                    or desc.endswith("moving_avg"):
-                self._init_zero(desc, arr)
-            elif desc.endswith("moving_var") or desc.endswith("running_var"):
-                self._init_one(desc, arr)
-            elif desc.endswith("moving_inv_var"):
-                self._init_zero(desc, arr)
-            else:
-                self._init_default(desc, arr)
+            self._dispatch(desc, arr)
 
-    def _legacy_init(self, name, arr):
-        if not isinstance(name, str) or not isinstance(arr, NDArray):
-            raise TypeError("name must be string, arr must be NDArray")
-        if name.startswith("upsampling"):
-            self._init_bilinear(name, arr)
-        elif name.startswith("stn_loc") and name.endswith("weight"):
-            self._init_zero(name, arr)
-        elif name.startswith("stn_loc") and name.endswith("bias"):
-            self._init_loc_bias(name, arr)
-        elif name.endswith("bias"):
-            self._init_bias(name, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(name, arr)
-        elif name.endswith("beta"):
-            self._init_beta(name, arr)
-        elif name.endswith("weight"):
-            self._init_weight(name, arr)
-        elif name.endswith("moving_mean"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_var"):
-            self._init_one(name, arr)
-        elif name.endswith("moving_inv_var"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(name, arr)
-        else:
-            self._init_default(name, arr)
-
-    def _init_bilinear(self, _, arr):
-        weight = np.zeros(np.prod(arr.shape), dtype="float32")
-        shape = arr.shape
-        f = np.ceil(shape[3] / 2.0)
-        c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(np.prod(shape)):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
-
-    def _init_loc_bias(self, _, arr):
-        shape = arr.shape
-        assert shape[0] == 6
-        arr[:] = np.array([1.0, 0, 0, 0, 1.0, 0])
-
+    # -- fill rules ------------------------------------------------------
     def _init_zero(self, _, arr):
         arr[:] = 0.0
 
     def _init_one(self, _, arr):
         arr[:] = 1.0
 
-    def _init_bias(self, _, arr):
-        arr[:] = 0.0
+    _init_bias = _init_zero
+    _init_beta = _init_zero
+    _init_gamma = _init_one
 
-    def _init_gamma(self, _, arr):
-        arr[:] = 1.0
+    def _init_bilinear(self, _, arr):
+        # separable kernel: outer product of per-axis triangle profiles
+        h, w = arr.shape[2], arr.shape[3]
+        f = np.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        kernel = np.outer(_triangle(h, f, c), _triangle(w, f, c))
+        arr[:] = np.broadcast_to(
+            kernel.astype(np.float32), arr.shape)
 
-    def _init_beta(self, _, arr):
-        arr[:] = 0.0
+    def _init_loc_bias(self, _, arr):
+        assert arr.shape[0] == 6
+        arr[:] = np.array([1.0, 0, 0, 0, 1.0, 0])  # identity affine
 
     def _init_weight(self, name, arr):
-        raise NotImplementedError("Must override it")
+        raise NotImplementedError(
+            "%s does not define a weight rule" % type(self).__name__)
 
     def _init_default(self, name, _):
         raise ValueError(
-            "Unknown initialization pattern for %s." % name)
+            "no initialization rule matches parameter name %r" % str(name))
 
     def __eq__(self, other):
         if not isinstance(other, Initializer):
             return NotImplemented
-        return self.__class__ is other.__class__ and \
-            self._kwargs == other._kwargs
+        return type(self) is type(other) and self._kwargs == other._kwargs
 
 
 class Load:
-    """Initialize by loading from existing param dict."""
+    """Fill parameters from a saved dict, falling back to default_init."""
 
     def __init__(self, param, default_init=None, verbose=False):
         if isinstance(param, str):
             from .ndarray import load as nd_load
             param = nd_load(param)
-        self.param = {}
-        for name, arr in param.items():
-            if name.startswith("arg:") or name.startswith("aux:"):
-                self.param[name[4:]] = arr
-            else:
-                self.param[name] = arr
+        # strip the save-format "arg:"/"aux:" tags
+        self.param = {(k[4:] if k[:4] in ("arg:", "aux:") else k): v
+                      for k, v in param.items()}
         self.default_init = default_init
         self.verbose = verbose
 
     def __call__(self, name, arr):
-        if name in self.param:
-            if arr.shape != self.param[name].shape:
-                raise ValueError("Parameter %s cannot be initialized from "
-                                 "loading. Shape mismatch, target %s vs loaded %s"
-                                 % (name, str(arr.shape), str(self.param[name].shape)))
-            arr[:] = self.param[name]
-            if self.verbose:
-                logging.info("Initialized %s by loading", name)
-        else:
+        loaded = self.param.get(name)
+        if loaded is None:
             if self.default_init is None:
-                raise ValueError("Cannot Initialize %s. Not found in loaded "
-                                 "param and no default Initializer is provided." % name)
+                raise ValueError(
+                    "parameter %r is absent from the loaded dict and no "
+                    "default initializer was given" % name)
             self.default_init(name, arr)
+            return
+        if arr.shape != loaded.shape:
+            raise ValueError(
+                "loaded parameter %r has shape %s but the target needs %s"
+                % (name, loaded.shape, arr.shape))
+        arr[:] = loaded
+        if self.verbose:
+            logging.info("Initialized %s by loading", name)
 
 
 class Mixed:
+    """First-matching-regex dispatch over a list of initializers."""
+
     def __init__(self, patterns, initializers):
         assert len(patterns) == len(initializers)
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, init in self.map:
-            if prog.match(name):
+        for pattern, init in self.map:
+            if pattern.match(name):
                 init(name, arr)
                 return
-        raise ValueError("Parameter name %s did not match any pattern." % name)
+        raise ValueError(
+            "parameter name %r matched none of the Mixed patterns" % name)
 
 
-@register
+# ---------------------------------------------------------------------------
+# constant fills
+
+@register("zeros")
 class Zero(Initializer):
     def __init__(self):
         super().__init__()
 
     def _init_weight(self, _, arr):
-        arr[:] = 0
+        arr[:] = 0.0
 
 
 zeros_init = Zero
 
 
-@register
+@register("ones")
 class One(Initializer):
     def __init__(self):
         super().__init__()
 
     def _init_weight(self, _, arr):
-        arr[:] = 1
+        arr[:] = 1.0
 
 
 @register
@@ -230,6 +253,9 @@ class Constant(Initializer):
         arr[:] = self.value
 
 
+# ---------------------------------------------------------------------------
+# random fills
+
 @register
 class Uniform(Initializer):
     def __init__(self, scale=0.07):
@@ -237,7 +263,8 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape).astype(np.float32)
+        arr[:] = np.random.uniform(
+            -self.scale, self.scale, arr.shape).astype(np.float32)
 
 
 @register
@@ -247,31 +274,51 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape).astype(np.float32)
+        arr[:] = np.random.normal(
+            0.0, self.sigma, arr.shape).astype(np.float32)
 
 
 @register
 class Orthogonal(Initializer):
+    """Scaled orthonormal basis from the SVD of a random matrix."""
+
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
         self.scale = scale
         self.rand_type = rand_type
 
     def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(np.prod(arr.shape[1:]))
+        rows = arr.shape[0]
+        cols = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            seed = np.random.uniform(-1.0, 1.0, (rows, cols))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
-        u, _, v = np.linalg.svd(tmp, full_matrices=False)
-        res = u if u.shape == tmp.shape else v
-        res = self.scale * res.reshape(arr.shape)
-        arr[:] = res.astype(np.float32)
+            seed = np.random.normal(0.0, 1.0, (rows, cols))
+        u, _s, vt = np.linalg.svd(seed, full_matrices=False)
+        basis = u if u.shape == seed.shape else vt
+        arr[:] = (self.scale * basis).reshape(arr.shape).astype(np.float32)
+
+
+def _fans(shape, name):
+    """(fan_in, fan_out) of a weight, folding spatial dims into both."""
+    if len(shape) < 2:
+        raise ValueError(
+            "Xavier-family initializers need a >=2-D weight; %r is %s"
+            % (str(name), (shape,)))
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    return shape[1] * receptive, shape[0] * receptive
 
 
 @register
 class Xavier(Initializer):
+    """Variance-scaled random fill (Glorot/He family)."""
+
+    _FACTORS = {
+        "avg": lambda fi, fo: (fi + fo) / 2.0,
+        "in": lambda fi, fo: fi,
+        "out": lambda fi, fo: fo,
+    }
+
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
@@ -280,70 +327,76 @@ class Xavier(Initializer):
         self.magnitude = float(magnitude)
 
     def _init_weight(self, name, arr):
-        shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) < 2:
-            raise ValueError("Xavier initializer cannot be applied to vector "
-                             "%s. It requires at least 2D." % name)
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
+        fan_in, fan_out = _fans(arr.shape, name)
+        try:
+            factor = self._FACTORS[self.factor_type](fan_in, fan_out)
+        except KeyError:
+            raise ValueError(
+                "factor_type must be one of %s; got %r"
+                % (sorted(self._FACTORS), self.factor_type))
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, shape).astype(np.float32)
+            sample = np.random.uniform(-scale, scale, arr.shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0, scale, shape).astype(np.float32)
+            sample = np.random.normal(0.0, scale, arr.shape)
         else:
-            raise ValueError("Unknown random type")
+            raise ValueError(
+                "rnd_type must be 'uniform' or 'gaussian'; got %r"
+                % self.rnd_type)
+        arr[:] = sample.astype(np.float32)
 
 
 @register
 class MSRAPrelu(Xavier):
+    """He initialization adjusted for a PReLU negative slope."""
+
     def __init__(self, factor_type="avg", slope=0.25):
-        magnitude = 2.0 / (1 + slope ** 2)
-        super().__init__("gaussian", factor_type, magnitude)
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
         self._kwargs = {"factor_type": factor_type, "slope": slope}
 
+
+# ---------------------------------------------------------------------------
+# structured fills
 
 @register
 class Bilinear(Initializer):
     def __init__(self):
         super().__init__()
 
-    def _init_weight(self, _, arr):
-        Initializer._init_bilinear(self, _, arr)
+    _init_weight = Initializer._init_bilinear
 
 
 @register
 class LSTMBias(Initializer):
+    """Zero bias with the forget gate offset to forget_bias.
+
+    Gate layout is [i, f, c, o] blocks of num_hidden each.
+    """
+
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
-    def _init_weight(self, name, arr):
-        arr[:] = 0.0
-        num_hidden = int(arr.shape[0] / 4)
-        a = arr.asnumpy().copy()  # asnumpy views are read-only
-        a[num_hidden:2 * num_hidden] = self.forget_bias
-        arr[:] = a
+    def _init_weight(self, _, arr):
+        num_hidden = arr.shape[0] // 4
+        bias = np.zeros(arr.shape, dtype=np.float32)
+        bias[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = bias
 
 
 @register
 class FusedRNN(Initializer):
-    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
-                 forget_bias=1.0):
+    """Initialize a fused-RNN flat parameter vector gate by gate.
+
+    Unpacks the flat vector with a FusedRNNCell, applies ``init`` (or the
+    global default) per unpacked weight, forces LSTM forget-gate biases to
+    ``forget_bias``, then repacks.
+    """
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
         if isinstance(init, str):
-            klass, kwargs = json.loads(init)
-            init = _INITIALIZER_REGISTRY[klass.lower()](**kwargs)
+            init = _from_dumps(init)
         super().__init__(init=init.dumps() if init is not None else None,
                          num_hidden=num_hidden, num_layers=num_layers,
                          mode=mode, bidirectional=bidirectional,
@@ -357,21 +410,16 @@ class FusedRNN(Initializer):
 
     def _init_weight(self, desc, arr):
         from .rnn import rnn_cell
-        cell = rnn_cell.FusedRNNCell(self._num_hidden, self._num_layers,
-                                     self._mode, self._bidirectional,
-                                     forget_bias=self._forget_bias, prefix="")
-        args = cell.unpack_weights({cell._parameter_prefix + "parameters": arr})
-        for name in args:
-            arg_desc = InitDesc(name, global_init=desc.global_init)
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        flat_name = cell._parameter_prefix + "parameters"
+        pieces = cell.unpack_weights({flat_name: arr})
+        fallback = getattr(desc, "global_init", None) or self._init
+        for name, piece in pieces.items():
             if self._mode == "lstm" and name.endswith("_f_bias"):
-                args[name][:] = self._forget_bias
-            elif self._init is None:
-                desc.global_init(arg_desc, args[name])
-            else:
-                self._init(arg_desc, args[name])
-        arr[:] = cell.pack_weights(args)["parameters"]
-
-
-# common aliases (ref: mx.init registry accepts "zeros"/"ones" names)
-_INITIALIZER_REGISTRY.setdefault("zeros", Zero)
-_INITIALIZER_REGISTRY.setdefault("ones", One)
+                piece[:] = self._forget_bias
+                continue
+            chosen = self._init if self._init is not None else fallback
+            chosen(InitDesc(name, global_init=fallback), piece)
+        arr[:] = cell.pack_weights(pieces)["parameters"]

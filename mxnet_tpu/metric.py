@@ -1,110 +1,162 @@
-"""Evaluation metrics (ref: python/mxnet/metric.py, 1,203 LoC)."""
-from __future__ import annotations
+"""Evaluation metrics.
 
-import math
+API parity with the reference metric registry (python/mxnet/metric.py)
+but a different internal design: every concrete metric is a pure
+per-batch *measure* — ``_measure(label, pred) -> (contribution, weight)``
+over numpy arrays — and the ``EvalMetric`` base owns coercion from
+device arrays, pairing of output/label lists, and running accumulation.
+Host transfer happens exactly once per batch at the measure boundary
+(metrics are scalar bookkeeping; keeping them out of the jitted step is
+deliberate — see module/fused_step.py for the on-device loss path).
+"""
+from __future__ import annotations
 
 import numpy as np
 
-from .base import MXNetError
 from .ndarray import NDArray
+
+__all__ = [
+    "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
+    "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+    "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch", "Caffe",
+    "CustomMetric", "create", "register", "np_metric", "check_label_shapes",
+]
+
+
+def _host(array):
+    """Bring one label/pred onto the host as a numpy array."""
+    if isinstance(array, NDArray):
+        return array.asnumpy()
+    return np.asarray(array)
 
 
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Validate that labels and preds pair up (count, or full shape)."""
+    a = labels.shape if shape else len(labels)
+    b = preds.shape if shape else len(preds)
+    if a != b:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(a, b))
 
 
 class EvalMetric:
+    """Running (weighted) average of a per-batch measure.
+
+    Subclasses implement ``_measure(label, pred)`` on numpy arrays and
+    return ``(contribution, weight)``; the base accumulates
+    ``sum_metric += contribution`` and ``num_inst += weight`` and reports
+    their ratio from :meth:`get`.
+    """
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
-        self._kwargs = kwargs
+        self._init_kwargs = kwargs
         self.reset()
+
+    # -- accumulation protocol -------------------------------------------
+    def _measure(self, label, pred):
+        raise NotImplementedError(
+            "%s must implement _measure or override update"
+            % type(self).__name__)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            contribution, weight = self._measure(_host(label), _host(pred))
+            self.sum_metric += contribution
+            self.num_inst += weight
+
+    def update_dict(self, label, pred):
+        """Update from {name: array} dicts (Module's named outputs)."""
+        preds = ([pred[k] for k in self.output_names]
+                 if self.output_names is not None else list(pred.values()))
+        labels = ([label[k] for k in self.label_names]
+                  if self.label_names is not None else list(label.values()))
+        self.update(labels, preds)
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+
+    # -- reporting -------------------------------------------------------
+    def get(self):
+        value = (self.sum_metric / self.num_inst if self.num_inst
+                 else float("nan"))
+        return (self.name, value)
+
+    def get_name_value(self):
+        names, values = self.get()
+        if not isinstance(names, list):
+            names, values = [names], [values]
+        return list(zip(names, values))
+
+    def get_config(self):
+        config = dict(self._init_kwargs)
+        config.update(metric=type(self).__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
+        return config
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
-    def get_config(self):
-        config = self._kwargs.copy()
-        config.update({"metric": self.__class__.__name__, "name": self.name,
-                       "output_names": self.output_names,
-                       "label_names": self.label_names})
-        return config
 
-    def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+# ---------------------------------------------------------------------------
+# registry
 
-    def update(self, labels, preds):
-        raise NotImplementedError()
-
-    def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
-
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
-
-    def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+_REGISTRY = {}
 
 
-_metric_registry = {}
+def register(*aliases):
+    """Class decorator registering a metric under its name plus aliases.
 
+    Usable bare (``@register``) or with explicit alias strings
+    (``@register("acc")``).
+    """
+    def _add(cls, extra=()):
+        for key in (cls.__name__.lower(), *extra):
+            _REGISTRY[key] = cls
+        return cls
 
-def register(klass):
-    _metric_registry[klass.__name__.lower()] = klass
-    return klass
+    if len(aliases) == 1 and isinstance(aliases[0], type):
+        return _add(aliases[0])
+    return lambda cls: _add(cls, aliases)
 
 
 def create(metric, *args, **kwargs):
-    if callable(metric):
-        return CustomMetric(metric, *args, **kwargs)
-    if isinstance(metric, CompositeEvalMetric):
-        return metric
+    """Build a metric from a name, callable, instance, or list thereof."""
     if isinstance(metric, EvalMetric):
         return metric
-    if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, (list, tuple)):
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
     if isinstance(metric, str):
-        name = metric.lower()
-        aliases = {"acc": "accuracy", "ce": "crossentropy", "nll_loss":
-                   "negativeloglikelihood", "top_k_accuracy": "topkaccuracy"}
-        name = aliases.get(name, name)
-        if name in _metric_registry:
-            return _metric_registry[name](*args, **kwargs)
-        raise ValueError("Metric must be either callable or str; unknown %s" % metric)
+        cls = _REGISTRY.get(metric.lower())
+        if cls is None:
+            raise ValueError(
+                "Metric must be either callable or str; unknown %s" % metric)
+        return cls(*args, **kwargs)
     raise TypeError("invalid metric type %s" % type(metric))
 
 
-@register
+# ---------------------------------------------------------------------------
+# composite
+
+@register("composite")
 class CompositeEvalMetric(EvalMetric):
-    def __init__(self, metrics=None, name="composite", output_names=None,
-                 label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    """Fan updates out to a list of child metrics; report all of them."""
+
+    def __init__(self, metrics=None, name="composite",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
         self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
@@ -113,110 +165,147 @@ class CompositeEvalMetric(EvalMetric):
     def get_metric(self, index):
         return self.metrics[index]
 
-    def update_dict(self, labels, preds):
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
-
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def update_dict(self, labels, preds):
+        for m in self.metrics:
+            m.update_dict(labels, preds)
 
     def reset(self):
-        for metric in getattr(self, "metrics", []):
-            metric.reset()
+        for m in getattr(self, "metrics", ()):
+            m.reset()
 
     def get(self):
         names, values = [], []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, np.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+        for m in self.metrics:
+            name, value = m.get()
+            names.extend([name] if isinstance(name, str) else name)
+            values.extend([value] if np.isscalar(value) else value)
         return (names, values)
 
 
-@register
+# ---------------------------------------------------------------------------
+# classification
+
+@register("acc")
 class Accuracy(EvalMetric):
-    def __init__(self, axis=1, name="accuracy", output_names=None,
-                 label_names=None):
+    """Fraction of predictions equal to the label.
+
+    Accepts either class scores (argmax'd over ``axis``) or already-decoded
+    class indices.
+    """
+
+    def __init__(self, axis=1, name="accuracy",
+                 output_names=None, label_names=None):
         super().__init__(name, axis=axis, output_names=output_names,
                          label_names=label_names)
         self.axis = axis
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_np = pred_label.asnumpy() if isinstance(pred_label, NDArray) else np.asarray(pred_label)
-            if pred_np.ndim > 1 and pred_np.shape != (np.asarray(label.asnumpy() if isinstance(label, NDArray) else label)).shape:
-                pred_np = np.argmax(pred_np, axis=self.axis)
-            label_np = (label.asnumpy() if isinstance(label, NDArray) else np.asarray(label)).astype("int32")
-            pred_np = pred_np.astype("int32")
-            check_label_shapes(label_np.flat, pred_np.flat)
-            self.sum_metric += (pred_np.flat == label_np.flat).sum()
-            self.num_inst += len(pred_np.flat)
+    def _measure(self, label, pred):
+        if pred.ndim > 1 and pred.shape != label.shape:
+            pred = pred.argmax(axis=self.axis)
+        label = label.astype(np.int64).ravel()
+        pred = pred.astype(np.int64).ravel()
+        check_label_shapes(label, pred, shape=1)
+        return float((pred == label).sum()), label.size
 
 
-@register
+@register("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
-    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
-                 label_names=None):
-        super().__init__(name, top_k=top_k, output_names=output_names,
-                         label_names=label_names)
-        self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+    """Fraction of samples whose label lands in the top-k scores."""
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_np = np.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label_np = label.asnumpy().astype("int32")
-            num_samples = pred_np.shape[0]
-            num_dims = len(pred_np.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_np.flat == label_np.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_np.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_np[:, num_classes - 1 - j].flat == label_np.flat).sum()
-            self.num_inst += num_samples
+    def __init__(self, top_k=1, name="top_k_accuracy",
+                 output_names=None, label_names=None):
+        if top_k <= 1:
+            raise AssertionError(
+                "Please use Accuracy if top_k is no more than 1")
+        super().__init__("%s_%d" % (name, top_k), top_k=top_k,
+                         output_names=output_names, label_names=label_names)
+        self.top_k = top_k
+
+    def _measure(self, label, pred):
+        if pred.ndim > 2:
+            raise AssertionError("Predictions should be no more than 2 dims")
+        label = label.astype(np.int64).ravel()
+        if pred.ndim == 1:
+            hits = (pred.astype(np.int64) == label).sum()
+        else:
+            k = min(self.top_k, pred.shape[1])
+            # one partial sort per batch; membership test is vectorized
+            top = np.argpartition(pred.astype(np.float32), -k, axis=1)[:, -k:]
+            hits = (top == label[:, None]).any(axis=1).sum()
+        return float(hits), label.size
 
 
 @register
 class F1(EvalMetric):
-    def __init__(self, name="f1", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    """Mean per-batch F1 for binary {0,1} labels."""
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = np.argmax(pred, axis=1)
-            check_label_shapes(label, pred_label)
-            if len(np.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_pos = ((pred_label == 1) * (label == 1)).sum()
-            false_pos = ((pred_label == 1) * (label == 0)).sum()
-            false_neg = ((pred_label == 0) * (label == 1)).sum()
-            precision = true_pos / (true_pos + false_pos) if true_pos + false_pos > 0 else 0.0
-            recall = true_pos / (true_pos + false_neg) if true_pos + false_neg > 0 else 0.0
-            if precision + recall > 0:
-                f1 = 2 * precision * recall / (precision + recall)
-            else:
-                f1 = 0.0
-            self.sum_metric += f1
-            self.num_inst += 1
+    def __init__(self, name="f1", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def _measure(self, label, pred):
+        label = label.astype(np.int64).ravel()
+        decided = pred.argmax(axis=1).ravel()
+        check_label_shapes(label, decided, shape=1)
+        if np.unique(label).size > 2:
+            raise ValueError(
+                "F1 currently only supports binary classification.")
+        tp = float(np.sum((decided == 1) & (label == 1)))
+        fp = float(np.sum((decided == 1) & (label == 0)))
+        fn = float(np.sum((decided == 0) & (label == 1)))
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        denom = precision + recall
+        return (2.0 * precision * recall / denom if denom else 0.0), 1
+
+
+# ---------------------------------------------------------------------------
+# likelihood family
+
+class _PickedLogProb(EvalMetric):
+    """Shared machinery: gather prob of the true class per sample."""
+
+    def __init__(self, eps, name, output_names, label_names):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def _picked(self, label, pred):
+        label = label.astype(np.int64).ravel()
+        assert label.shape[0] == pred.shape[0], (label.shape, pred.shape)
+        return pred[np.arange(label.shape[0]), label]
+
+    def _measure(self, label, pred):
+        prob = self._picked(label, pred)
+        return float(-np.log(prob + self.eps).sum()), prob.shape[0]
+
+
+@register("ce", "crossentropy")
+class CrossEntropy(_PickedLogProb):
+    def __init__(self, eps=1e-12, name="cross-entropy",
+                 output_names=None, label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register("nll_loss", "negativeloglikelihood")
+class NegativeLogLikelihood(_PickedLogProb):
+    def __init__(self, eps=1e-12, name="nll-loss",
+                 output_names=None, label_names=None):
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 class Perplexity(EvalMetric):
+    """exp(mean negative log prob), optionally masking one ignore label.
+
+    Accumulates ``perplexity * tokens`` so composing batches of unequal
+    size stays a token-weighted mean, matching the reference semantics.
+    """
+
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, ignore_label=ignore_label, axis=axis,
@@ -224,173 +313,135 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
+    def _pair_nll(self, label, pred):
+        """(total nll, token count) for one output/label pair."""
+        classes = pred.shape[-1]
+        assert label.size * classes == pred.size, \
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+        flat = label.astype(np.int64).ravel()
+        prob = pred.reshape(-1, classes)[np.arange(flat.size), flat]
+        tokens = flat.size
+        if self.ignore_label is not None:
+            keep = flat != self.ignore_label
+            prob = np.where(keep, prob, 1.0)
+            tokens = int(keep.sum())
+        return float(-np.log(np.maximum(prob, 1e-10)).sum()), tokens
+
     def update(self, labels, preds):
+        # pool nll/tokens across every output pair BEFORE exponentiating:
+        # exp is nonlinear, so per-pair perplexities cannot be averaged
         assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
+        nll, tokens = 0.0, 0
         for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.as_in_context(pred.context).reshape((label.size,))
-            label_np = label.asnumpy().astype("int32")
-            pred_np = pred.asnumpy().reshape(-1, pred.shape[-1])
-            probs = pred_np[np.arange(label_np.shape[0]), label_np]
-            if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(pred_np.dtype)
-                num -= np.sum(ignore)
-                probs = probs * (1 - ignore) + ignore
-            loss -= np.sum(np.log(np.maximum(1e-10, probs)))
-            num += probs.shape[0]
-        self.sum_metric += np.exp(loss / num) * num if num > 0 else 0.0
-        self.num_inst += num
+            pair_nll, pair_tokens = self._pair_nll(_host(label), _host(pred))
+            nll += pair_nll
+            tokens += pair_tokens
+        if tokens > 0:
+            self.sum_metric += float(np.exp(nll / tokens)) * tokens
+            self.num_inst += tokens
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+
+# ---------------------------------------------------------------------------
+# regression
+
+class _Regression(EvalMetric):
+    """Shared 2-D coercion for elementwise regression measures."""
+
+    @staticmethod
+    def _as_2d(a):
+        return a.reshape(a.shape[0], -1) if a.ndim > 1 else a[:, None]
+
+    def _measure(self, label, pred):
+        return self._residual(self._as_2d(label), self._as_2d(pred)), 1
 
 
 @register
-class MAE(EvalMetric):
+class MAE(_Regression):
     def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += np.abs(label - pred).mean()
-            self.num_inst += 1
+    def _residual(self, label, pred):
+        return float(np.abs(label - pred).mean())
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_Regression):
     def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _residual(self, label, pred):
+        return float(np.square(label - pred).mean())
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_Regression):
     def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
-
-
-@register
-class CrossEntropy(EvalMetric):
-    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
+        super().__init__(name, output_names=output_names,
                          label_names=label_names)
-        self.eps = eps
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[np.arange(label.shape[0]), np.int64(label)]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _residual(self, label, pred):
+        return float(np.sqrt(np.square(label - pred).mean()))
 
 
-@register
-class NegativeLogLikelihood(EvalMetric):
-    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, (label.shape[0], num_examples)
-            prob = pred[np.arange(num_examples, dtype=np.int64),
-                        np.int64(label)]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
-
-
-@register
+@register("pearsonr")
 class PearsonCorrelation(EvalMetric):
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, 1)
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            self.sum_metric += np.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def _measure(self, label, pred):
+        check_label_shapes(label, pred, shape=1)
+        return float(np.corrcoef(pred.ravel(), label.ravel())[0, 1]), 1
 
+
+# ---------------------------------------------------------------------------
+# loss passthrough + custom
 
 @register
 class Loss(EvalMetric):
-    def __init__(self, name="loss", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names, label_names=label_names)
+    """Mean of raw output values (for networks that emit a loss head)."""
 
-    def update(self, _, preds):
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, _labels, preds):
         for pred in preds:
-            self.sum_metric += pred.asnumpy().sum()
-            self.num_inst += pred.size
+            host = _host(pred)
+            self.sum_metric += float(host.sum())
+            self.num_inst += host.size
 
 
 @register
 class Torch(Loss):
+    """Alias kept for checkpoint/config compatibility."""
+
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class Caffe(Loss):
+    """Alias kept for checkpoint/config compatibility."""
+
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class CustomMetric(EvalMetric):
+    """Wrap a ``feval(label_np, pred_np)`` callable as a metric.
+
+    ``feval`` may return a bare value (weight 1) or ``(sum, count)``.
+    """
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:  # lambdas render as '<lambda>'
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
@@ -401,26 +452,23 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+        for label, pred in zip(labels, preds):
+            result = self._feval(_host(label), _host(pred))
+            if isinstance(result, tuple):
+                contribution, weight = result
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                contribution, weight = result, 1
+            self.sum_metric += contribution
+            self.num_inst += weight
 
 
 def np_metric(name=None, allow_extra_outputs=False):
-    def feval(numpy_feval):
-        def wrapped(label, pred):
-            return numpy_feval(label, pred)
-        wrapped.__name__ = name or numpy_feval.__name__
-        return CustomMetric(wrapped, wrapped.__name__, allow_extra_outputs)
-    return feval
+    """Decorator turning a numpy feval into a CustomMetric instance."""
+    def _wrap(numpy_feval):
+        feval_name = name or numpy_feval.__name__
+        numpy_feval.__name__ = feval_name
+        return CustomMetric(numpy_feval, feval_name, allow_extra_outputs)
+    return _wrap
 
 
 np_ = np_metric

@@ -12,11 +12,12 @@ from .. import symbol as sym
 from .resnet import depth_config
 
 
-def conv_bn(data, num_filter, kernel, stride, pad, name, relu=True):
+def conv_bn(data, num_filter, kernel, stride, pad, name, relu=True,
+            bn_name=None):
     c = sym.Convolution(data, num_filter=num_filter, kernel=kernel,
                         stride=stride, pad=pad, no_bias=True, name=name)
     bn = sym.BatchNorm(c, fix_gamma=False, eps=2e-5, momentum=0.9,
-                       name=name + "_bn")
+                       name=bn_name or (name + "_bn"))
     if relu:
         bn = sym.Activation(bn, act_type="relu", name=name + "_relu")
     return bn
@@ -24,10 +25,13 @@ def conv_bn(data, num_filter, kernel, stride, pad, name, relu=True):
 
 def residual_unit_v1(data, num_filter, stride, dim_match, name,
                      bottle_neck=True):
+    # v1 places the stride on the FIRST conv of the branch (resnet-v1.py:49
+    # strides conv1; the v1.5 variant that strides the 3x3 lives in torch-
+    # land, not here)
     if bottle_neck:
-        body = conv_bn(data, num_filter // 4, (1, 1), (1, 1), (0, 0),
+        body = conv_bn(data, num_filter // 4, (1, 1), stride, (0, 0),
                        name + "_conv1")
-        body = conv_bn(body, num_filter // 4, (3, 3), stride, (1, 1),
+        body = conv_bn(body, num_filter // 4, (3, 3), (1, 1), (1, 1),
                        name + "_conv2")
         body = conv_bn(body, num_filter, (1, 1), (1, 1), (0, 0),
                        name + "_conv3", relu=False)
@@ -39,8 +43,11 @@ def residual_unit_v1(data, num_filter, stride, dim_match, name,
     if dim_match:
         shortcut = data
     else:
+        # reference param names: conv '<unit>_conv1sc', its BN '<unit>_sc'
+        # (resnet-v1.py:64-66) so v1 checkpoints load by name
         shortcut = conv_bn(data, num_filter, (1, 1), stride, (0, 0),
-                           name + "_sc", relu=False)
+                           name + "_conv1sc", relu=False,
+                           bn_name=name + "_sc")
     return sym.Activation(body + shortcut, act_type="relu",
                           name=name + "_out")
 
@@ -51,8 +58,9 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
         if isinstance(image_shape, str) else list(image_shape)
     height = shape[1]
     units, filters, bottle_neck = depth_config(num_layers, height)
-    data = sym.var("data")
-    net = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, name="bn_data")
+    # no bn_data layer here: that input-normalizing BatchNorm is a v2
+    # (pre-activation) feature; the reference v1 stem starts at conv0
+    net = sym.var("data")
     if height <= 32:  # CIFAR-style stem
         net = conv_bn(net, filters[0], (3, 3), (1, 1), (1, 1), "conv0")
     else:

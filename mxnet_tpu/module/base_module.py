@@ -1,20 +1,27 @@
-"""BaseModule: the high-level train/predict interface
-(ref: python/mxnet/module/base_module.py — fit loop at :376-534)."""
+"""BaseModule: the high-level train/score/predict interface.
+
+API parity with the reference module contract (python/mxnet/module/
+base_module.py) with this package's own training-loop construction: the
+epoch loop drives a one-batch *lookahead* generator so the next batch's
+host→device transfer (``prepare``) overlaps the current step — the same
+latency-hiding job the reference's ``next_data_batch`` juggling does, but
+expressed as an iterator adapter rather than inline state flags.
+Subclasses provide bind/forward/backward/update; Module's fused path
+collapses those into one jitted XLA program per step.
+"""
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
 from .. import metric as metric_mod
-from ..base import MXNetError
-from ..io import DataDesc
+from ..context import cpu
 from ..initializer import Uniform
-from ..ndarray import NDArray
 
 
 class BatchEndParam:
+    """The object handed to batch-end callbacks (Speedometer et al.)."""
+
     def __init__(self, epoch, nbatch, eval_metric, locals=None):
         self.epoch = epoch
         self.nbatch = nbatch
@@ -22,30 +29,67 @@ class BatchEndParam:
         self.locals = locals
 
 
+def _each_callback(callbacks, arg):
+    """Invoke one callback or a list of them with a single argument."""
+    if callbacks is None:
+        return
+    if not isinstance(callbacks, (list, tuple)):
+        callbacks = [callbacks]
+    for cb in callbacks:
+        cb(arg)
+
+
 def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return obj
-    return [obj]
+    return obj if isinstance(obj, (list, tuple)) else [obj]
+
+
+def _lookahead(iterable):
+    """Yield (item, next_item-or-None) pairs, one element ahead."""
+    it = iter(iterable)
+    try:
+        current = next(it)
+    except StopIteration:
+        return
+    for upcoming in it:
+        yield current, upcoming
+        current = upcoming
+    yield current, None
+
+
+def _trim_pad(outputs, pad):
+    """Drop the iterator's pad rows from each output array."""
+    if not pad:
+        return list(outputs)
+    return [out[:out.shape[0] - pad] for out in outputs]
+
+
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
 
 
 def _check_input_names(symbol, names, typename, throw):
+    """Warn/raise when a declared data/label name is not a symbol input."""
     args = symbol.list_arguments()
     for name in names:
         if name in args:
             continue
-        candidates = [arg for arg in args if not arg.endswith("_weight")
-                      and not arg.endswith("_bias") and not arg.endswith("_gamma")
-                      and not arg.endswith("_beta")]
-        msg = ("\033[91mYou created Module with Module(..., %s_names=%s) but "
-               "input with name '%s' is not found in symbol.list_arguments(). "
-               "Did you mean one of:\n\t%s\033[0m"
-               % (typename, str(names), name, "\n\t".join(candidates)))
+        likely_inputs = [a for a in args
+                        if not a.endswith(_PARAM_SUFFIXES)]
+        msg = ("the Module was created with %s_names=%s, but %r is not an "
+               "argument of the symbol. Inputs the symbol does declare: %s"
+               % (typename, list(names), name, ", ".join(likely_inputs)))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
 class BaseModule:
+    """Abstract train/predict driver over a bound computation.
+
+    Concrete subclasses (Module, BucketingModule, SequentialModule,
+    PythonModule) implement the abstract computation methods; everything
+    layered on top of them — ``fit``, ``score``, ``predict`` — lives here.
+    """
+
     def __init__(self, logger=logging):
         self.logger = logger
         self.binded = False
@@ -56,6 +100,11 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
 
+    def _ready(self):
+        if not (self.binded and self.params_initialized):
+            raise AssertionError(
+                "this call needs bind() and init_params() to have run")
+
     # -- high-level API ------------------------------------------------------
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
@@ -64,74 +113,61 @@ class BaseModule:
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        assert self.binded and self.params_initialized
+        """Evaluate on a data iterator; returns name/value pairs."""
+        self._ready()
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
+        seen = 0
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            _each_callback(batch_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                locals=locals()))
+            seen += 1
+        _each_callback(score_end_callback, BatchEndParam(
+            epoch=epoch, nbatch=seen, eval_metric=eval_metric,
+            locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
+        """Generator over (outputs, nbatch, batch) for each batch."""
+        self._ready()
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+                return
+            self.forward(batch, is_train=False)
+            yield _trim_pad(self.get_outputs(), batch.pad), nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            from ..ndarray import concatenate
-            output_list2 = [concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Run inference over an iterator and collect the outputs."""
+        per_batch = [
+            [o.copy() for o in outs]
+            for outs, _, _ in self.iter_predict(eval_data, num_batch, reset)]
+        if not per_batch:
+            return per_batch
+        if not merge_batches:
+            return per_batch
+        widths = {len(outs) for outs in per_batch}
+        if len(widths) != 1:
+            raise AssertionError(
+                "cannot merge: batches produced differing output counts %s "
+                "(bucketing?); pass merge_batches=False" % sorted(widths))
+        from ..ndarray import concatenate
+        merged = [concatenate([outs[i] for outs in per_batch])
+                  for i in range(widths.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
+    # -- the training loop ---------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -140,8 +176,9 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """Train the module (ref: base_module.py:376)."""
-        assert num_epoch is not None, "please specify number of epochs"
+        """Bind, initialize, and train for ``num_epoch`` epochs."""
+        if num_epoch is None:
+            raise AssertionError("fit() needs num_epoch")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -154,66 +191,99 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = metric_mod.create(
+            validation_metric if validation_metric is not None
+            else eval_metric)
+        eval_metric = metric_mod.create(eval_metric)
 
-        ################################################################################
-        # training loop
-        ################################################################################
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+            self._run_epoch(epoch, train_data, eval_metric,
+                            batch_end_callback, monitor)
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-
+            # sync the trained values back into the module's param dicts so
+            # callbacks and the next epoch observe the same tensors
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_now, aux_now)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
-    # -- symbol/params accessors (abstract) ----------------------------------
+    def _run_epoch(self, epoch, train_data, eval_metric,
+                   batch_end_callback, monitor):
+        """One pass over train_data: step on each batch, prefetch the next."""
+        tic = time.time()
+        eval_metric.reset()
+        for nbatch, (batch, upcoming) in enumerate(_lookahead(train_data)):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            if upcoming is not None:
+                # start the next batch's transfer while the step executes
+                self.prepare(upcoming)
+            self.update_metric(eval_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            _each_callback(batch_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                locals=locals()))
+        for name, val in eval_metric.get_name_value():
+            self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+        self.logger.info("Epoch[%d] Time cost=%.3f",
+                         epoch, time.time() - tic)
+
+    # -- parameter persistence -----------------------------------------------
+    def save_params(self, fname):
+        from ..ndarray import save
+        arg_params, aux_params = self.get_params()
+        blob = {"arg:" + k: v.as_in_context(cpu())
+                for k, v in arg_params.items()}
+        blob.update({"aux:" + k: v.as_in_context(cpu())
+                     for k, v in aux_params.items()})
+        save(fname, blob)
+
+    def load_params(self, fname):
+        from ..ndarray import load
+        split = {"arg": {}, "aux": {}}
+        for key, value in load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind not in split or not name:
+                raise ValueError(
+                    "%s is not a Module param file (bad key %r)"
+                    % (fname, key))
+            split[kind][name] = value
+        self.set_params(split["arg"], split["aux"])
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # -- state passthrough (stateless by default) ------------------------------
+    def get_states(self, merge_multi_context=True):
+        self._ready()
+        assert not merge_multi_context
+        return []
+
+    def set_states(self, states=None, value=None):
+        self._ready()
+        assert not states and not value
+
+    def prepare(self, data_batch):
+        pass
+
+    # -- abstract surface ------------------------------------------------------
     @property
     def symbol(self):
         return self._symbol
@@ -246,52 +316,9 @@ class BaseModule:
                     allow_extra=False):
         raise NotImplementedError()
 
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init, allow_extra=allow_extra)
-
-    def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(
-            __import__("mxnet_tpu").cpu()) for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v.as_in_context(
-            __import__("mxnet_tpu").cpu()) for k, v in aux_params.items()})
-        from ..ndarray import save
-        save(fname, save_dict)
-
-    def load_params(self, fname):
-        from ..ndarray import load
-        save_dict = load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
-
-    def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        assert not merge_multi_context
-        return []
-
-    def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
-        assert not states and not value
-
     def install_monitor(self, mon):
         raise NotImplementedError()
 
-    def prepare(self, data_batch):
-        pass
-
-    # -- computation (abstract) ----------------------------------------------
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()
 

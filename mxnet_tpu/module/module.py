@@ -1,62 +1,89 @@
-"""Module: symbolic training interface (ref: python/mxnet/module/module.py)."""
+"""Module: the symbolic training interface.
+
+API parity with the reference Module contract (python/mxnet/module/
+module.py) built around this package's executor design: bind() compiles
+the whole symbol into one XLA program per context via
+DataParallelExecutorGroup, and init_optimizer() upgrades the step to a
+single fused fwd+bwd+update dispatch (module/fused_step.py) whenever the
+configuration allows — the reference needed separate engine pushes per
+op; here one jitted program per batch is the fast path, with the generic
+forward/backward/update methods as the escape hatch.
+"""
 from __future__ import annotations
 
 import logging
+import pickle
 import warnings
 
-import numpy as np
-
-from ..base import MXNetError
 from ..context import cpu
 from ..initializer import Uniform, InitDesc
 from ..io import DataDesc
 from ..ndarray import zeros as nd_zeros
 from .. import optimizer as opt
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
-                     _update_params_on_kvstore, load_checkpoint,
-                     save_checkpoint)
+                     _update_params_on_kvstore, load_checkpoint)
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
 
+def _normalize_descs(names, shapes, kind, strict):
+    """Coerce shape specs to DataDesc and verify they cover ``names``."""
+    descs = [d if isinstance(d, DataDesc) else DataDesc(*d)
+             for d in (shapes or [])]
+    if sorted(names) != sorted(d[0] for d in descs):
+        msg = ("%s_shapes %s does not provide exactly the declared "
+               "%s_names %s" % (kind, descs, kind, list(names)))
+        if strict:
+            raise ValueError(msg)
+        warnings.warn(msg)
+    return descs
+
+
+def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):
+    """Normalize data/label shape specs into DataDesc lists."""
+    data = _normalize_descs(data_names, data_shapes, "data", strict=True)
+    if label_shapes is None:
+        _normalize_descs(label_names, None, "label", strict=False)
+        return data, None
+    return data, _normalize_descs(label_names, label_shapes, "label",
+                                  strict=False)
+
+
 class Module(BaseModule):
-    """A Module implements the BaseModule API on a Symbol (ref: module.py:34)."""
+    """BaseModule implementation over a Symbol bound to explicit contexts."""
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging, context=None,
                  work_load_list=None, fixed_param_names=None,
                  state_names=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = [cpu()]
-        if isinstance(context, (list, tuple)):
-            self._context = list(context)
-        else:
-            self._context = [context]
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
-        self._work_load_list = work_load_list
-
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
+        if context is None:
+            context = cpu()
+        self._context = (list(context) if isinstance(context, (list, tuple))
+                         else [context])
+        self._work_load_list = work_load_list or [1] * len(self._context)
+        assert len(self._work_load_list) == len(self._context)
 
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names + (state_names or [])
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = list(fixed_param_names or [])
-        self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
         self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
         self._output_names = symbol.list_outputs()
+        self._aux_names = symbol.list_auxiliary_states()
+        # every argument that is not fed as data/label/state is a parameter
+        inputs = set(self._data_names + self._label_names + self._state_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in inputs]
 
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, self._state_names, "state", True)
-        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+        for group, kind, strict in (
+                (self._data_names, "data", True),
+                (self._label_names, "label", False),
+                (self._state_names, "state", True),
+                (self._fixed_param_names, "fixed_param", True)):
+            _check_input_names(symbol, group, kind, strict)
 
+        # host-side master copies (the checkpoint representation)
         self._arg_params = None
         self._aux_params = None
         self._params_dirty = False
@@ -72,12 +99,12 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
 
+    # -- checkpointing -------------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
@@ -85,20 +112,15 @@ class Module(BaseModule):
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         self._symbol.save("%s-symbol.json" % prefix)
-        param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
-        logging.info("Saved checkpoint to \"%s\"", param_name)
+        param_file = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_file)
+        logging.info('Saved checkpoint to "%s"', param_file)
         if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
-            logging.info("Saved optimizer state to \"%s\"", state_name)
+            state_file = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_file)
+            logging.info('Saved optimizer state to "%s"', state_file)
 
-    def _reset_bind(self):
-        self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
-
+    # -- introspection -------------------------------------------------------
     @property
     def data_names(self):
         return self._data_names
@@ -126,62 +148,12 @@ class Module(BaseModule):
         assert self.binded
         return self._exec_group.get_output_shapes()
 
-    def get_params(self):
-        assert self.binded and self.params_initialized
-        if self._params_dirty:
-            self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
-
-    def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
-        if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "init_params call ignored.", stacklevel=2)
-            return
-        assert self.binded, "call bind before initializing the parameters"
-
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(name, arr)
-            else:
-                initializer(name, arr)
-
-        attrs = self._symbol.attr_dict()
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, aux_params)
-
-        self.params_initialized = True
-        self._params_dirty = False
-        self._exec_group.set_params(self._arg_params, self._aux_params)
-
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        if not allow_missing:
-            self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
-                             force_init=force_init, allow_extra=allow_extra)
-            return
-        if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "set_params call ignored.", stacklevel=2)
-            return
-        self._exec_group.set_params(arg_params, aux_params,
-                                    allow_extra=allow_extra)
-        self._params_dirty = True
-        self.params_initialized = True
+    # -- binding -------------------------------------------------------------
+    def _reset_bind(self):
+        self.binded = False
+        self._exec_group = None
+        self._data_shapes = None
+        self._label_shapes = None
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -191,24 +163,21 @@ class Module(BaseModule):
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        if not for_training:
+            assert not inputs_need_grad
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
         self._grad_req = grad_req
-
-        if not for_training:
-            assert not inputs_need_grad
-
         self._data_shapes, self._label_shapes = _parse_data_desc(
             self.data_names, self.label_names, data_shapes, label_shapes)
 
+        shared_group = None
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \
                 shared_module.binded and shared_module.params_initialized
             shared_group = shared_module._exec_group
-        else:
-            shared_group = None
 
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
@@ -217,25 +186,29 @@ class Module(BaseModule):
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
             state_names=self._state_names)
         self._total_exec_bytes = 0
+
         if shared_module is not None:
+            # adopt the sharer's masters outright (bucketing reuses them)
             self.params_initialized = True
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
+            if shared_module.optimizer_initialized:
+                self.borrow_optimizer(shared_module)
         elif self.params_initialized:
+            # rebind after load(): push the preloaded masters to devices
             self._exec_group.set_params(self._arg_params, self._aux_params)
         else:
-            assert self._arg_params is None and self._aux_params is None
-            param_arrays = [nd_zeros(x[0].shape, dtype=x[0].dtype)
-                            for x in self._exec_group.param_arrays]
-            self._arg_params = {name: arr for name, arr in
-                                zip(self._param_names, param_arrays)}
-            aux_arrays = [nd_zeros(x[0].shape, dtype=x[0].dtype)
-                          for x in self._exec_group.aux_arrays]
-            self._aux_params = {name: arr for name, arr in
-                                zip(self._aux_names, aux_arrays)}
+            self._arg_params, self._aux_params = self._allocate_masters()
 
-        if shared_module is not None and shared_module.optimizer_initialized:
-            self.borrow_optimizer(shared_module)
+    def _allocate_masters(self):
+        """Fresh zeroed host arrays shaped like the bound device params."""
+        args = {name: nd_zeros(replicas[0].shape, dtype=replicas[0].dtype)
+                for name, replicas in zip(self._param_names,
+                                          self._exec_group.param_arrays)}
+        auxs = {name: nd_zeros(replicas[0].shape, dtype=replicas[0].dtype)
+                for name, replicas in zip(self._aux_names,
+                                          self._exec_group.aux_arrays)}
+        return args, auxs
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -243,6 +216,73 @@ class Module(BaseModule):
             self.data_names, self.label_names, data_shapes, label_shapes)
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
 
+    # -- parameters ----------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _fill_master(self, desc, arr, provided, initializer, allow_missing):
+        """Resolve one master array from ``provided`` or the initializer."""
+        if provided is None:
+            initializer(desc, arr)
+            return
+        source = provided.get(str(desc))
+        if source is not None:
+            if source is not arr:
+                source.copyto(arr)
+        elif not allow_missing:
+            raise RuntimeError("%s is not presented" % desc)
+        elif initializer is not None:
+            initializer(desc, arr)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and "
+                          "force_init=False. init_params call ignored.",
+                          stacklevel=2)
+            return
+        assert self.binded, "call bind before initializing the parameters"
+
+        attrs = self._symbol.attr_dict()
+        for masters, provided in ((self._arg_params, arg_params),
+                                  (self._aux_params, aux_params)):
+            for name in sorted(masters):
+                desc = InitDesc(name, attrs.get(name, None))
+                self._fill_master(desc, masters[name], provided,
+                                  initializer, allow_missing)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and "
+                          "force_init=False. set_params call ignored.",
+                          stacklevel=2)
+            return
+        # partial update: push straight to devices, masters refresh lazily
+        self._exec_group.set_params(arg_params, aux_params,
+                                    allow_extra=allow_extra)
+        self._params_dirty = True
+        self.params_initialized = True
+
+    def _sync_params_from_devices(self):
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    # -- optimizer -----------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -271,25 +311,28 @@ class Module(BaseModule):
         rescale_grad = 1.0 / batch_size
 
         if isinstance(optimizer, str):
-            idx2name = {}
+            # index→name map lets per-param lr/wd multipliers resolve
+            names = self._exec_group.param_names
             if update_on_kvstore:
-                idx2name.update(enumerate(self._exec_group.param_names))
+                idx2name = dict(enumerate(names))
             else:
-                for k in range(len(self._context)):
-                    idx2name.update({i * len(self._context) + k: n
-                                     for i, n in enumerate(self._exec_group.param_names)})
+                ndev = len(self._context)
+                idx2name = {i * ndev + k: n
+                            for i, n in enumerate(names)
+                            for k in range(ndev)}
             optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer_params.setdefault("rescale_grad", rescale_grad)
             optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name, **optimizer_params)
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
             if optimizer.rescale_grad != rescale_grad:
                 warnings.warn(
-                    "Optimizer created manually outside Module but rescale_grad "
-                    "is not normalized to 1.0/batch_size/num_workers (%s vs. %s). "
-                    "Is this intended?" % (optimizer.rescale_grad, rescale_grad),
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad is not normalized to "
+                    "1.0/batch_size/num_workers (%s vs. %s). Is this "
+                    "intended?" % (optimizer.rescale_grad, rescale_grad),
                     stacklevel=2)
 
         self._optimizer = optimizer
@@ -298,8 +341,9 @@ class Module(BaseModule):
         self._updater = None
 
         if kvstore:
-            if self._compression_params_of(kvstore):
-                kvstore.set_gradient_compression(self._compression_params_of(kvstore))
+            requested = self._compression_params_of(kvstore)
+            if requested:
+                kvstore.set_gradient_compression(requested)
             _initialize_kvstore(kvstore=kvstore,
                                 param_arrays=self._exec_group.param_arrays,
                                 arg_params=self._arg_params,
@@ -341,26 +385,25 @@ class Module(BaseModule):
         self._updater = shared_module._updater
         self.optimizer_initialized = True
 
+    # -- computation ---------------------------------------------------------
+    def _rebind_for_batch(self, data_batch):
+        """Reshape the bound program when a batch arrives with new shapes."""
+        incoming = tuple(arr.shape for arr in data_batch.data)
+        if incoming == tuple(d.shape for d in self._data_shapes):
+            return
+        dshapes = getattr(data_batch, "provide_data", None) or [
+            DataDesc(d.name, shape, d.dtype, d.layout)
+            for d, shape in zip(self._data_shapes, incoming)]
+        lshapes = getattr(data_batch, "provide_label", None)
+        if not lshapes and getattr(data_batch, "label", None):
+            lshapes = [DataDesc(d.name, arr.shape, d.dtype, d.layout)
+                       for d, arr in zip(self._label_shapes,
+                                         data_batch.label)]
+        self.reshape(dshapes, lshapes or None)
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
-        new_data_shapes = tuple(i.shape for i in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            if hasattr(data_batch, "provide_data") and data_batch.provide_data:
-                new_dshape = data_batch.provide_data
-            else:
-                new_dshape = [DataDesc(i.name, shape, i.dtype, i.layout)
-                              for i, shape in zip(self._data_shapes,
-                                                  new_data_shapes)]
-            if hasattr(data_batch, "provide_label") and data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif hasattr(data_batch, "label") and data_batch.label:
-                new_lshape = [DataDesc(i.name, j.shape, i.dtype, i.layout)
-                              for i, j in zip(self._label_shapes,
-                                              data_batch.label)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
+        self._rebind_for_batch(data_batch)
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -423,27 +466,25 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
+        return self._exec_group.get_outputs(
+            merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
         return self._exec_group.get_input_grads(
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
-    def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
-        self._params_dirty = False
-
+    # -- optimizer state persistence -----------------------------------------
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if getattr(self, "_fused_step", None) is not None \
                 and self._fused_step.ran:
             # self-describing container so load works regardless of which
             # path the restoring process ends up using
-            import pickle
             with open(fname, "wb") as fout:
                 pickle.dump({"format": "fused_v2",
                              "states": self._fused_step.export_states()},
@@ -458,7 +499,6 @@ class Module(BaseModule):
         assert self.optimizer_initialized
         with open(fname, "rb") as f:
             raw = f.read()
-        import pickle
         payload = None
         try:
             obj = pickle.loads(raw)
@@ -494,28 +534,3 @@ class Module(BaseModule):
 
     def prepare(self, data_batch):
         pass
-
-
-def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):
-    """Normalize shape specs into DataDesc lists (ref: module/base_module.py)."""
-    data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                   for x in data_shapes]
-    _check_names_match(data_names, data_shapes, "data", True)
-    if label_shapes is not None:
-        label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                        for x in label_shapes]
-        _check_names_match(label_names, label_shapes, "label", False)
-    else:
-        _check_names_match(label_names, [], "label", False)
-    return data_shapes, label_shapes
-
-
-def _check_names_match(data_names, data_shapes, name, throw):
-    actual = [x[0] for x in data_shapes]
-    if sorted(data_names) != sorted(actual):
-        msg = "Data provided by %s_shapes don't match names specified by " \
-              "%s_names (%s vs. %s)" % (name, name, str(data_shapes),
-                                        str(data_names))
-        if throw:
-            raise ValueError(msg)
-        warnings.warn(msg)
