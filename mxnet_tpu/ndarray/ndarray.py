@@ -395,20 +395,31 @@ class NDArray:
         return NDArray(arr, ctx=self._ctx)
 
     def __setitem__(self, key, value):
+        dt = self._h.array.dtype
         if isinstance(value, NDArray):
             val = value._h.array
         elif isinstance(value, (int, float, bool)):
             val = value
         else:
-            val = jnp.asarray(np.asarray(value), self._h.array.dtype)
+            # stay in numpy until the single device_put below — an eager
+            # jnp.asarray would allocate on the DEFAULT backend, not this
+            # array's device
+            val = np.asarray(value).astype(dt, copy=False)
         if isinstance(key, slice) and key == slice(None):
+            dev = self.context.jax_device()
             if np.isscalar(val):
-                self._h.array = jnp.full_like(self._h.array, val)
-            else:
-                self._h.array = jnp.broadcast_to(
-                    jnp.asarray(val, self._h.array.dtype), self.shape)
-                self._h.array = jax.device_put(self._h.array,
-                                               self.context.jax_device())
+                self._h.array = jax.device_put(
+                    np.full(self.shape, val, dt), dev)
+            elif isinstance(val, np.ndarray):
+                self._h.array = jax.device_put(
+                    np.broadcast_to(val, self.shape).astype(dt, copy=False),
+                    dev)
+            else:  # jax array (possibly on another device): op-free move
+                if val.dtype != dt:
+                    val = val.astype(dt)
+                if val.shape != self.shape:
+                    val = jnp.broadcast_to(val, self.shape)
+                self._h.array = jax.device_put(val, dev)
             return
         if isinstance(key, NDArray):
             key = key.asnumpy().astype(np.int32)
@@ -567,10 +578,13 @@ def empty(shape, ctx=None, dtype="float32"):
 
 
 def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    # host numpy -> one explicit placement: an eager jnp.zeros would
+    # first allocate on the DEFAULT backend, which may not be the target
+    # ctx (and under the driver may not even be usable)
     ctx = ctx or current_context()
     if isinstance(shape, int):
         shape = (shape,)
-    arr = jnp.zeros(shape, np_dtype(dtype or "float32"))
+    arr = np.zeros(shape, np_dtype(dtype or "float32"))
     return NDArray(jax.device_put(arr, ctx.jax_device()), ctx=ctx)
 
 
@@ -578,7 +592,7 @@ def ones(shape, ctx=None, dtype="float32", **kwargs):
     ctx = ctx or current_context()
     if isinstance(shape, int):
         shape = (shape,)
-    arr = jnp.ones(shape, np_dtype(dtype or "float32"))
+    arr = np.ones(shape, np_dtype(dtype or "float32"))
     return NDArray(jax.device_put(arr, ctx.jax_device()), ctx=ctx)
 
 

@@ -127,3 +127,69 @@ def test_sharded_train_step_converges():
     losses = [float(step({"x": jnp.asarray(X), "y": jnp.asarray(Y)}))
               for _ in range(25)]
     assert losses[-1] < losses[0] * 0.1, losses
+
+
+def test_pipeline_training_matches_sequential():
+    """GPipe TRAINING: fwd+bwd+update through the pipeline schedule in one
+    program, with microbatch gradient accumulation, matches the unsharded
+    sequential step's loss trajectory at pp=2 (round-3 verdict item 4).
+
+    Matmul precision is pinned: this backend's default matmul rounds
+    operands, and the two programs would otherwise diverge by the
+    rounding, not by the schedule."""
+    with jax.default_matmul_precision("highest"):
+        _run_pipeline_training_check()
+
+
+def _run_pipeline_training_check():
+    mesh = _mesh(dp=2, pp=2)
+    rng = np.random.RandomState(7)
+    n_stages, d, batch = 2, 8, 16
+    w0 = rng.randn(n_stages, d, d).astype(np.float32) * 0.3
+    X = rng.randn(batch, d).astype(np.float32)
+    Yt = np.tanh(np.tanh(X @ (rng.randn(d, d) * 0.5)) @ (rng.randn(d, d) * 0.5)).astype(np.float32)
+
+    def stage_fn(p, xm):
+        return jnp.tanh(xm @ p)
+
+    def piped_loss(params, batch_data):
+        y = pipeline_stages(
+            params["w"], batch_data["x"], stage_fn, n_micro=4, mesh=mesh,
+            params_spec={"w": jax.sharding.PartitionSpec("pp")}["w"],
+            batch_axis="dp")
+        return jnp.mean((y - batch_data["y"]) ** 2)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    step = ShardedTrainStep(
+        piped_loss, {"w": jnp.asarray(w0)}, mesh, lr=0.2, momentum=0.9,
+        param_sharding={"w": NamedSharding(mesh, P("pp"))},
+        batch_spec={"x": NamedSharding(mesh, P("dp")),
+                    "y": NamedSharding(mesh, P("dp"))})
+
+    # sequential oracle: same math on one device, full batch
+    w_ref = jnp.asarray(w0)
+    m_ref = jnp.zeros_like(w_ref)
+
+    @jax.jit
+    def ref_step(w, m, x, y):
+        def loss_fn(w):
+            h = x
+            for i in range(n_stages):
+                h = jnp.tanh(h @ w[i])
+            return jnp.mean((h - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        m2 = 0.9 * m + g
+        return w - 0.2 * m2, m2, loss
+
+    batch_data = {"x": jnp.asarray(X), "y": jnp.asarray(Yt)}
+    losses_p, losses_r = [], []
+    for it in range(6):
+        losses_p.append(float(step(batch_data)))
+        w_ref, m_ref, l = ref_step(w_ref, m_ref,
+                                   jnp.asarray(X), jnp.asarray(Yt))
+        losses_r.append(float(l))
+    np.testing.assert_allclose(losses_p, losses_r, rtol=2e-4, atol=2e-5)
+    assert losses_p[-1] < losses_p[0] * 0.9, "pipeline training not learning"
+    # the trained pipeline weights match the sequential weights stage-wise
+    np.testing.assert_allclose(np.asarray(step.params["w"]),
+                               np.asarray(w_ref), rtol=2e-3, atol=2e-4)

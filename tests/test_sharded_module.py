@@ -1,0 +1,165 @@
+"""ShardedModule: the Module API over a device mesh (round-3 verdict
+item 3 — tp/sp/dp reachable from the frontend a user actually holds).
+
+Runs on the 8-virtual-device CPU mesh from conftest; the same programs
+run unchanged on a TPU pod slice.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import MeshSpec, create_mesh
+
+
+def _mesh(**sizes):
+    spec = MeshSpec(**sizes)
+    return create_mesh(spec, devices=jax.devices("cpu")[:spec.n_devices])
+
+
+def _toy_problem(rng, n_in=16, n_cls=8, n=256):
+    W = rng.randn(n_in, n_cls).astype("f")
+    X = rng.randn(n, n_in).astype("f")
+    Y = (X @ W).argmax(1).astype("f")
+    return X, Y
+
+
+def _mlp(n_cls=8, hidden=64):
+    net = mx.sym.var("data")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=n_cls, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_fit_on_dp_tp_mesh_learns():
+    rng = np.random.RandomState(0)
+    X, Y = _toy_problem(rng)
+    it = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.ShardedModule(_mlp(), mesh=_mesh(dp=2, tp=2))
+    mod.fit(it, num_epoch=10, initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+    # the default rule really sharded the big weight over tp
+    assert "tp" in str(mod._dev_params["fc1_weight"].sharding.spec)
+
+
+def test_shard_attr_and_partition_override():
+    """Per-parameter placement: ctor dict wins over __shard__ attr wins
+    over the default rule (the mesh analog of the reference's ctx_group
+    attribute)."""
+    rng = np.random.RandomState(1)
+    X, Y = _toy_problem(rng)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    net = mx.sym.var("data")
+    w = mx.sym.var("fc1_weight", __shard__="None,tp")
+    net = mx.sym.FullyConnected(net, weight=w, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    from jax.sharding import PartitionSpec as P
+    mod = mx.mod.ShardedModule(
+        net, mesh=_mesh(dp=2, tp=2),
+        param_partition={"fc2_weight": P()})
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    assert str(mod._dev_params["fc1_weight"].sharding.spec) == \
+        str(P(None, "tp"))
+    assert mod._dev_params["fc2_weight"].sharding.spec == P()
+
+
+def test_sequence_axis_shards_sp():
+    """sequence_axis=1 shards the token dim over sp (context parallelism
+    for long inputs); training still learns."""
+    rng = np.random.RandomState(2)
+    n, seq, vocab = 128, 8, 16
+    X = rng.randint(0, vocab, (n, seq)).astype("f")
+    # label: parity of the first token (learnable from embeddings)
+    Y = (X[:, 0] % 2).astype("f")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    net = mx.sym.var("data")
+    net = mx.sym.Embedding(net, input_dim=vocab, output_dim=16,
+                           name="embed")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.ShardedModule(net, mesh=_mesh(dp=2, sp=2, tp=2),
+                               sequence_axis=1)
+    mod.fit(it, num_epoch=12, initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_matches_single_device_module():
+    """Same symbol, same init, same batches: the mesh step's loss curve
+    tracks the plain single-device Module."""
+    rng = np.random.RandomState(3)
+    X, Y = _toy_problem(rng, n=128)
+    net = _mlp()
+
+    def run(mod_factory, epochs=3):
+        it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                               label_name="softmax_label")
+        mod = mod_factory()
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        np.random.seed(42)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.2})
+        metric = mx.metric.create("ce")
+        for _ in range(epochs):
+            it.reset()
+            metric.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+                mod.update_metric(metric, batch.label)
+        return metric.get()[1]
+
+    ce_mesh = run(lambda: mx.mod.ShardedModule(net, mesh=_mesh(dp=2)))
+    ce_ref = run(lambda: mx.mod.Module(net, context=mx.cpu()))
+    assert abs(ce_mesh - ce_ref) < 0.05 * max(ce_ref, 1e-3), \
+        (ce_mesh, ce_ref)
+
+
+def test_checkpoint_roundtrip_into_plain_module():
+    """save_checkpoint output loads into the ordinary Module — mesh
+    training and single-chip deployment share the artifact format."""
+    rng = np.random.RandomState(4)
+    X, Y = _toy_problem(rng, n=128)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.ShardedModule(_mlp(), mesh=_mesh(dp=2, tp=2))
+    mod.fit(it, num_epoch=6, initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    mod.save_checkpoint("/tmp/shardckpt", 1)
+
+    plain = mx.mod.Module.load("/tmp/shardckpt", 1)
+    plain.bind(data_shapes=it.provide_data,
+               label_shapes=it.provide_label, for_training=False)
+    acc2 = dict(plain.score(it, "acc"))["accuracy"]
+    assert abs(acc - acc2) < 1e-6, (acc, acc2)
+
+
+def test_batch_not_divisible_raises():
+    rng = np.random.RandomState(5)
+    X, Y = _toy_problem(rng, n=66)
+    it = mx.io.NDArrayIter(X, Y, batch_size=33,
+                           label_name="softmax_label")
+    mod = mx.mod.ShardedModule(_mlp(), mesh=_mesh(dp=2))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    with pytest.raises(mx.base.MXNetError):
+        mod.init_params(mx.initializer.Xavier())
