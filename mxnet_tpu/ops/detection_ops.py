@@ -474,3 +474,63 @@ register("_contrib_DeformablePSROIPooling", _deformable_psroi_pool,
          aliases=("DeformablePSROIPooling",),
          doc="Deformable position-sensitive ROI pooling (sampled bins with "
              "learned offsets).")
+
+
+def _bipartite_matching(score, is_ascend=False, threshold=0.0, topk=-1):
+    """Greedy bipartite matching over a [..., rows, cols] score matrix
+    (ref: contrib/bounding_box-inl.h:619 bipartite_matching): walk
+    score-sorted pairs, match a pair when both its row and column are
+    still free and the score passes the threshold; the first failing
+    score ends the batch element's walk (scores are sorted, so nothing
+    after it can pass).  The reference stops AFTER the assignment that
+    exceeds topk — that off-by-one is reproduced.  Outputs are the row
+    and column marker arrays, -1 where unmatched, score dtype."""
+    rows, cols = score.shape[-2], score.shape[-1]
+    lead = score.shape[:-2]
+    flat = score.reshape((-1, rows * cols))
+    topk = int(topk)
+
+    def one(s):
+        order = jnp.argsort(-s if not is_ascend else s, stable=True)
+
+        def body(j, carry):
+            rm, cm, count, stop = carry
+            idx = order[j]
+            r = (idx // cols).astype(jnp.int32)
+            c = (idx % cols).astype(jnp.int32)
+            val = s[idx]
+            good = (val < threshold) if is_ascend else (val > threshold)
+            free = (rm[r] == -1) & (cm[c] == -1)
+            do = free & good & ~stop
+            rm = rm.at[r].set(jnp.where(do, c, rm[r]))
+            cm = cm.at[c].set(jnp.where(do, r, cm[c]))
+            count = count + do.astype(jnp.int32)
+            stop = stop | (free & ~good) | \
+                ((topk > 0) & (count > topk) & do)
+            return rm, cm, count, stop
+
+        rm0 = jnp.full((rows,), -1, jnp.int32)
+        cm0 = jnp.full((cols,), -1, jnp.int32)
+        rm, cm, _, _ = lax.fori_loop(
+            0, rows * cols, body, (rm0, cm0, jnp.int32(0), False))
+        return rm, cm
+
+    rm, cm = jax.vmap(one)(flat)
+    return (rm.reshape(lead + (rows,)).astype(score.dtype),
+            cm.reshape(lead + (cols,)).astype(score.dtype))
+
+
+def _bipartite_infer_shape(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None, None]
+    return in_shapes, [tuple(d[:-1]), tuple(d[:-2]) + (d[-1],)]
+
+
+register("_contrib_bipartite_matching", _bipartite_matching,
+         num_inputs=1, num_outputs=2,
+         infer_shape=_bipartite_infer_shape,
+         params={"is_ascend": (pBool, False), "threshold": (pFloat, 0.0),
+                 "topk": (pInt, -1)},
+         doc="Greedy score-ordered bipartite matching (detection target "
+             "assignment).")

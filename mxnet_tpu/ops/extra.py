@@ -537,3 +537,74 @@ register("nadam_update", _nadam_update,
                  "wd": (pFloat, 0.0), "rescale_grad": (pFloat, 1.0),
                  "clip_gradient": (pFloat, -1.0), "epsilon": (pFloat, 1e-8),
                  "schedule_decay": (pFloat, 0.004)})
+
+
+def _linalg_gelqf(A):
+    """LQ factorization A = L @ Q with Q orthonormal rows (ref:
+    tensor/la_op.cc:483 _linalg_gelqf — LAPACK gelqf+orglq there; here
+    the transpose of XLA's QR, with signs fixed so diag(L) >= 0)."""
+    Qt, Rt = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    # sign-normalize: LQ with a non-negative diagonal is the unique
+    # representative LAPACK produces for full-rank inputs
+    d = jnp.diagonal(Rt, axis1=-2, axis2=-1)
+    s = jnp.where(d < 0, -1.0, 1.0).astype(A.dtype)
+    # flipping column i of Q-tilde pairs with flipping ROW i of R-tilde
+    Q = jnp.swapaxes(Qt * s[..., None, :], -1, -2)
+    L = jnp.swapaxes(Rt * s[..., :, None], -1, -2)
+    return Q, L
+
+
+def _gelqf_infer_shape(in_shapes, attrs):
+    a = in_shapes[0]
+    if a is None:
+        return in_shapes, [None, None]
+    return in_shapes, [tuple(a), tuple(a[:-1]) + (a[-2],)]
+
+
+register("linalg_gelqf", _linalg_gelqf, num_inputs=1, num_outputs=2,
+         aliases=("_linalg_gelqf",), infer_shape=_gelqf_infer_shape)
+
+
+def _linalg_syevd(A):
+    """Symmetric eigendecomposition U @ A = diag(L) @ U, L ascending
+    (ref: tensor/la_op.cc _linalg_syevd; row-eigenvector convention —
+    U is the transpose of the usual column-eigenvector matrix)."""
+    w, V = jnp.linalg.eigh(A)
+    return jnp.swapaxes(V, -1, -2), w
+
+
+def _syevd_infer_shape(in_shapes, attrs):
+    a = in_shapes[0]
+    if a is None:
+        return in_shapes, [None, None]
+    return in_shapes, [tuple(a), tuple(a[:-1])]
+
+
+register("linalg_syevd", _linalg_syevd, num_inputs=1, num_outputs=2,
+         aliases=("_linalg_syevd",), infer_shape=_syevd_infer_shape)
+
+
+def _khatri_rao(*mats, num_args=0):
+    """Column-wise Khatri-Rao product (ref: contrib/krprod.cc:75
+    khatri_rao): column k of the output is the Kronecker product of the
+    inputs' k-th columns; rows multiply out, columns must agree."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[..., :, None, :] * m[..., None, :, :]).reshape(
+            out.shape[:-2] + (out.shape[-2] * m.shape[-2], m.shape[-1]))
+    return out
+
+
+def _khatri_rao_infer_shape(in_shapes, attrs):
+    if any(s is None for s in in_shapes):
+        return in_shapes, [None]
+    rows = 1
+    for s in in_shapes:
+        rows *= s[-2]
+    return in_shapes, [(rows, in_shapes[0][-1])]
+
+
+register("khatri_rao", _khatri_rao, num_inputs=None,
+         key_var_num_args="num_args", aliases=("_contrib_krprod",),
+         infer_shape=_khatri_rao_infer_shape,
+         params={"num_args": (pInt, 0)})

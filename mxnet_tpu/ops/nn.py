@@ -751,6 +751,29 @@ def _softmax_fwd(data, label, multi_output, preserve_shape):
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
 
 
+def _softmax_cross_entropy(data, label):
+    """Summed cross-entropy of softmax(data) picked at integer labels
+    (ref: loss_binary_op.cc:30 softmax_cross_entropy — 2-D data, 1-D
+    label, scalar [1] output; backward is softmax minus one-hot via
+    autodiff of this forward)."""
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    idx = lax.stop_gradient(label).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return (-jnp.sum(picked)).reshape(1).astype(data.dtype)
+
+
+def _sce_infer_shape(in_shapes, attrs):
+    d, l = in_shapes
+    filled = list(in_shapes)
+    if d is not None and l is None:
+        filled[1] = (d[0],)
+    return filled, [(1,)]
+
+
+register("softmax_cross_entropy", _softmax_cross_entropy,
+         input_names=("data", "label"), infer_shape=_sce_infer_shape)
+
+
 def _softmax_output_grad(out, label, grad_scale, ignore_label, use_ignore,
                          normalization, multi_output):
     if multi_output:

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..base import np_dtype
+from ..base import np_dtype, MXNetError
 from .registry import register, pShape, pInt, pFloat, pBool, pStr, pDtype, pAny
 
 # ---------------------------------------------------------------------------
@@ -126,6 +126,10 @@ _UNARY = {
     "relu": lambda x: jnp.maximum(x, 0), "sigmoid": jax.nn.sigmoid,
     "softsign": jax.nn.soft_sign, "erf": lax.erf,
     "logical_not": lambda x: (x == 0).astype(x.dtype),
+    # mshadow round = C round() = half away from zero; jnp.round would be
+    # banker's rounding (round(2.5) -> 2 instead of 3)
+    "round": lambda x: jnp.where(x >= 0, jnp.floor(x + 0.5),
+                                 jnp.ceil(x - 0.5)),
 }
 
 for _n, _f in _UNARY.items():
@@ -290,6 +294,26 @@ register("Reshape", _reshape, num_inputs=1, aliases=("reshape",),
 
 register("Flatten", lambda x: jnp.reshape(x, (x.shape[0], -1)), num_inputs=1,
          aliases=("flatten",))
+
+
+def _reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (ref: elemwise_unary_op_basic.cc:254
+    reshape_like — rhs contributes its shape only, no gradient)."""
+    return jnp.reshape(lhs, jax.lax.stop_gradient(rhs).shape)
+
+
+def _reshape_like_infer_shape(in_shapes, attrs):
+    lhs, rhs = in_shapes
+    if lhs is not None and rhs is not None and \
+            int(np.prod(lhs)) != int(np.prod(rhs)):
+        raise MXNetError(
+            "reshape_like: lhs %s and rhs %s carry different element "
+            "counts" % (lhs, rhs))
+    return in_shapes, [tuple(rhs) if rhs is not None else None]
+
+
+register("reshape_like", _reshape_like, input_names=("lhs", "rhs"),
+         infer_shape=_reshape_like_infer_shape)
 
 
 def _transpose(x, axes=None):

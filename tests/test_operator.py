@@ -411,3 +411,110 @@ def test_square_sum_gradient():
     x = mx.sym.Variable("x")
     sym = mx.sym.square_sum(x, axis=1)
     check_numeric_gradient(sym, [np.random.rand(3, 4).astype(np.float32)])
+
+
+def test_round_half_away_from_zero():
+    import mxnet_tpu as mx
+    x = mx.nd.array([2.5, -2.5, 1.4, -1.4, 0.5, -0.5])
+    out = mx.nd.round(x).asnumpy()
+    assert (out == [3, -3, 1, -1, 1, -1]).all(), out
+
+
+def test_reshape_like():
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    lhs = mx.sym.var("lhs")
+    rhs = mx.sym.var("rhs")
+    sym = mx.sym.reshape_like(lhs, rhs)
+    a = rng.rand(2, 6).astype(np.float32)
+    b = np.zeros((3, 4), np.float32)
+    exe = sym.bind(mx.cpu(), {"lhs": mx.nd.array(a), "rhs": mx.nd.array(b)},
+                   args_grad={"lhs": mx.nd.zeros((2, 6)),
+                              "rhs": mx.nd.zeros((3, 4))})
+    out = exe.forward()[0]
+    assert out.shape == (3, 4)
+    assert np.allclose(out.asnumpy().ravel(), a.ravel())
+    exe.backward(mx.nd.array(np.ones((3, 4), np.float32)))
+    assert np.allclose(exe.grad_dict["lhs"].asnumpy(), 1.0)
+    assert np.allclose(exe.grad_dict["rhs"].asnumpy(), 0.0)
+
+
+def test_softmax_cross_entropy():
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(1)
+    d = rng.randn(4, 5).astype(np.float32)
+    l = rng.randint(0, 5, (4,)).astype(np.float32)
+    out = mx.nd.softmax_cross_entropy(mx.nd.array(d), mx.nd.array(l))
+    p = np.exp(d - d.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    ref = -np.log(p[np.arange(4), l.astype(int)]).sum()
+    assert_almost_equal(out.asnumpy(), np.array([ref]), rtol=1e-5, atol=1e-6)
+    # gradient = softmax - onehot (through the symbol executor)
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    sym = mx.sym.softmax_cross_entropy(data, label)
+    exe = sym.bind(mx.cpu(), {"data": mx.nd.array(d),
+                              "label": mx.nd.array(l)},
+                   args_grad={"data": mx.nd.zeros((4, 5))},
+                   grad_req={"data": "write", "label": "null"})
+    exe.forward()
+    exe.backward(mx.nd.array(np.ones((1,), np.float32)))
+    onehot = np.eye(5, dtype=np.float32)[l.astype(int)]
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), p - onehot,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_gelqf_syevd():
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(2)
+    A = rng.randn(3, 5).astype(np.float32)
+    Q, L = mx.nd.linalg_gelqf(mx.nd.array(A))
+    qn, ln = Q.asnumpy(), L.asnumpy()
+    assert_almost_equal(ln @ qn, A, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(qn @ qn.T, np.eye(3, dtype=np.float32),
+                        rtol=1e-4, atol=1e-5)
+    assert np.tril(ln) == pytest.approx(ln), "L not lower triangular"
+    # batch mode
+    Ab = rng.randn(2, 3, 4).astype(np.float32)
+    Qb, Lb = mx.nd.linalg_gelqf(mx.nd.array(Ab))
+    assert Qb.shape == (2, 3, 4) and Lb.shape == (2, 3, 3)
+
+    S = rng.randn(4, 4).astype(np.float32)
+    S = (S + S.T) / 2
+    U, w = mx.nd.linalg_syevd(mx.nd.array(S))
+    un, wn = U.asnumpy(), w.asnumpy()
+    assert_almost_equal(un @ S, np.diag(wn) @ un, rtol=1e-3, atol=1e-4)
+    assert (np.diff(wn) >= -1e-5).all(), "eigenvalues not ascending"
+    # gradient of an eigenvalue-based scalar (distinct eigenvalues)
+    sym = mx.sym.sum(mx.sym.linalg_syevd(mx.sym.var("A"))[1])
+    check_numeric_gradient(sym, {"A": S}, rtol=0.05, atol=1e-2)
+
+
+def test_khatri_rao():
+    import mxnet_tpu as mx
+    A = mx.nd.array([[1., -1], [2, -3]])
+    B = mx.nd.array([[1., 4], [2, 5], [3, 6]])
+    C = mx.nd.khatri_rao(A, B)
+    ref = np.array([[1, -4], [2, -5], [3, -6],
+                    [2, -12], [4, -15], [6, -18]], np.float32)
+    assert_almost_equal(C.asnumpy(), ref, rtol=1e-6, atol=1e-6)
+    # three matrices: rows multiply out
+    D = mx.nd.array(np.ones((2, 2), np.float32))
+    assert mx.nd.khatri_rao(A, B, D).shape == (12, 2)
+
+
+def test_bipartite_matching():
+    import mxnet_tpu as mx
+    score = mx.nd.array([[0.9, 0.2], [0.8, 0.7]])
+    rm, cm = mx.nd.contrib.bipartite_matching(score, threshold=0.5)
+    # 0.9 matches (0,0); 0.8 blocked (row 1 col 0 taken? no: row1 free,
+    # col0 taken) -> 0.7 matches (1,1)
+    assert (rm.asnumpy() == [0, 1]).all(), rm.asnumpy()
+    assert (cm.asnumpy() == [0, 1]).all(), cm.asnumpy()
+    # threshold cuts the walk at the first failing score
+    rm2, _ = mx.nd.contrib.bipartite_matching(score, threshold=0.85)
+    assert (rm2.asnumpy() == [0, -1]).all()
+    # ascending mode: smallest scores match while below threshold
+    rm3, cm3 = mx.nd.contrib.bipartite_matching(score, is_ascend=True,
+                                                threshold=0.75)
+    assert (rm3.asnumpy() == [1, 1]).all() or (rm3.asnumpy()[0] == 1)
