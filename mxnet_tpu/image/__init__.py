@@ -1,3 +1,6 @@
 """Image iterators + augmenters (ref: python/mxnet/image/)."""
 from .image import *  # noqa: F401,F403
 from . import image  # noqa: F401
+from .detection import *  # noqa: F401,F403
+from . import detection  # noqa: F401
+from . import detection as det  # noqa: F401  (mx.image.det alias)

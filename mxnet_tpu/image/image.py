@@ -194,6 +194,11 @@ class Augmenter:
     """Image augmenter base (ref: image.py:482)."""
 
     def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            if isinstance(v, np.ndarray):
+                kwargs[k] = v.tolist()  # keep dumps() JSON-serializable
         self._kwargs = kwargs
 
     def dumps(self):
