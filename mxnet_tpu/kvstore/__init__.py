@@ -30,6 +30,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._gc = None          # GradientCompression when requested
         self._merge_owner = {}   # key -> merge-buffer context ('device')
         self._owner_load = {}    # context -> assigned bytes
 
@@ -84,6 +85,8 @@ class KVStore:
                 acc += v.as_in_context(ctx0)
             return acc
         owner = self._merge_ctx(key, vals)
+        if self._gc is not None and key is not None:
+            return self._reduce_compressed(key, vals, owner)
         # copies to the owner dispatch in parallel; the adds form a
         # balanced tree so the dependency chain is log2(n) deep (the
         # engine/XLA overlaps independent pair-sums)
@@ -96,6 +99,26 @@ class KVStore:
                 nxt.append(moved[-1])
             moved = nxt
         return moved[0]
+
+    def _reduce_compressed(self, key, vals, owner):
+        """Device-store reduction with 2-bit compression on the
+        cross-device hop (ref: the reference's device-comm compression,
+        kvstore_local.h + gradient_compression.h): each source device
+        quantizes against its own error-feedback residual, the PACKED
+        codes cross to the merge owner (2 bits/element of traffic), and
+        the owner dequantizes and sums."""
+        import jax
+        packed_rows = []
+        for v in vals:
+            codes = self._gc.quantize((key, str(v.context)), v._h.array)
+            moved = NDArray(codes)  # uint8 payload crosses devices
+            if v.context != owner:
+                moved = moved.as_in_context(owner)
+            packed_rows.append(np.asarray(moved._h.array))
+        summed = self._gc.dequantize_sum(
+            np.stack(packed_rows), vals[0].shape, vals[0]._h.array.dtype)
+        return NDArray(jax.device_put(np.asarray(summed),
+                                      owner.jax_device()), ctx=owner)
 
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
@@ -161,7 +184,22 @@ class KVStore:
                     result.todense().copyto(o)
 
     def set_gradient_compression(self, compression_params):
+        """'device' stores compress the cross-device hop for real (codes
+        move between devices, dequantize at the merge owner); plain
+        'local' raises like the reference (kvstore.py checks for
+        'device' or 'dist' in the type and refuses otherwise) — silently
+        accepting user intent and doing nothing is worse than either."""
+        if "device" not in self._type and "dist" not in self._type:
+            raise MXNetError(
+                "gradient compression requires a 'device' or 'dist' "
+                "kvstore; %r does not compress anything" % self._type)
+        from .gradient_compression import GradientCompression
+        params = dict(compression_params or {})
+        ctype = params.pop("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unknown compression type %r" % ctype)
         self._compression_params = compression_params
+        self._gc = GradientCompression(**params)
 
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer

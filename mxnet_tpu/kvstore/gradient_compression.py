@@ -36,6 +36,17 @@ class GradientCompression:
         return _dequantize_2bit(codes, int(np.prod(shape)),
                                 self.threshold).reshape(shape).astype(dtype)
 
+    def dequantize_sum(self, gathered, shape, dtype=jnp.float32):
+        """Sum of every participant's codes, dequantized: gathered is
+        [n_participants, n_packed] uint8 (each row one worker's packed
+        2-bit codes).  threshold * (#plus - #minus) per element — exactly
+        the sum of the individually dequantized gradients, computed from
+        the 2-bit wire payload instead of exchanged float32."""
+        n = int(np.prod(shape))
+        return _dequantize_2bit_sum(jnp.asarray(gathered), n,
+                                    self.threshold) \
+            .reshape(shape).astype(dtype)
+
 
 @jax.jit
 def _pack2(q):
@@ -64,3 +75,14 @@ def _dequantize_2bit(packed, n, threshold):
                       axis=1).reshape(-1)[:n]
     return jnp.where(codes == 1, threshold,
                      jnp.where(codes == 2, -threshold, 0.0))
+
+
+def _dequantize_2bit_sum(packed_rows, n, threshold):
+    """packed_rows: [w, n_packed] uint8 -> per-element sum over w of the
+    dequantized values, as float32 [n]."""
+    b = packed_rows
+    codes = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
+                      axis=-1).reshape(b.shape[0], -1)[:, :n]
+    signed = jnp.where(codes == 1, 1, jnp.where(codes == 2, -1, 0)) \
+        .astype(jnp.int32)
+    return threshold * jnp.sum(signed, axis=0).astype(jnp.float32)

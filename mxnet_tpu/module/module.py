@@ -55,8 +55,9 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging, context=None,
                  work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, compression_params=None):
         super().__init__(logger=logger)
+        self._compression_params = compression_params
         self._symbol = symbol
         if context is None:
             context = cpu()
@@ -341,9 +342,8 @@ class Module(BaseModule):
         self._updater = None
 
         if kvstore:
-            requested = self._compression_params_of(kvstore)
-            if requested:
-                kvstore.set_gradient_compression(requested)
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
             _initialize_kvstore(kvstore=kvstore,
                                 param_arrays=self._exec_group.param_arrays,
                                 arg_params=self._arg_params,
@@ -372,10 +372,6 @@ class Module(BaseModule):
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
-
-    @staticmethod
-    def _compression_params_of(kvstore):
-        return getattr(kvstore, "_requested_compression", None)
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized

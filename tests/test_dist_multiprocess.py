@@ -56,6 +56,29 @@ out2 = mx.nd.zeros((2, 2))
 kv.pull("emb", out=out2)
 assert np.allclose(out2.asnumpy(), sum(range(size))), out2.asnumpy()
 
+# --- 2-bit gradient compression: packed codes are the wire payload ---
+before = kv.wire_bytes_pushed
+kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv.init("g", mx.nd.zeros((8,)))
+g = np.array([1.0, -1.0, 0.1, -0.1, 0.7, -0.7, 0.0, 2.0], np.float32)
+kv.push("g", mx.nd.array(g))
+out3 = mx.nd.zeros((8,))
+kv.pull("g", out=out3)
+quant = np.where(g >= 0.5, 0.5, np.where(g <= -0.5, -0.5, 0.0))
+assert np.allclose(out3.asnumpy(), quant * size), (rank, out3.asnumpy())
+wire = kv.wire_bytes_pushed - before
+assert wire == 2, wire  # 8 elements -> 2 bytes of 2-bit codes (vs 32 f32)
+
+# error feedback: the quantization error rides the residual into the
+# next push (gradient_compression.h:52 semantics)
+residual = g - quant
+kv.push("g", mx.nd.zeros((8,)))
+out4 = mx.nd.zeros((8,))
+kv.pull("g", out=out4)
+quant2 = np.where(residual >= 0.5, 0.5,
+                  np.where(residual <= -0.5, -0.5, 0.0))
+assert np.allclose(out4.asnumpy(), quant2 * size), (rank, out4.asnumpy())
+
 print("WORKER_OK rank=%d size=%d pulled=%s" % (rank, size,
                                                out.asnumpy()[0]))
 """

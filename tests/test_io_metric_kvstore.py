@@ -375,3 +375,39 @@ def test_device_store_spreads_merge_owners():
     # owns at least one merge buffer
     owners = set(kv._merge_owner.values())
     assert len(owners) == len(ctxs), kv._merge_owner
+
+
+def test_device_kvstore_gradient_compression():
+    """'device' stores compress the cross-device hop: result equals the
+    per-source quantize -> sum oracle, with error feedback."""
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs 2 cpu devices")
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((6,)))
+    g0 = np.array([1.0, -0.2, 0.6, -0.9, 0.1, 0.0], np.float32)
+    g1 = np.array([0.4, -1.1, 0.5, 0.2, -0.6, 2.0], np.float32)
+    vals = [mx.nd.array(g0, ctx=mx.cpu(0)), mx.nd.array(g1, ctx=mx.cpu(1))]
+    kv.push("w", vals)
+    out = mx.nd.zeros((6,))
+    kv.pull("w", out=out)
+
+    def q(x):
+        return np.where(x >= 0.5, 0.5, np.where(x <= -0.5, -0.5, 0.0))
+
+    expect = q(g0) + q(g1)
+    np.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
+    # second push: per-source residuals carry
+    r0, r1 = g0 - q(g0), g1 - q(g1)
+    kv.push("w", [mx.nd.zeros((6,), ctx=mx.cpu(0)),
+                  mx.nd.zeros((6,), ctx=mx.cpu(1))])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), q(r0) + q(r1), atol=1e-6)
+
+
+def test_local_kvstore_compression_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
