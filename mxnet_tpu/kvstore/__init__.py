@@ -21,6 +21,19 @@ from ..ndarray import NDArray, zeros as nd_zeros
 from .. import optimizer as opt
 
 
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _gather_rows(dense, rid):
+    """Device-side row gather for row_sparse_pull: sorted ids (dups
+    kept — static shapes), out-of-range ids clipped."""
+    ids = jnp.sort(rid.astype(jnp.int64))
+    ids = jnp.clip(ids, 0, dense.shape[0] - 1)
+    return ids, dense[ids]
+
+
 class KVStore:
     """Single-process key-value store base (ref: include/mxnet/kvstore.h)."""
 
@@ -164,18 +177,21 @@ class KVStore:
         for k, olist, rid in zip(keys, outs, row_ids):
             stored = self._stored[k]
             dense = stored.todense() if hasattr(stored, "todense") else stored
-            ids = np.unique(rid.asnumpy().astype(np.int64))
-            if ids.size and (ids[0] < 0 or ids[-1] >= dense.shape[0]):
-                raise MXNetError(
-                    "row_sparse_pull: row id out of range for key %r "
-                    "(%d rows)" % (k, dense.shape[0]))
-            rows = dense._h.array[ids]
+            # ON-DEVICE id handling (the reference's GPU-side sort/unique,
+            # kvstore_utils.cu, reinterpreted for XLA's static shapes):
+            # sort on device, keep duplicates (the output stays
+            # len(row_ids) rows — duplicated identical rows scatter to the
+            # same dense value), clip the gather instead of a host-synced
+            # range check.  Embedding training hits this every step; an
+            # asnumpy here would stall the pipeline on the device queue.
+            ids, rows = _gather_rows(dense._h.array,
+                                     rid._h.array if isinstance(rid, NDArray)
+                                     else jnp.asarray(np.asarray(rid)))
             if isinstance(olist, NDArray):
                 olist = [olist]
             for o in olist:
                 result = sp.RowSparseNDArray(
-                    NDArray(rows), nd_array(ids, dtype=np.int64),
-                    dense.shape)
+                    NDArray(rows), NDArray(ids), dense.shape)
                 if isinstance(o, sp.RowSparseNDArray):
                     o._data_arr = result._data_arr
                     o._indices = result._indices

@@ -128,16 +128,23 @@ class RowSparseNDArray(BaseSparseNDArray):
                          "supported" % stype)
 
     def retain(self, row_ids):
-        """Keep only the given rows (ref: sparse retain op)."""
-        rid = row_ids.asnumpy().astype(np.int64) \
-            if isinstance(row_ids, NDArray) else np.asarray(row_ids, np.int64)
-        cur = self._indices.asnumpy()
-        mask = np.isin(cur, rid)
-        new_idx = cur[mask]
-        data = self._data_arr.asnumpy()[mask]
-        return RowSparseNDArray(nd_array(data, dtype=self.dtype),
-                                nd_array(new_idx, dtype=np.int64),
-                                self._sshape)
+        """Keep only the given rows (ref: sparse retain op).
+
+        Runs ON DEVICE with static shapes (the reference's GPU answer to
+        the same problem was device-side sort/unique,
+        kvstore_utils.cu): the result's indices are exactly the
+        requested row_ids — requested-but-absent rows appear as explicit
+        zero rows rather than being compacted away (XLA needs static
+        shapes; the dense value is identical).  No host sync: embedding
+        training calls this every step."""
+        if isinstance(row_ids, NDArray):
+            rid = row_ids._h.array.astype(jnp.int64)
+        else:
+            rid = jnp.asarray(np.asarray(row_ids), jnp.int64)
+        data, idx = _retain_rows(self._data_arr._h.array,
+                                 self._indices._h.array.astype(jnp.int64),
+                                 rid)
+        return RowSparseNDArray(NDArray(data), NDArray(idx), self._sshape)
 
     def __repr__(self):
         return "\n<RowSparseNDArray %s @%s>" % (
@@ -400,3 +407,19 @@ def retain(data, indices):
     if isinstance(data, RowSparseNDArray):
         return data.retain(indices)
     raise MXNetError("retain only supports row_sparse")
+
+
+@jax.jit
+def _retain_rows(data, cur_idx, rid):
+    """Static-shape device kernel behind RowSparseNDArray.retain: for
+    each requested row id, binary-search the (sorted) stored indices and
+    gather its data row, zeros when absent."""
+    order = jnp.argsort(cur_idx)  # defensive: invariant says sorted
+    sorted_idx = cur_idx[order]
+    pos = jnp.searchsorted(sorted_idx, rid)
+    pos_c = jnp.clip(pos, 0, sorted_idx.shape[0] - 1)
+    found = sorted_idx[pos_c] == rid
+    rows = data[order[pos_c]]
+    rows = jnp.where(found.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     rows, jnp.zeros_like(rows[:1]))
+    return rows, rid

@@ -206,3 +206,84 @@ def test_libsvm_iter_padding_and_multilabel(tmp_path):
     it2 = mx.io.MXDataIter("LibSVMIter", data_libsvm=str(data),
                            data_shape=(3,), batch_size=2)
     assert isinstance(it2, mx.io.LibSVMIter)
+
+
+def test_retain_on_device_no_host_sync():
+    """retain must not touch the host (round-3 verdict item 7): embedding
+    training calls it per step; an asnumpy would stall on the device
+    queue every iteration."""
+    d = _rand_dense((16, 4), density=1.0, seed=11)
+    rs = sparse.row_sparse_array(d)
+    from mxnet_tpu.ndarray.ndarray import NDArray as _ND
+    real = _ND.asnumpy
+    calls = []
+    _ND.asnumpy = lambda self: (calls.append(1), real(self))[1]
+    try:
+        kept = rs.retain(mx.nd.array([3.0, 9.0, 12.0]))
+        assert not calls, "retain synced to host %d times" % len(calls)
+    finally:
+        _ND.asnumpy = real
+    dense = kept.asnumpy()
+    for r in (3, 9, 12):
+        np.testing.assert_allclose(dense[r], d[r])
+    others = [r for r in range(16) if r not in (3, 9, 12)]
+    assert np.abs(dense[others]).sum() == 0
+
+
+def test_retain_requested_but_absent_rows_are_zero():
+    data = np.array([[1., 1], [2, 2]], np.float32)
+    rs = sparse.RowSparseNDArray(mx.nd.array(data),
+                                 mx.nd.array([1, 3]), (6, 2))
+    kept = rs.retain(mx.nd.array([0.0, 1.0, 3.0, 5.0]))
+    dense = kept.asnumpy()
+    np.testing.assert_allclose(dense[1], [1, 1])
+    np.testing.assert_allclose(dense[3], [2, 2])
+    assert np.abs(dense[[0, 2, 4, 5]]).sum() == 0
+
+
+def test_row_sparse_pull_on_device_no_host_sync():
+    kv = mx.kv.create("local")
+    table = _rand_dense((32, 8), density=1.0, seed=12)
+    kv.init("emb", mx.nd.array(table))
+    out = sparse.zeros("row_sparse", (32, 8))
+    rid = mx.nd.array([4.0, 4.0, 17.0, 2.0])
+    from mxnet_tpu.ndarray.ndarray import NDArray as _ND
+    real = _ND.asnumpy
+    calls = []
+    _ND.asnumpy = lambda self: (calls.append(1), real(self))[1]
+    try:
+        kv.row_sparse_pull("emb", out=out, row_ids=rid)
+        assert not calls, "row_sparse_pull synced %d times" % len(calls)
+    finally:
+        _ND.asnumpy = real
+    dense = out.asnumpy()
+    for r in (2, 4, 17):
+        np.testing.assert_allclose(dense[r], table[r])
+    untouched = [r for r in range(32) if r not in (2, 4, 17)]
+    assert np.abs(dense[untouched]).sum() == 0
+
+
+def test_embedding_training_microbench_no_per_step_sync():
+    """A small embedding-training loop: row_sparse_pull + retain +
+    sparse-grad push every step, with host syncs counted — zero allowed
+    inside the loop (the step stays on the async device queue)."""
+    vocab, dim, steps = 64, 16, 5
+    kv = mx.kv.create("local")
+    rng = np.random.RandomState(13)
+    kv.init("w", mx.nd.array(rng.randn(vocab, dim).astype("f")))
+    out = sparse.zeros("row_sparse", (vocab, dim))
+    from mxnet_tpu.ndarray.ndarray import NDArray as _ND
+    real = _ND.asnumpy
+    calls = []
+    _ND.asnumpy = lambda self: (calls.append(1), real(self))[1]
+    try:
+        for step in range(steps):
+            ids = mx.nd.array(
+                rng.randint(0, vocab, (8,)).astype("f"))
+            kv.row_sparse_pull("w", out=out, row_ids=ids)
+            grad = out.retain(ids)  # touched rows only
+            kv.push("w", grad)      # sparse accumulate path
+        assert not calls, "%d host syncs inside the loop" % len(calls)
+    finally:
+        _ND.asnumpy = real
+    assert np.isfinite(kv._stored["w"].asnumpy()).all()
