@@ -83,6 +83,14 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
                              NDArrayHandle **outputs, int num_params,
                              const char **param_keys,
                              const char **param_vals);
+/* out= form (reference MXImperativeInvokeEx preallocated-outputs mode):
+ * results rebind into the caller-provided handles, enabling in-place
+ * optimizer updates on executor-bound weights. */
+int MXImperativeInvokeByNameInto(const char *op_name, int num_inputs,
+                                 NDArrayHandle *inputs, int num_outputs,
+                                 NDArrayHandle *outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
 
 /* -- Symbol ------------------------------------------------------------- */
 
@@ -97,6 +105,59 @@ int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
 int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
                                 const char ***out_array);
 int MXSymbolFree(SymbolHandle handle);
+
+/* -- Executor group (parity: c_api_executor.cc) --------------------------
+ * Bind caller-owned NDArrays to a symbol and run forward/backward.
+ * grad_req codes: 0=null, 1=write, 2=inplace(treated as write), 3=add. */
+typedef void *ExecutorHandle;
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/* head_grads may be NULL (loss-head semantics: ones) */
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+/* outputs are fresh handles the caller frees with MXNDArrayFree; the
+ * returned array pointer is thread-local, valid until the next
+ * MXExecutorOutputs/MXImperativeInvokeByName on this thread */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* -- Autograd group (parity: c_api_ndarray.cc MXAutograd*) --------------- */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles);
+/* ograd_handles may be NULL (ones for every head) */
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+/* fresh handle; caller frees */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* -- Symbol compose / attributes (parity: c_api_symbolic.cc) -------------
+ * CreateAtomicSymbol makes a pending op; Compose binds its inputs IN
+ * PLACE (the handle becomes the composed symbol).  ComposeEx returns a
+ * fresh handle instead and leaves the atom reusable-by-accident -- use
+ * Compose unless interop requires the Ex form. */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolComposeEx(SymbolHandle sym, const char *name, mx_uint num_args,
+                      const char **keys, SymbolHandle *args,
+                      SymbolHandle *out);
+/* *out is thread-local, valid until the next attr/list call */
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out);
 
 #ifdef __cplusplus
 }

@@ -1,0 +1,404 @@
+// C++ frontend: the NDArray / Symbol / Executor programming model over
+// the core C ABI (capability parity: cpp-package/include/mxnet-cpp/ —
+// ndarray.hpp, symbol.hpp, operator.hpp, executor.hpp condensed into one
+// header; deploy/train *sessions* live in predictor.hpp / trainer.hpp).
+//
+// Header-only, RAII, exception-based: every failing MX* call throws
+// mxnet_cpp::Error carrying MXGetLastError().  Handles are shared_ptr
+// owned, so NDArray/Symbol/Executor values copy freely.
+//
+// Usage:
+//   auto x = Symbol::Variable("data");
+//   auto fc = Operator("FullyConnected").SetParam("num_hidden", 10)
+//                 .CreateSymbol("fc1", {x});
+//   auto loss = Operator("SoftmaxOutput").CreateSymbol("softmax", {fc});
+//   Executor exe = loss.Bind(args, grads, reqs, aux);
+//   exe.Forward(true); exe.Backward();
+#ifndef MXNET_TPU_CPP_MXNET_CPP_HPP_
+#define MXNET_TPU_CPP_MXNET_CPP_HPP_
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxnet_cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXGetLastError());
+}
+
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context gpu(int id = 0) { return {2, id}; }
+  static Context tpu(int id = 0) { return {2, id}; }  // gpu aliases tpu
+};
+
+// ---------------------------------------------------------------------------
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  NDArray(const std::vector<mx_uint> &shape, Context ctx = Context::cpu()) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(), (mx_uint)shape.size(),
+                          ctx.dev_type, ctx.dev_id, 0, &h));
+    reset(h);
+  }
+
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          Context ctx = Context::cpu())
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  NDArrayHandle handle() const { return h_ ? h_.get() : nullptr; }
+  bool defined() const { return (bool)h_; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *dims = nullptr;
+    Check(MXNDArrayGetShape(handle(), &ndim, &dims));
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  void SyncCopyFromCPU(const float *data, size_t n) {
+    Check(MXNDArraySyncCopyFromCPU(handle(), data, n * sizeof(float)));
+  }
+
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle(), out.data(),
+                                 out.size() * sizeof(float)));
+    return out;
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle())); }
+
+  // imperative op on NDArrays (the cpp-package Operator::Invoke path)
+  static std::vector<NDArray> Invoke(
+      const std::string &op, const std::vector<NDArray> &inputs,
+      const std::map<std::string, std::string> &attrs = {}) {
+    std::vector<NDArrayHandle> in;
+    in.reserve(inputs.size());
+    for (const auto &a : inputs) in.push_back(a.handle());
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : attrs) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int num_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXImperativeInvokeByName(op.c_str(), (int)in.size(), in.data(),
+                                   &num_out, &outs, (int)keys.size(),
+                                   keys.data(), vals.data()));
+    std::vector<NDArray> result;
+    result.reserve(num_out);
+    for (int i = 0; i < num_out; ++i)
+      result.push_back(FromHandle(outs[i]));
+    return result;
+  }
+
+  // out= form: results land in the given (bound) arrays — the path
+  // optimizer updates take so executor-bound weights change in place
+  static void InvokeInto(const std::string &op,
+                         const std::vector<NDArray> &inputs,
+                         const std::vector<NDArray> &outs,
+                         const std::map<std::string, std::string> &attrs
+                         = {}) {
+    std::vector<NDArrayHandle> in;
+    for (const auto &a : inputs) in.push_back(a.handle());
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : attrs) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    std::vector<NDArrayHandle> out_h;
+    for (const auto &o : outs) out_h.push_back(o.handle());
+    Check(MXImperativeInvokeByNameInto(op.c_str(), (int)in.size(),
+                                       in.data(), (int)out_h.size(),
+                                       out_h.data(), (int)keys.size(),
+                                       keys.data(), vals.data()));
+  }
+
+  NDArray operator+(const NDArray &rhs) const {
+    return Invoke("elemwise_add", {*this, rhs})[0];
+  }
+  NDArray operator-(const NDArray &rhs) const {
+    return Invoke("elemwise_sub", {*this, rhs})[0];
+  }
+  NDArray operator*(const NDArray &rhs) const {
+    return Invoke("elemwise_mul", {*this, rhs})[0];
+  }
+  NDArray operator*(float s) const {
+    std::ostringstream os;
+    os << s;
+    return Invoke("_mul_scalar", {*this}, {{"scalar", os.str()}})[0];
+  }
+
+ private:
+  void reset(NDArrayHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void *p) {
+      if (p != nullptr) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+// ---------------------------------------------------------------------------
+
+enum class GradReq : mx_uint { kNull = 0, kWrite = 1, kAdd = 3 };
+
+class Executor;
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return FromHandle(h);
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return FromHandle(h);
+  }
+
+  static Symbol FromHandle(SymbolHandle h) {
+    Symbol s;
+    s.reset(h);
+    return s;
+  }
+
+  SymbolHandle handle() const { return h_ ? h_.get() : nullptr; }
+  bool defined() const { return (bool)h_; }
+
+  std::string ToJSON() const {
+    const char *out = nullptr;
+    Check(MXSymbolSaveToJSON(handle(), &out));
+    return out;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return List(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return List(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return List(&MXSymbolListAuxiliaryStates);
+  }
+
+  std::string GetAttr(const std::string &key) const {
+    const char *out = nullptr;
+    int ok = 0;
+    Check(MXSymbolGetAttr(handle(), key.c_str(), &out, &ok));
+    return ok ? std::string(out) : std::string();
+  }
+
+  void SetAttr(const std::string &key, const std::string &value) {
+    Check(MXSymbolSetAttr(handle(), key.c_str(), value.c_str()));
+  }
+
+  Symbol GetInternals() const {
+    SymbolHandle out = nullptr;
+    Check(MXSymbolGetInternals(handle(), &out));
+    return FromHandle(out);
+  }
+
+  Symbol operator[](mx_uint i) const {
+    SymbolHandle out = nullptr;
+    Check(MXSymbolGetOutput(handle(), i, &out));
+    return FromHandle(out);
+  }
+
+  Executor Bind(Context ctx, const std::vector<NDArray> &args,
+                const std::vector<NDArray> &arg_grads,
+                const std::vector<GradReq> &grad_reqs,
+                const std::vector<NDArray> &aux_states) const;
+
+ private:
+  template <typename F>
+  std::vector<std::string> List(F fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(handle(), &n, &arr));
+    std::vector<std::string> out;
+    out.reserve(n);
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+
+  void reset(SymbolHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void *p) {
+      if (p != nullptr) MXSymbolFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+// Op builder: attrs now, inputs at CreateSymbol (the cpp-package
+// Operator::SetParam / CreateSymbol flow over CreateAtomicSymbol+Compose).
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_keys_.push_back(name);
+    inputs_.push_back(sym);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name = "",
+                      const std::vector<Symbol> &args = {}) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle atom = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(op_.c_str(), (mx_uint)keys.size(),
+                                     keys.data(), vals.data(), &atom));
+    Symbol sym = Symbol::FromHandle(atom);
+    std::vector<Symbol> all = inputs_;
+    for (const auto &a : args) all.push_back(a);
+    std::vector<SymbolHandle> handles;
+    for (const auto &a : all) handles.push_back(a.handle());
+    std::vector<const char *> in_keys;
+    for (const auto &k : input_keys_) in_keys.push_back(k.c_str());
+    Check(MXSymbolCompose(sym.handle(), name.empty() ? nullptr
+                                                     : name.c_str(),
+                          (mx_uint)handles.size(),
+                          in_keys.size() == handles.size()
+                              ? in_keys.data() : nullptr,
+                          handles.data()));
+    return sym;
+  }
+
+ private:
+  std::string op_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> inputs_;
+};
+
+// ---------------------------------------------------------------------------
+
+class Executor {
+ public:
+  Executor() = default;
+
+  Executor(const Symbol &sym, Context ctx, const std::vector<NDArray> &args,
+           const std::vector<NDArray> &arg_grads,
+           const std::vector<GradReq> &grad_reqs,
+           const std::vector<NDArray> &aux_states)
+      : args_(args), arg_grads_(arg_grads), aux_(aux_states) {
+    std::vector<NDArrayHandle> in, grads;
+    std::vector<mx_uint> reqs;
+    for (size_t i = 0; i < args.size(); ++i) {
+      in.push_back(args[i].handle());
+      grads.push_back(i < arg_grads.size() && arg_grads[i].defined()
+                          ? arg_grads[i].handle() : nullptr);
+      reqs.push_back(i < grad_reqs.size() ? (mx_uint)grad_reqs[i]
+                                          : (mx_uint)GradReq::kNull);
+    }
+    std::vector<NDArrayHandle> aux;
+    for (const auto &a : aux_states) aux.push_back(a.handle());
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorBind(sym.handle(), ctx.dev_type, ctx.dev_id,
+                         (mx_uint)in.size(), in.data(), grads.data(),
+                         reqs.data(), (mx_uint)aux.size(), aux.data(), &h));
+    h_ = std::shared_ptr<void>(h, [](void *p) {
+      if (p != nullptr) MXExecutorFree(p);
+    });
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_.get(), is_train ? 1 : 0));
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(h_.get(), &n, &outs));
+    outputs_.clear();
+    for (mx_uint i = 0; i < n; ++i)
+      outputs_.push_back(NDArray::FromHandle(outs[i]));
+  }
+
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> heads;
+    for (const auto &h : head_grads) heads.push_back(h.handle());
+    Check(MXExecutorBackward(h_.get(), (mx_uint)heads.size(),
+                             heads.empty() ? nullptr : heads.data()));
+  }
+
+  const std::vector<NDArray> &outputs() const { return outputs_; }
+  const std::vector<NDArray> &arg_arrays() const { return args_; }
+  const std::vector<NDArray> &grad_arrays() const { return arg_grads_; }
+
+ private:
+  std::shared_ptr<void> h_;
+  std::vector<NDArray> args_, arg_grads_, aux_, outputs_;
+};
+
+inline Executor Symbol::Bind(Context ctx, const std::vector<NDArray> &args,
+                             const std::vector<NDArray> &arg_grads,
+                             const std::vector<GradReq> &grad_reqs,
+                             const std::vector<NDArray> &aux_states) const {
+  return Executor(*this, ctx, args, arg_grads, grad_reqs, aux_states);
+}
+
+// Plain SGD over an executor's bound (arg, grad) pairs — the minimal
+// cpp-package Optimizer analog; richer schedules belong to the host
+// language driving the session.
+inline void SGDUpdate(Executor *exe, const std::vector<bool> &trainable,
+                      float lr) {
+  const auto &args = exe->arg_arrays();
+  const auto &grads = exe->grad_arrays();
+  for (size_t i = 0; i < args.size(); ++i) {
+    if (i >= trainable.size() || !trainable[i]) continue;
+    if (i >= grads.size() || !grads[i].defined()) continue;
+    std::ostringstream os;
+    os << lr;
+    NDArray::InvokeInto("sgd_update", {args[i], grads[i]}, {args[i]},
+                        {{"lr", os.str()}});
+  }
+}
+
+}  // namespace mxnet_cpp
+
+#endif  // MXNET_TPU_CPP_MXNET_CPP_HPP_

@@ -122,15 +122,20 @@ def list_op_names():
     return sorted(registry.op_registry().keys())
 
 
-def imperative_invoke(op_name, inputs, keys, vals):
+def imperative_invoke(op_name, inputs, keys, vals, out_arrs=None):
     """Invoke a registered op by name on NDArray handles.
 
     Attr values arrive as strings (the reference's C convention); the
     registry's normalize_attrs parses them exactly like symbol JSON attrs.
+    out_arrs (reference MXImperativeInvokeEx semantics) supplies
+    preallocated destinations whose handles rebind to the results.
     Returns a list of output NDArrays."""
     from .ndarray import _invoke
     attrs = dict(zip(keys, vals))
-    out = _invoke(op_name, list(inputs), attrs)
+    out = _invoke(op_name, list(inputs), attrs,
+                  out=list(out_arrs) if out_arrs else None)
+    if out_arrs:
+        return list(out_arrs)
     return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
@@ -153,3 +158,149 @@ def symbol_list_arguments(sym):
 
 def symbol_list_aux(sym):
     return list(sym.list_auxiliary_states())
+
+
+# -- executor group (ref: c_api_executor.cc MXExecutorBind/Forward/...) ------
+
+def executor_bind(sym, dev_type, dev_id, arg_handles, grad_handles,
+                  grad_req_codes, aux_handles):
+    """Bind a symbol against caller-owned NDArrays.  grad_req codes use
+    the reference's enum: 0=null, 1=write, 2=inplace(→write), 3=add."""
+    from .executor import Executor
+    ctx = _ctx(dev_type, dev_id)
+    req_names = {0: "null", 1: "write", 2: "write", 3: "add"}
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    args = dict(zip(arg_names, arg_handles))
+    grads = {n: g for n, g in zip(arg_names, grad_handles)
+             if g is not None}
+    reqs = {n: req_names.get(int(c), "null")
+            for n, c in zip(arg_names, grad_req_codes)}
+    auxs = dict(zip(aux_names, aux_handles))
+    return Executor(sym, ctx, args, grads, auxs, reqs)
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+    return None
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+    return None
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+# -- autograd group (ref: c_api_ndarray.cc MXAutograd*) ----------------------
+
+def autograd_set_recording(flag):
+    from . import autograd
+    prev = autograd.is_recording()
+    autograd.set_recording(bool(flag))
+    return int(prev)
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    prev = autograd.is_training()
+    autograd.set_training(bool(flag))
+    return int(prev)
+
+
+def autograd_mark_variables(variables, req_codes, gradients):
+    from . import autograd
+    req_names = {0: "null", 1: "write", 2: "write", 3: "add"}
+    for v, c, g in zip(variables, req_codes, gradients):
+        autograd.mark_variables([v], [g], req_names.get(int(c), "write"))
+    return None
+
+
+def autograd_backward(outputs, head_grads, retain_graph):
+    from . import autograd
+    ograds = list(head_grads) if head_grads else None
+    autograd.backward(list(outputs), ograds,
+                      retain_graph=bool(retain_graph))
+    return None
+
+
+def ndarray_get_grad(arr):
+    if getattr(arr, "_grad", None) is None:
+        raise ValueError("array has no gradient buffer; mark_variables "
+                         "first")
+    return arr._grad
+
+
+# -- symbol compose/attr group (ref: c_api_symbolic.cc) ----------------------
+
+def symbol_create_variable(name):
+    from . import symbol as sym_mod
+    return sym_mod.var(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    """A free-floating op symbol awaiting compose (reference
+    CreateAtomicSymbol semantics: attrs bind now, inputs bind later).
+    Returned as an empty Symbol carrying the pending op so MXSymbolCompose
+    can fill it IN PLACE, honoring the reference's mutate-the-handle
+    contract."""
+    from .symbol.symbol import Symbol
+    atom = Symbol([])
+    atom._atomic_op = op_name
+    atom._atomic_attrs = dict(zip(keys, vals))
+    return atom
+
+
+def symbol_compose(atom, name, keys, arg_syms):
+    from . import symbol as sym_mod
+    op_name = getattr(atom, "_atomic_op", None)
+    if op_name is None:
+        raise ValueError("compose target is not an atomic symbol")
+    kwargs = dict(atom._atomic_attrs)
+    if name:
+        kwargs["name"] = name
+    fn = getattr(sym_mod, op_name, None)
+    if fn is None:
+        raise ValueError("unknown operator %r" % op_name)
+    if keys:  # named inputs
+        composed = fn(**dict(zip(keys, arg_syms)), **kwargs)
+    else:
+        composed = fn(*arg_syms, **kwargs)
+    atom._entries = list(composed._entries)  # in-place: handle is composed
+    return composed
+
+
+def symbol_get_attr(sym, key):
+    found = sym.attr(key)
+    if found is None and not (key.startswith("__") and key.endswith("__")):
+        # free-form attrs round-trip through the metadata namespace
+        found = sym.attr("__%s__" % key)
+    return found
+
+
+def symbol_set_attr(sym, key, value):
+    """Reference MXSymbolSetAttr accepts ANY key (metadata like
+    ctx_group/mirror_stage); this evaluator is strict about op params,
+    so non-parameter keys store in the dunder metadata namespace the
+    graph walk already skips."""
+    from .ops.registry import op_registry
+    entry = sym._entries[0][0] if sym._entries else None
+    is_param = False
+    if entry is not None and not entry.is_var:
+        op = op_registry().get(entry.op_name)
+        is_param = op is not None and key in op.params
+    if is_param or (key.startswith("__") and key.endswith("__")):
+        sym._set_attr(**{key: value})
+    else:
+        sym._set_attr(**{"__%s__" % key: value})
+    return None
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]

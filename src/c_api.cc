@@ -19,6 +19,7 @@ extern "C" {
 typedef unsigned int mx_uint;
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
 }
 
 namespace {
@@ -421,10 +422,13 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
     Py_INCREF(h);
     PyList_SET_ITEM(ins, i, h);
   }
+  PyObject *none = Py_None;
+  Py_INCREF(none);
   PyObject *res = support_call(
       "imperative_invoke",
-      Py_BuildValue("(sNNN)", op_name, ins, str_list(param_keys, num_params),
-                    str_list(param_vals, num_params)));
+      Py_BuildValue("(sNNNN)", op_name, ins,
+                    str_list(param_keys, num_params),
+                    str_list(param_vals, num_params), none));
   if (!res) return -1;
   tl_invoke_handles.clear();
   for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
@@ -435,6 +439,45 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
   Py_DECREF(res);
   *num_outputs = (int)tl_invoke_handles.size();
   *outputs = tl_invoke_handles.data();
+  return 0;
+}
+
+// out= form of invoke (the reference MXImperativeInvokeEx's preallocated
+// -outputs mode as its own entry point — MXImperativeInvokeByName keeps
+// its returns-fresh-handles contract, where callers may legally reuse the
+// outputs pointer variable across calls)
+int MXImperativeInvokeByNameInto(const char *op_name, int num_inputs,
+                                 NDArrayHandle *inputs, int num_outputs,
+                                 NDArrayHandle *outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals) {
+  CHECK_NULL(op_name, "op name");
+  if (num_inputs > 0) CHECK_NULL(inputs, "inputs");
+  if (num_outputs > 0) CHECK_NULL(outputs, "outputs");
+  if (num_params > 0) {
+    CHECK_NULL(param_keys, "param keys");
+    CHECK_NULL(param_vals, "param vals");
+  }
+  GIL gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *h = (PyObject *)inputs[i];
+    Py_INCREF(h);
+    PyList_SET_ITEM(ins, i, h);
+  }
+  PyObject *outs_given = PyList_New(num_outputs);
+  for (int i = 0; i < num_outputs; ++i) {
+    PyObject *h = (PyObject *)outputs[i];
+    Py_INCREF(h);
+    PyList_SET_ITEM(outs_given, i, h);
+  }
+  PyObject *res = support_call(
+      "imperative_invoke",
+      Py_BuildValue("(sNNNN)", op_name, ins,
+                    str_list(param_keys, num_params),
+                    str_list(param_vals, num_params), outs_given));
+  if (!res) return -1;
+  Py_DECREF(res);  // results live in the caller-provided handles
   return 0;
 }
 
@@ -519,4 +562,343 @@ int MXSymbolFree(SymbolHandle handle) {
   return 0;
 }
 
+
+// -- Executor group (ref: src/c_api/c_api_executor.cc:132 MXExecutorBind,
+// :220 MXExecutorForward/Backward/Outputs) ----------------------------------
+
+int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  CHECK_NULL(symbol, "SymbolHandle");
+  CHECK_NULL(out, "output pointer");
+  if (len > 0) {
+    CHECK_NULL(in_args, "in_args");
+    CHECK_NULL(grad_req_type, "grad_req_type");
+  }
+  GIL gil;
+  PyObject *args = PyList_New(len);
+  PyObject *grads = PyList_New(len);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *a = (PyObject *)in_args[i];
+    Py_INCREF(a);
+    PyList_SET_ITEM(args, i, a);
+    PyObject *g = (arg_grad_store && arg_grad_store[i])
+                      ? (PyObject *)arg_grad_store[i] : Py_None;
+    Py_INCREF(g);
+    PyList_SET_ITEM(grads, i, g);
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  }
+  PyObject *auxs = PyList_New(aux_states_len);
+  for (mx_uint i = 0; i < aux_states_len; ++i) {
+    PyObject *a = (PyObject *)aux_states[i];
+    Py_INCREF(a);
+    PyList_SET_ITEM(auxs, i, a);
+  }
+  PyObject *res = support_call(
+      "executor_bind",
+      Py_BuildValue("(OiiNNNN)", (PyObject *)symbol, dev_type, dev_id, args,
+                    grads, reqs, auxs));
+  if (!res) return -1;
+  *out = res;  // handle owns the reference
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  CHECK_NULL(handle, "ExecutorHandle");
+  GIL gil;
+  PyObject *res = support_call(
+      "executor_forward",
+      Py_BuildValue("(Oi)", (PyObject *)handle, is_train));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  CHECK_NULL(handle, "ExecutorHandle");
+  GIL gil;
+  PyObject *heads;
+  if (len == 0 || head_grads == nullptr) {
+    heads = Py_None;
+    Py_INCREF(heads);
+  } else {
+    heads = PyList_New(len);
+    for (mx_uint i = 0; i < len; ++i) {
+      PyObject *h = (PyObject *)head_grads[i];
+      Py_INCREF(h);
+      PyList_SET_ITEM(heads, i, h);
+    }
+  }
+  PyObject *res = support_call(
+      "executor_backward",
+      Py_BuildValue("(ON)", (PyObject *)handle, heads));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  CHECK_NULL(handle, "ExecutorHandle");
+  CHECK_NULL(out_size, "output pointer");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "executor_outputs", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  tl_invoke_handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);  // caller frees via MXNDArrayFree
+    tl_invoke_handles.push_back((void *)o);
+  }
+  Py_DECREF(res);
+  *out_size = (mx_uint)n;
+  *out = tl_invoke_handles.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+// -- Autograd group (ref: src/c_api/c_api_ndarray.cc MXAutograd*) -----------
+
+static int autograd_toggle(const char *fn, int flag, int *prev) {
+  GIL gil;
+  PyObject *res = support_call(fn, Py_BuildValue("(i)", flag));
+  if (!res) return -1;
+  if (prev != nullptr) *prev = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return autograd_toggle("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return autograd_toggle("autograd_set_training", is_training, prev);
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  if (num_var > 0) {
+    CHECK_NULL(var_handles, "var_handles");
+    CHECK_NULL(reqs_array, "reqs_array");
+    CHECK_NULL(grad_handles, "grad_handles");
+  }
+  GIL gil;
+  PyObject *vars = PyList_New(num_var);
+  PyObject *reqs = PyList_New(num_var);
+  PyObject *grads = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyObject *v = (PyObject *)var_handles[i];
+    Py_INCREF(v);
+    PyList_SET_ITEM(vars, i, v);
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+    PyObject *g = (PyObject *)grad_handles[i];
+    Py_INCREF(g);
+    PyList_SET_ITEM(grads, i, g);
+  }
+  PyObject *res = support_call(
+      "autograd_mark_variables", Py_BuildValue("(NNN)", vars, reqs, grads));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  if (num_output > 0) CHECK_NULL(output_handles, "output_handles");
+  GIL gil;
+  PyObject *outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject *o = (PyObject *)output_handles[i];
+    Py_INCREF(o);
+    PyList_SET_ITEM(outs, i, o);
+  }
+  PyObject *heads;
+  if (ograd_handles == nullptr) {
+    heads = Py_None;
+    Py_INCREF(heads);
+  } else {
+    heads = PyList_New(num_output);
+    for (mx_uint i = 0; i < num_output; ++i) {
+      PyObject *h = (PyObject *)ograd_handles[i];
+      Py_INCREF(h);
+      PyList_SET_ITEM(heads, i, h);
+    }
+  }
+  PyObject *res = support_call(
+      "autograd_backward",
+      Py_BuildValue("(NNi)", outs, heads, retain_graph));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  CHECK_NULL(handle, "NDArrayHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "ndarray_get_grad", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out = res;  // caller frees
+  return 0;
+}
+
+// -- Symbol compose/attrs (ref: src/c_api/c_api_symbolic.cc) ----------------
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  CHECK_NULL(name, "name");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_create_variable", Py_BuildValue("(s)", name));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  CHECK_NULL(op_name, "op name");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_create_atomic",
+      Py_BuildValue("(sNN)", op_name, str_list(keys, (int)num_param),
+                    str_list(vals, (int)num_param)));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  CHECK_NULL(sym, "SymbolHandle");
+  GIL gil;
+  PyObject *arg_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *a = (PyObject *)args[i];
+    Py_INCREF(a);
+    PyList_SET_ITEM(arg_list, i, a);
+  }
+  PyObject *key_list = keys ? str_list(keys, (int)num_args) : Py_None;
+  if (!keys) Py_INCREF(Py_None);
+  PyObject *res = support_call(
+      "symbol_compose",
+      Py_BuildValue("(OsNN)", (PyObject *)sym, name ? name : "", key_list,
+                    arg_list));
+  if (!res) return -1;
+  // the support function filled the atomic handle's entries in place
+  // (the reference's mutate-the-handle contract); the returned composed
+  // Symbol is the same graph and is not needed here
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolComposeEx(SymbolHandle sym, const char *name, mx_uint num_args,
+                      const char **keys, SymbolHandle *args,
+                      SymbolHandle *out) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *arg_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *a = (PyObject *)args[i];
+    Py_INCREF(a);
+    PyList_SET_ITEM(arg_list, i, a);
+  }
+  PyObject *key_list;
+  if (keys) {
+    key_list = str_list(keys, (int)num_args);
+  } else {
+    key_list = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *res = support_call(
+      "symbol_compose",
+      Py_BuildValue("(OsNN)", (PyObject *)sym, name ? name : "", key_list,
+                    arg_list));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(key, "key");
+  CHECK_NULL(out, "output pointer");
+  CHECK_NULL(success, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_get_attr", Py_BuildValue("(Os)", (PyObject *)sym, key));
+  if (!res) return -1;
+  if (res == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    const char *s = PyUnicode_AsUTF8(res);
+    if (s == nullptr) {
+      PyErr_Clear();
+      s = "";
+    }
+    tl_json = s;  // reuse the string stash; lifetime: until next call
+    *out = tl_json.c_str();
+    *success = 1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(key, "key");
+  CHECK_NULL(value, "value");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_set_attr",
+      Py_BuildValue("(Oss)", (PyObject *)sym, key, value));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_get_internals", Py_BuildValue("(O)", (PyObject *)sym));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "symbol_get_output", Py_BuildValue("(OI)", (PyObject *)sym, index));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
 }  // extern "C"
+
