@@ -296,6 +296,10 @@ class Operator {
     Check(MXSymbolCreateAtomicSymbol(op_.c_str(), (mx_uint)keys.size(),
                                      keys.data(), vals.data(), &atom));
     Symbol sym = Symbol::FromHandle(atom);
+    if (!input_keys_.empty() && !args.empty())
+      throw Error("Operator::CreateSymbol: mixing SetInput() named "
+                  "inputs with positional args is ambiguous; use one "
+                  "style for every input");
     std::vector<Symbol> all = inputs_;
     for (const auto &a : args) all.push_back(a);
     std::vector<SymbolHandle> handles;
@@ -305,8 +309,7 @@ class Operator {
     Check(MXSymbolCompose(sym.handle(), name.empty() ? nullptr
                                                      : name.c_str(),
                           (mx_uint)handles.size(),
-                          in_keys.size() == handles.size()
-                              ? in_keys.data() : nullptr,
+                          in_keys.empty() ? nullptr : in_keys.data(),
                           handles.data()));
     return sym;
   }

@@ -131,7 +131,15 @@ def imperative_invoke(op_name, inputs, keys, vals, out_arrs=None):
     preallocated destinations whose handles rebind to the results.
     Returns a list of output NDArrays."""
     from .ndarray import _invoke
+    from .ops.registry import get_op
     attrs = dict(zip(keys, vals))
+    if out_arrs:
+        op = get_op(op_name)
+        want = op.str_outputs(op.normalize_attrs(dict(attrs)))
+        if len(out_arrs) != want:
+            raise ValueError(
+                "%s produces %d output(s) but %d preallocated handles "
+                "were given" % (op_name, want, len(out_arrs)))
     out = _invoke(op_name, list(inputs), attrs,
                   out=list(out_arrs) if out_arrs else None)
     if out_arrs:
@@ -186,8 +194,24 @@ def executor_forward(exe, is_train):
 
 
 def executor_backward(exe, head_grads):
-    exe.backward(list(head_grads) if head_grads else None)
+    exe.backward(_fill_head_grads(head_grads, exe.outputs))
     return None
+
+
+def _fill_head_grads(head_grads, outputs):
+    """None entries mean 'ones for this head' (reference C semantics)."""
+    if not head_grads:
+        return None
+    from .ndarray import NDArray, ones as nd_ones
+    filled = []
+    for grad, out in zip(head_grads, list(outputs) + [None] * len(head_grads)):
+        if grad is not None:
+            filled.append(grad)
+        elif out is not None:
+            filled.append(nd_ones(out.shape, dtype=out.dtype))
+        else:
+            raise ValueError("NULL head grad without a matching output")
+    return filled
 
 
 def executor_outputs(exe):
@@ -220,7 +244,7 @@ def autograd_mark_variables(variables, req_codes, gradients):
 
 def autograd_backward(outputs, head_grads, retain_graph):
     from . import autograd
-    ograds = list(head_grads) if head_grads else None
+    ograds = _fill_head_grads(head_grads, outputs)
     autograd.backward(list(outputs), ograds,
                       retain_graph=bool(retain_graph))
     return None

@@ -1,4 +1,14 @@
-"""Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+"""Vision model zoo.
+
+API parity with the reference registry (python/mxnet/gluon/model_zoo/
+vision/__init__.py): every builder importable by name plus get_model().
+The registry is assembled by scanning the submodules' exported builders
+instead of a hand-maintained table.
+"""
+from . import (alexnet as _m_alexnet, densenet as _m_densenet,
+               inception as _m_inception, mobilenet as _m_mobilenet,
+               resnet as _m_resnet, squeezenet as _m_squeezenet,
+               vgg as _m_vgg)
 from .alexnet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .inception import *  # noqa: F401,F403
@@ -7,37 +17,33 @@ from .resnet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
 
-from . import alexnet as _alexnet
-from . import densenet as _densenet
-from . import inception as _inception
-from . import mobilenet as _mobilenet
-from . import resnet as _resnet
-from . import squeezenet as _squeezenet
-from . import vgg as _vgg
+# registry names follow the reference spelling: squeezenet/mobilenet
+# versions are dotted ("squeezenet1.0"), everything else underscored
+_ALIAS = {"squeezenet1_0": "squeezenet1.0", "squeezenet1_1": "squeezenet1.1",
+          "mobilenet1_0": "mobilenet1.0", "mobilenet0_75": "mobilenet0.75",
+          "mobilenet0_5": "mobilenet0.5", "mobilenet0_25": "mobilenet0.25",
+          "inception_v3": "inceptionv3"}
+
+
+def _collect():
+    registry = {}
+    for mod in (_m_alexnet, _m_densenet, _m_inception, _m_mobilenet,
+                _m_resnet, _m_squeezenet, _m_vgg):
+        for name in getattr(mod, "__all__", ()):
+            entry = getattr(mod, name)
+            if callable(entry) and not isinstance(entry, type) \
+                    and not name.startswith(("get_",)):
+                registry[_ALIAS.get(name, name)] = entry
+    return registry
+
+
+_MODELS = _collect()
 
 
 def get_model(name, **kwargs):
     """Return a model by name, e.g. get_model('resnet50_v1', classes=10)."""
-    models = {
-        "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
-        "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
-        "resnet152_v1": resnet152_v1,
-        "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
-        "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
-        "resnet152_v2": resnet152_v2,
-        "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
-        "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
-        "vgg19_bn": vgg19_bn,
-        "alexnet": alexnet,
-        "densenet121": densenet121, "densenet161": densenet161,
-        "densenet169": densenet169, "densenet201": densenet201,
-        "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
-        "inceptionv3": inception_v3,
-        "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
-        "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
-    }
-    name = name.lower()
-    if name not in models:
-        raise ValueError("Model %r not found; available: %s" % (
-            name, sorted(models)))
-    return models[name](**kwargs)
+    key = name.lower()
+    if key not in _MODELS:
+        raise ValueError("Model %r not found; available: %s"
+                         % (name, sorted(_MODELS)))
+    return _MODELS[key](**kwargs)

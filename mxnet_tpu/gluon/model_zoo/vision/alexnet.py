@@ -1,10 +1,24 @@
-"""AlexNet (parity: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet.
+
+Architecture parity with the reference zoo entry (python/mxnet/gluon/
+model_zoo/vision/alexnet.py) — same layer stack so pretrained weights
+line up by position — built here from a declarative layer table.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["AlexNet", "alexnet"]
+
+# (channels, kernel, stride, pad, pool-after?)
+_CONV_PLAN = (
+    (64, 11, 4, 2, True),
+    (192, 5, 1, 2, True),
+    (384, 3, 1, 1, False),
+    (256, 3, 1, 1, False),
+    (256, 3, 1, 1, True),
+)
 
 
 class AlexNet(HybridBlock):
@@ -13,30 +27,21 @@ class AlexNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                for ch, k, s, p, pool in _CONV_PLAN:
+                    self.features.add(nn.Conv2D(
+                        ch, kernel_size=k, strides=s, padding=p,
+                        activation="relu"))
+                    if pool:
+                        self.features.add(
+                            nn.MaxPool2D(pool_size=3, strides=2))
                 self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.features.add(nn.Dense(4096, activation="relu"))
+                    self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=None, **kwargs):

@@ -1,29 +1,27 @@
 """MobileNet v1 (width multipliers 1.0/0.75/0.5/0.25).
 
-Parity: gluon/model_zoo/vision/mobilenet.py.  Depthwise convolutions map to
-XLA's feature_group_count grouped convolution — efficient on the MXU without a
-hand-written kernel.
+Architecture parity with the reference zoo entry (python/mxnet/gluon/
+model_zoo/vision/mobilenet.py).  Depthwise convolutions lower to XLA's
+feature_group_count grouped convolution — MXU-efficient without a
+hand-written kernel.  The body is one table of (depthwise-channels,
+pointwise-channels, stride) rows.
 """
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["MobileNet", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
-           "mobilenet0_25"]
+__all__ = ["MobileNet", "get_mobilenet", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25"]
 
-
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    out.add(nn.Activation("relu"))
-
-
-def _add_conv_dw(out, dw_channels, channels, stride):
-    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels)
-    _add_conv(out, channels)
+# (dw_channels, out_channels, stride) at multiplier 1.0
+_BODY = ((32, 64, 1),
+         (64, 128, 2), (128, 128, 1),
+         (128, 256, 2), (256, 256, 1),
+         (256, 512, 2),
+         (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+         (512, 512, 1),
+         (512, 1024, 2), (1024, 1024, 1))
 
 
 class MobileNet(HybridBlock):
@@ -32,49 +30,45 @@ class MobileNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3,
-                          stride=2, pad=1)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2 +
-                               [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6 +
-                            [1024] * 2]
-                strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dwc, c, s)
+                self._unit(int(32 * multiplier), kernel=3, stride=2, pad=1)
+                for dw, out, stride in _BODY:
+                    dw, out = int(dw * multiplier), int(out * multiplier)
+                    # depthwise 3x3 then pointwise 1x1
+                    self._unit(dw, kernel=3, stride=stride, pad=1,
+                               groups=dw)
+                    self._unit(out)
                 self.features.add(nn.GlobalAvgPool2D())
                 self.features.add(nn.Flatten())
             self.output = nn.Dense(classes)
 
+    def _unit(self, channels, kernel=1, stride=1, pad=0, groups=1):
+        self.features.add(nn.Conv2D(channels, kernel, stride, pad,
+                                    groups=groups, use_bias=False))
+        self.features.add(nn.BatchNorm(scale=True))
+        self.features.add(nn.Activation("relu"))
+
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        version_suffix = "{0:.2f}".format(multiplier)
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        load_pretrained(net, "mobilenet%s" % version_suffix, ctx)
+        tag = "{0:.2f}".format(multiplier)
+        if tag in ("1.00", "0.50"):
+            tag = tag[:-1]
+        load_pretrained(net, "mobilenet%s" % tag, ctx)
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _entry(multiplier):
+    def build(**kwargs):
+        return get_mobilenet(multiplier, **kwargs)
+    return build
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
+mobilenet1_0 = _entry(1.0)
+mobilenet0_75 = _entry(0.75)
+mobilenet0_5 = _entry(0.5)
+mobilenet0_25 = _entry(0.25)

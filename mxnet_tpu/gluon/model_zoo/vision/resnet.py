@@ -1,11 +1,13 @@
 """ResNet V1/V2 Gluon models.
 
-Capability-parity target: python/mxnet/gluon/model_zoo/vision/resnet.py in the
-reference (resnet18/34/50/101/152 in both v1 [He et al. 2015, post-activation]
-and v2 [He et al. 2016, pre-activation] flavours).  Written TPU-first: plain
-HybridBlocks whose hybridized form lowers to one XLA computation; BatchNorm +
-ReLU fuse into the surrounding convolutions under XLA, so no hand-fused
-"conv-bn-relu" kernel is needed.
+Architecture parity with the reference zoo (python/mxnet/gluon/
+model_zoo/vision/resnet.py): resnet18/34/50/101/152 in both v1
+(post-activation) and v2 (pre-activation) flavors.  TPU-first: plain
+HybridBlocks whose hybridized form lowers to one XLA computation —
+BatchNorm+ReLU fuse into the surrounding convolutions under XLA, so no
+hand-fused kernel is needed.  One parameterized residual block per
+version covers basic and bottleneck branches; the public Basic*/
+Bottleneck* class names remain as thin configurations of it.
 """
 from __future__ import annotations
 
@@ -19,239 +21,183 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
            "resnet152_v2"]
 
-
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    """ResNet V1 basic residual block: conv3x3-BN-relu-conv3x3-BN + shortcut."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample is not None:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
-
-
-class BottleneckV1(HybridBlock):
-    """ResNet V1 bottleneck: 1x1 reduce, 3x3, 1x1 expand (4x)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample is not None:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
-
-
-class BasicBlockV2(HybridBlock):
-    """ResNet V2 pre-activation basic block: BN-relu-conv, BN-relu-conv."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample is not None:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
-
-
-class BottleneckV2(HybridBlock):
-    """ResNet V2 pre-activation bottleneck."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample is not None:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    """ResNet V1 (post-activation)."""
-
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-class ResNetV2(HybridBlock):
-    """ResNet V2 (pre-activation)."""
-
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-# depth -> (block_kind, per-stage unit counts, per-stage channels)
+# depth -> (bottleneck?, per-stage unit counts, per-stage channels)
 resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+    18: (False, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: (False, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: (True, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: (True, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: (True, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
 }
 
+
+def _conv(channels, kernel, stride=1, in_channels=0):
+    pad = (kernel - 1) // 2
+    return nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                     padding=pad, use_bias=False, in_channels=in_channels)
+
+
+class _ResidualV1(HybridBlock):
+    """Post-activation residual unit: body -> add shortcut -> relu.
+
+    basic: [3x3/s, BN, relu, 3x3, BN]; bottleneck: [1x1/s, BN, relu,
+    3x3, BN, relu, 1x1, BN].  The projection shortcut (1x1/s + BN)
+    appears whenever channels change.
+    """
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 bottleneck=False, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        if bottleneck:
+            plan = [(channels // 4, 1, stride), (channels // 4, 3, 1),
+                    (channels, 1, 1)]
+        else:
+            plan = [(channels, 3, stride), (channels, 3, 1)]
+        for i, (ch, k, s) in enumerate(plan):
+            self.body.add(_conv(ch, k, s,
+                                in_channels if i == 0 and not bottleneck
+                                else 0))
+            self.body.add(nn.BatchNorm())
+            if i + 1 < len(plan):
+                self.body.add(nn.Activation("relu"))
+        self.downsample = None
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(
+                channels, kernel_size=1, strides=stride, use_bias=False,
+                in_channels=in_channels))
+            self.downsample.add(nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        shortcut = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + shortcut, act_type="relu")
+
+
+class _ResidualV2(HybridBlock):
+    """Pre-activation residual unit: BN-relu precedes each conv, and the
+    projection shortcut taps the PRE-ACTIVATED input (He 2016)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 bottleneck=False, **kwargs):
+        super().__init__(**kwargs)
+        if bottleneck:
+            plan = [(channels // 4, 1, 1), (channels // 4, 3, stride),
+                    (channels, 1, 1)]
+        else:
+            plan = [(channels, 3, stride), (channels, 3, 1)]
+        self._norms = []
+        self._convs = []
+        for i, (ch, k, s) in enumerate(plan):
+            bn = nn.BatchNorm()
+            conv = _conv(ch, k, s,
+                         in_channels if i == 0 and not bottleneck else 0)
+            setattr(self, "bn%d" % (i + 1), bn)
+            setattr(self, "conv%d" % (i + 1), conv)
+            self._norms.append(bn)
+            self._convs.append(conv)
+        self.downsample = nn.Conv2D(
+            channels, 1, stride, use_bias=False,
+            in_channels=in_channels) if downsample else None
+
+    def hybrid_forward(self, F, x):
+        shortcut = x
+        for i, (bn, conv) in enumerate(zip(self._norms, self._convs)):
+            x = F.Activation(bn(x), act_type="relu")
+            if i == 0 and self.downsample is not None:
+                shortcut = self.downsample(x)
+            x = conv(x)
+        return x + shortcut
+
+
+class BasicBlockV1(_ResidualV1):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, downsample, in_channels,
+                         bottleneck=False, **kwargs)
+
+
+class BottleneckV1(_ResidualV1):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, downsample, in_channels,
+                         bottleneck=True, **kwargs)
+
+
+class BasicBlockV2(_ResidualV2):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, downsample, in_channels,
+                         bottleneck=False, **kwargs)
+
+
+class BottleneckV2(_ResidualV2):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, downsample, in_channels,
+                         bottleneck=True, **kwargs)
+
+
+def _stage(block, units, channels, stride, index, in_channels):
+    stage = nn.HybridSequential(prefix="stage%d_" % index)
+    with stage.name_scope():
+        stage.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels, prefix=""))
+        for _ in range(units - 1):
+            stage.add(block(channels, 1, False, in_channels=channels,
+                            prefix=""))
+    return stage
+
+
+class _ResNetBase(HybridBlock):
+    version = None
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            if self.version == 2:
+                # v2 normalizes the raw input (frozen affine)
+                self.features.add(nn.BatchNorm(scale=False, center=False))
+            if thumbnail:  # CIFAR-size stem
+                self.features.add(_conv(channels[0], 3))
+            else:
+                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                            use_bias=False))
+                self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+                self.features.add(nn.MaxPool2D(3, 2, 1))
+            width = channels[0]
+            for i, units in enumerate(layers):
+                self.features.add(_stage(block, units, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1, width))
+                width = channels[i + 1]
+            if self.version == 2:
+                self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D())
+            if self.version == 2:
+                self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes, in_units=width)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class ResNetV1(_ResNetBase):
+    version = 1
+
+
+class ResNetV2(_ResNetBase):
+    version = 2
+
+
+_VERSIONS = {1: (ResNetV1, BasicBlockV1, BottleneckV1),
+             2: (ResNetV2, BasicBlockV2, BottleneckV2)}
+
+# kept for API compatibility with the reference module's globals
 resnet_net_versions = [ResNetV1, ResNetV2]
 resnet_block_versions = [
     {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
@@ -260,55 +206,34 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    assert num_layers in resnet_spec, \
-        "invalid resnet depth %d; options: %s" % (num_layers,
-                                                  sorted(resnet_spec))
-    assert version in (1, 2), "invalid resnet version %d" % version
-    block_type, layers, channels = resnet_spec[num_layers]
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    if num_layers not in resnet_spec:
+        raise AssertionError("invalid resnet depth %d; options: %s"
+                             % (num_layers, sorted(resnet_spec)))
+    if version not in _VERSIONS:
+        raise AssertionError("invalid resnet version %d" % version)
+    bottleneck, layers, channels = resnet_spec[num_layers]
+    net_cls, basic, bottle = _VERSIONS[version]
+    net = net_cls(bottle if bottleneck else basic, layers, channels,
+                  **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
         load_pretrained(net, "resnet%d_v%d" % (num_layers, version), ctx)
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _entry(version, depth):
+    def build(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    return build
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _entry(1, 18)
+resnet34_v1 = _entry(1, 34)
+resnet50_v1 = _entry(1, 50)
+resnet101_v1 = _entry(1, 101)
+resnet152_v1 = _entry(1, 152)
+resnet18_v2 = _entry(2, 18)
+resnet34_v2 = _entry(2, 34)
+resnet50_v2 = _entry(2, 50)
+resnet101_v2 = _entry(2, 101)
+resnet152_v2 = _entry(2, 152)

@@ -1,4 +1,9 @@
-"""SqueezeNet 1.0/1.1 (parity: gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1.
+
+Architecture parity with the reference zoo entries (python/mxnet/gluon/
+model_zoo/vision/squeezenet.py); each version is one declarative plan of
+stem / pool / fire rows consumed by a single builder loop.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -6,18 +11,25 @@ from ... import nn
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
+# rows: ("stem", channels, kernel) | ("pool",) | ("fire", squeeze, e1, e3)
+_PLANS = {
+    "1.0": (("stem", 96, 7), ("pool",),
+            ("fire", 16, 64, 64), ("fire", 16, 64, 64),
+            ("fire", 32, 128, 128), ("pool",),
+            ("fire", 32, 128, 128), ("fire", 48, 192, 192),
+            ("fire", 48, 192, 192), ("fire", 64, 256, 256), ("pool",),
+            ("fire", 64, 256, 256)),
+    "1.1": (("stem", 64, 3), ("pool",),
+            ("fire", 16, 64, 64), ("fire", 16, 64, 64), ("pool",),
+            ("fire", 32, 128, 128), ("fire", 32, 128, 128), ("pool",),
+            ("fire", 48, 192, 192), ("fire", 48, 192, 192),
+            ("fire", 64, 256, 256), ("fire", 64, 256, 256)),
+}
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+
+def _relu_conv(channels, kernel, padding=0):
     out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
+    out.add(nn.Conv2D(channels, kernel, padding=padding))
     out.add(nn.Activation("relu"))
     return out
 
@@ -25,58 +37,42 @@ def _make_fire_conv(channels, kernel_size, padding=0):
 class _FireExpand(HybridBlock):
     """Parallel 1x1 + 3x3 expand paths, concatenated on channels."""
 
-    def __init__(self, expand1x1_channels, expand3x3_channels, **kwargs):
+    def __init__(self, e1, e3, **kwargs):
         super().__init__(**kwargs)
-        self.p1 = _make_fire_conv(expand1x1_channels, 1)
-        self.p3 = _make_fire_conv(expand3x3_channels, 3, 1)
+        self.p1 = _relu_conv(e1, 1)
+        self.p3 = _relu_conv(e3, 3, 1)
 
     def hybrid_forward(self, F, x):
         return F.concat(self.p1(x), self.p3(x), dim=1)
 
 
+def _fire(squeeze, e1, e3):
+    out = nn.HybridSequential(prefix="")
+    out.add(_relu_conv(squeeze, 1))
+    out.add(_FireExpand(e1, e3))
+    return out
+
+
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ("1.0", "1.1"), \
-            "unsupported SqueezeNet version %s" % version
+        if version not in _PLANS:
+            raise AssertionError(
+                "unsupported SqueezeNet version %s" % version)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            for row in _PLANS[version]:
+                if row[0] == "stem":
+                    self.features.add(nn.Conv2D(row[1], kernel_size=row[2],
+                                                strides=2))
+                    self.features.add(nn.Activation("relu"))
+                elif row[0] == "pool":
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                                   ceil_mode=True))
+                else:
+                    self.features.add(_fire(*row[1:]))
             self.features.add(nn.Dropout(0.5))
-
+            # classifier is a 1x1 conv + global average (no dense head)
             self.output = nn.HybridSequential(prefix="")
             self.output.add(nn.Conv2D(classes, kernel_size=1))
             self.output.add(nn.Activation("relu"))
@@ -84,22 +80,18 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, ctx=None, **kwargs):
-    net = SqueezeNet("1.0", **kwargs)
-    if pretrained:
-        from ..model_store import load_pretrained
-        load_pretrained(net, "squeezenet1.0", ctx)
-    return net
+def _entry(version):
+    def build(pretrained=False, ctx=None, **kwargs):
+        net = SqueezeNet(version, **kwargs)
+        if pretrained:
+            from ..model_store import load_pretrained
+            load_pretrained(net, "squeezenet" + version, ctx)
+        return net
+    return build
 
 
-def squeezenet1_1(pretrained=False, ctx=None, **kwargs):
-    net = SqueezeNet("1.1", **kwargs)
-    if pretrained:
-        from ..model_store import load_pretrained
-        load_pretrained(net, "squeezenet1.1", ctx)
-    return net
+squeezenet1_0 = _entry("1.0")
+squeezenet1_1 = _entry("1.1")

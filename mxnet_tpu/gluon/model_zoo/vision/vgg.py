@@ -1,6 +1,8 @@
 """VGG 11/13/16/19 (+BN variants).
 
-Parity: python/mxnet/gluon/model_zoo/vision/vgg.py in the reference.
+Architecture parity with the reference zoo entries (python/mxnet/gluon/
+model_zoo/vision/vgg.py); the feature extractor is generated from the
+per-depth stage table below.
 """
 from __future__ import annotations
 
@@ -10,6 +12,12 @@ from ... import nn
 __all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
            "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
 
+# depth -> convs per stage; stage channels are fixed across depths
+vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
 
 class VGG(HybridBlock):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
@@ -17,41 +25,29 @@ class VGG(HybridBlock):
         super().__init__(**kwargs)
         assert len(layers) == len(filters)
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
+            self.features = nn.HybridSequential(prefix="")
+            for repeat, width in zip(layers, filters):
+                self._stage(repeat, width, batch_norm)
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu",
+                                           weight_initializer="normal"))
+                self.features.add(nn.Dropout(rate=0.5))
             self.output = nn.Dense(classes, weight_initializer="normal")
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
+    def _stage(self, repeat, width, batch_norm):
+        for _ in range(repeat):
+            self.features.add(nn.Conv2D(width, kernel_size=3, padding=1))
+            if batch_norm:
+                self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(strides=2))
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, **kwargs)
+    net = VGG(*vgg_spec[num_layers], **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
         bn = "_bn" if kwargs.get("batch_norm") else ""
@@ -59,37 +55,14 @@ def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _entry(depth, batch_norm):
+    def build(**kwargs):
+        if batch_norm:
+            kwargs["batch_norm"] = True
+        return get_vgg(depth, **kwargs)
+    return build
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+vgg11, vgg13, vgg16, vgg19 = (_entry(d, False) for d in (11, 13, 16, 19))
+vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn = (
+    _entry(d, True) for d in (11, 13, 16, 19))

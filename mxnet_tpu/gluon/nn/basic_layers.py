@@ -1,4 +1,12 @@
-"""Basic Gluon layers (ref: python/mxnet/gluon/nn/basic_layers.py:32-526)."""
+"""Basic Gluon layers.
+
+API parity with the reference layer set (python/mxnet/gluon/nn/
+basic_layers.py): Sequential/HybridSequential, Dense, Dropout,
+Embedding, the norm family, Flatten, Lambda wrappers, activations.
+Shared machinery lives in two helpers the reference repeated inline: a
+container mixin for the sequential pair, and one declaration routine
+for the norm layers' gamma/beta (+ running stats) parameter blocks.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -6,124 +14,9 @@ import numpy as np
 from ..block import Block, HybridBlock
 from ..utils import _indent
 
-__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "Flatten", "Lambda",
-           "HybridLambda", "Activation", "LeakyReLU"]
-
-
-class Sequential(Block):
-    """Stack blocks sequentially (ref: basic_layers.py:32)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
-    def forward(self, x):
-        for block in self._children:
-            x = block(x)
-        return x
-
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(["  ({key}): {block}".format(
-            key=key, block=_indent(str(block), 2))
-            for key, block in enumerate(self._children)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
-    def __getitem__(self, key):
-        return self._children[key]
-
-    def __len__(self):
-        return len(self._children)
-
-    def hybridize(self, active=True, **kwargs):
-        if self._children and all(isinstance(c, HybridBlock)
-                                  for c in self._children):
-            import warnings
-            warnings.warn(
-                "All children of this Sequential layer are HybridBlocks. "
-                "Consider using HybridSequential for the best performance.",
-                stacklevel=2)
-        super().hybridize(active, **kwargs)
-
-
-class HybridSequential(HybridBlock):
-    """Stack HybridBlocks sequentially (ref: basic_layers.py:~80)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        for block in self._children:
-            x = block(x)
-        return x
-
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(["  ({key}): {block}".format(
-            key=key, block=_indent(str(block), 2))
-            for key, block in enumerate(self._children)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
-    def __getitem__(self, key):
-        return self._children[key]
-
-    def __len__(self):
-        return len(self._children)
-
-
-class Dense(HybridBlock):
-    """Fully-connected layer (ref: basic_layers.py:~125)."""
-
-    def __init__(self, units, activation=None, use_bias=True, flatten=True,
-                 dtype="float32", weight_initializer=None,
-                 bias_initializer="zeros", in_units=0, prefix=None,
-                 params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._flatten = flatten
-        with self.name_scope():
-            self._units = units
-            self._in_units = in_units
-            self.weight = self.params.get(
-                "weight", shape=(units, in_units), init=weight_initializer,
-                dtype=dtype, allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    "bias", shape=(units,), init=_resolve_init(bias_initializer),
-                    dtype=dtype, allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + "_")
-            else:
-                self.act = None
-
-    def hybrid_forward(self, F, x, weight, bias=None):
-        if bias is None:
-            act = F.FullyConnected(x, weight, no_bias=True,
-                                   num_hidden=self._units,
-                                   flatten=self._flatten, name="fwd")
-        else:
-            act = F.FullyConnected(x, weight, bias, num_hidden=self._units,
-                                   flatten=self._flatten, name="fwd")
-        if self.act is not None:
-            act = self.act(act)
-        return act
-
-    def __repr__(self):
-        s = "{name}({layout}, {act})"
-        shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        act=self.act if self.act else "linear",
-                        layout="{0} -> {1}".format(
-                            shape[1] if shape[1] else None, shape[0]))
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout",
+           "Embedding", "BatchNorm", "InstanceNorm", "LayerNorm",
+           "Flatten", "Lambda", "HybridLambda", "Activation", "LeakyReLU"]
 
 
 def _resolve_init(init):
@@ -132,6 +25,59 @@ def _resolve_init(init):
         return {"zeros": init_mod.Zero(), "ones": init_mod.One()}.get(
             init, init)
     return init
+
+
+class _ChainMixin:
+    """add/index/len/repr shared by the two sequential containers."""
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def __getitem__(self, key):
+        return self._children[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __repr__(self):
+        body = "\n".join("  (%d): %s" % (i, _indent(str(block), 2))
+                         for i, block in enumerate(self._children))
+        return "%s(\n%s\n)" % (type(self).__name__, body)
+
+
+class Sequential(_ChainMixin, Block):
+    """Imperative stack of child blocks."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x):
+        for block in self._children:
+            x = block(x)
+        return x
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._children):
+            import warnings
+            warnings.warn(
+                "All children of this Sequential layer are HybridBlocks. "
+                "Consider using HybridSequential for the best "
+                "performance.", stacklevel=2)
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(_ChainMixin, HybridBlock):
+    """Hybridizable stack of child blocks."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children:
+            x = block(x)
+        return x
 
 
 class Activation(HybridBlock):
@@ -146,8 +92,7 @@ class Activation(HybridBlock):
         return F.Activation(x, act_type=self._act_type, name="fwd")
 
     def __repr__(self):
-        return "{name}({_act_type})".format(
-            name=self.__class__.__name__, _act_type=self._act_type)
+        return "%s(%s)" % (type(self).__name__, self._act_type)
 
 
 class LeakyReLU(HybridBlock):
@@ -156,7 +101,48 @@ class LeakyReLU(HybridBlock):
         self._alpha = alpha
 
     def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha, name="fwd")
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha,
+                           name="fwd")
+
+
+class Dense(HybridBlock):
+    """Fully connected layer, optionally flattening trailing dims."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._in_units = in_units
+        self._flatten = flatten
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units),
+                init=weight_initializer, dtype=dtype,
+                allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(units,),
+                init=_resolve_init(bias_initializer), dtype=dtype,
+                allow_deferred_init=True) if use_bias else None
+            self.act = Activation(activation, prefix=activation + "_") \
+                if activation is not None else None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if bias is None:
+            out = F.FullyConnected(x, weight, no_bias=True,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten, name="fwd")
+        else:
+            out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                                   flatten=self._flatten, name="fwd")
+        return out if self.act is None else self.act(out)
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return "%s(%s -> %s, %s)" % (
+            type(self).__name__, shape[1] if shape[1] else None, shape[0],
+            self.act if self.act else "linear")
 
 
 class Dropout(HybridBlock):
@@ -169,45 +155,48 @@ class Dropout(HybridBlock):
         return F.Dropout(x, p=self._rate, axes=self._axes, name="fwd")
 
     def __repr__(self):
-        return "{name}(p = {_rate})".format(name=self.__class__.__name__,
-                                            _rate=self._rate)
+        return "%s(p = %s)" % (type(self).__name__, self._rate)
+
+
+def _affine_pair(layer, in_channels, scale, center, gamma_init, beta_init):
+    """Declare the gamma/beta parameter pair every norm layer carries;
+    a disabled side becomes a frozen constant (grad_req='null')."""
+    layer.gamma = layer.params.get(
+        "gamma", grad_req="write" if scale else "null",
+        shape=(in_channels,), init=_resolve_init(gamma_init),
+        allow_deferred_init=True, differentiable=scale)
+    layer.beta = layer.params.get(
+        "beta", grad_req="write" if center else "null",
+        shape=(in_channels,), init=_resolve_init(beta_init),
+        allow_deferred_init=True, differentiable=center)
 
 
 class BatchNorm(HybridBlock):
-    """Batch normalization (ref: basic_layers.py:~280)."""
+    """Batch normalization with tracked running statistics."""
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
-                 scale=True, use_global_stats=False, beta_initializer="zeros",
-                 gamma_initializer="ones", running_mean_initializer="zeros",
-                 running_variance_initializer="ones", in_channels=0, **kwargs):
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):
         super().__init__(**kwargs)
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale,
                         "use_global_stats": use_global_stats}
         if in_channels != 0:
             self.in_channels = in_channels
-        self.gamma = self.params.get("gamma",
-                                     grad_req="write" if scale else "null",
-                                     shape=(in_channels,),
-                                     init=_resolve_init(gamma_initializer),
-                                     allow_deferred_init=True,
-                                     differentiable=scale)
-        self.beta = self.params.get("beta",
-                                    grad_req="write" if center else "null",
-                                    shape=(in_channels,),
-                                    init=_resolve_init(beta_initializer),
-                                    allow_deferred_init=True,
-                                    differentiable=center)
-        self.running_mean = self.params.get(
-            "running_mean", grad_req="null", shape=(in_channels,),
-            init=_resolve_init(running_mean_initializer),
-            allow_deferred_init=True, differentiable=False)
-        self.running_var = self.params.get(
-            "running_var", grad_req="null", shape=(in_channels,),
-            init=_resolve_init(running_variance_initializer),
-            allow_deferred_init=True, differentiable=False)
+        _affine_pair(self, in_channels, scale, center, gamma_initializer,
+                     beta_initializer)
+        for name, init in (("running_mean", running_mean_initializer),
+                           ("running_var", running_variance_initializer)):
+            setattr(self, name, self.params.get(
+                name, grad_req="null", shape=(in_channels,),
+                init=_resolve_init(init), allow_deferred_init=True,
+                differentiable=False))
 
     def cast(self, dtype):
+        # fp16 BN statistics lose too much precision; keep f32
         if np.dtype(dtype).name == "float16":
             dtype = "float32"
         super().cast(dtype)
@@ -217,14 +206,10 @@ class BatchNorm(HybridBlock):
                            name="fwd", **self._kwargs)
 
     def __repr__(self):
-        s = "{name}({content}"
-        in_channels = self.gamma.shape[0]
-        s += ", in_channels={0}".format(in_channels if in_channels else None)
-        s += ")"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            ["=".join([k, v.__repr__()])
-                             for k, v in self._kwargs.items()]))
+        channels = self.gamma.shape[0]
+        opts = ", ".join("%s=%r" % kv for kv in self._kwargs.items())
+        return "%s(%s, in_channels=%s)" % (
+            type(self).__name__, opts, channels if channels else None)
 
 
 class InstanceNorm(HybridBlock):
@@ -233,23 +218,15 @@ class InstanceNorm(HybridBlock):
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self._kwargs = {"eps": epsilon}
-        self.gamma = self.params.get("gamma",
-                                     grad_req="write" if scale else "null",
-                                     shape=(in_channels,),
-                                     init=_resolve_init(gamma_initializer),
-                                     allow_deferred_init=True)
-        self.beta = self.params.get("beta",
-                                    grad_req="write" if center else "null",
-                                    shape=(in_channels,),
-                                    init=_resolve_init(beta_initializer),
-                                    allow_deferred_init=True)
+        _affine_pair(self, in_channels, scale, center, gamma_initializer,
+                     beta_initializer)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.InstanceNorm(x, gamma, beta, name="fwd", **self._kwargs)
 
 
 class LayerNorm(HybridBlock):
-    """Layer normalization over the last axis."""
+    """Normalization over one axis (default: last)."""
 
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
@@ -257,16 +234,8 @@ class LayerNorm(HybridBlock):
         super().__init__(**kwargs)
         self._axis = axis
         self._epsilon = epsilon
-        self.gamma = self.params.get("gamma",
-                                     grad_req="write" if scale else "null",
-                                     shape=(in_channels,),
-                                     init=_resolve_init(gamma_initializer),
-                                     allow_deferred_init=True)
-        self.beta = self.params.get("beta",
-                                    grad_req="write" if center else "null",
-                                    shape=(in_channels,),
-                                    init=_resolve_init(beta_initializer),
-                                    allow_deferred_init=True)
+        _affine_pair(self, in_channels, scale, center, gamma_initializer,
+                     beta_initializer)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.LayerNorm(x, gamma, beta, axis=self._axis,
@@ -279,17 +248,17 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
                         "dtype": dtype}
-        self.weight = self.params.get("weight",
-                                      shape=(input_dim, output_dim),
-                                      init=weight_initializer,
-                                      allow_deferred_init=True)
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim),
+            init=weight_initializer, allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, name="fwd", **self._kwargs)
 
     def __repr__(self):
-        s = "{block_name}({input_dim} -> {output_dim}, {dtype})"
-        return s.format(block_name=self.__class__.__name__, **self._kwargs)
+        return "%s(%s -> %s, %s)" % (
+            type(self).__name__, self._kwargs["input_dim"],
+            self._kwargs["output_dim"], self._kwargs["dtype"])
 
 
 class Flatten(HybridBlock):
@@ -300,52 +269,57 @@ class Flatten(HybridBlock):
         return F.Flatten(x)
 
     def __repr__(self):
-        return self.__class__.__name__
+        return type(self).__name__
+
+
+def _named_function(function, *namespaces):
+    """Resolve a str to an op in the given namespaces, or pass a callable
+    through; returns (callable-or-name, display_name)."""
+    if callable(function):
+        return function, getattr(function, "__name__", "custom")
+    if isinstance(function, str):
+        for ns in namespaces:
+            if not hasattr(ns, function):
+                raise AssertionError(
+                    "Function name %s is not found in %s."
+                    % (function, ns.__name__.split(".")[-1]))
+        return function, function
+    raise ValueError("Unrecognized function in lambda: {} of type {}"
+                     .format(function, type(function)))
 
 
 class Lambda(Block):
+    """Wrap an ndarray function (by name) or any callable as a Block."""
+
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        if isinstance(function, str):
-            from ... import ndarray as nd
-            assert hasattr(nd, function), \
-                "Function name %s is not found in ndarray." % function
-            self._func_impl = getattr(nd, function)
-        elif callable(function):
-            self._func_impl = function
-        else:
-            raise ValueError("Unrecognized function in lambda: {} of type {}"
-                             .format(function, type(function)))
-        self._func_name = getattr(self._func_impl, "__name__", "custom")
+        from ... import ndarray as nd
+        fn, self._func_name = _named_function(function, nd)
+        self._func_impl = getattr(nd, fn) if isinstance(fn, str) else fn
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)
 
 
 class HybridLambda(HybridBlock):
+    """Wrap an F-generic function (by name, resolved per-backend) or a
+    callable taking (F, x, ...) as a HybridBlock."""
+
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        if isinstance(function, str):
-            from ... import ndarray as nd
-            from ... import symbol as sym
-            assert hasattr(nd, function) and hasattr(sym, function), \
-                "Function name %s is not found in symbol/ndarray." % function
-            self._func = lambda F, *args: getattr(F, function)(*args)
-            self._func_name = function
-        elif callable(function):
-            self._func = function
-            self._func_name = getattr(function, "__name__", "custom")
+        from ... import ndarray as nd
+        from ... import symbol as sym
+        fn, self._func_name = _named_function(function, nd, sym)
+        if isinstance(fn, str):
+            self._func = lambda F, *args: getattr(F, fn)(*args)
         else:
-            raise ValueError("Unrecognized function in lambda: {} of type {}"
-                             .format(function, type(function)))
+            self._func = fn
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)

@@ -1,89 +1,87 @@
-"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+"""Gluon utilities.
+
+API parity with the reference helpers (python/mxnet/gluon/utils.py):
+batch splitting across contexts, global-norm clipping, repr indentation,
+checksum verification.  download() is a stub by policy — this
+environment has no network egress.
+"""
 from __future__ import annotations
 
+import hashlib
 import math
-
-import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, array
 
 
+def _slice_bounds(size, num_slice):
+    """[(start, stop)] per slice; the LAST slice absorbs the remainder."""
+    step = size // num_slice
+    bounds = [(i * step, (i + 1) * step) for i in range(num_slice)]
+    return bounds[:-1] + [((num_slice - 1) * step, size)]
+
+
 def split_data(data, num_slice, batch_axis=0, even_split=True):
-    """Split an NDArray into num_slice slices along batch_axis
-    (ref: utils.py:split_data)."""
+    """Split an NDArray into num_slice chunks along batch_axis."""
     size = data.shape[batch_axis]
     if size < num_slice:
         raise ValueError(
             "Too many slices for data with shape %s. Arguments are "
-            "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice,
-                                                 batch_axis))
-    if even_split and size % num_slice != 0:
+            "num_slice=%d and batch_axis=%d."
+            % (data.shape, num_slice, batch_axis))
+    if even_split and size % num_slice:
         raise ValueError(
-            "data with shape %s cannot be evenly split into %d slices along "
-            "axis %d. Use a batch size that's multiple of %d or set "
-            "even_split=False to allow uneven partitioning of data." % (
-                str(data.shape), num_slice, batch_axis, num_slice))
-    step = size // num_slice
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (data.shape, num_slice, batch_axis, num_slice))
     if batch_axis == 0:
-        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
-                  else data[i * step:size]
-                  for i in range(num_slice)]
-    else:
-        from .. import ndarray as nd
-        slices = [nd.slice_axis(data, batch_axis, i * step,
-                                (i + 1) * step if i < num_slice - 1 else size)
-                  for i in range(num_slice)]
-    return slices
+        return [data[lo:hi] for lo, hi in _slice_bounds(size, num_slice)]
+    from .. import ndarray as nd
+    return [nd.slice_axis(data, batch_axis, lo, hi)
+            for lo, hi in _slice_bounds(size, num_slice)]
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Split data and load each slice to one context (ref: utils.py)."""
+    """split_data, then place one slice per context."""
     if not isinstance(data, NDArray):
         data = array(data, ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+    return [piece.as_in_context(ctx)
+            for piece, ctx in zip(
+                split_data(data, len(ctx_list), batch_axis, even_split),
+                ctx_list)]
 
 
 def clip_global_norm(arrays, max_norm):
-    """Rescale arrays so that the sum of their 2-norm is smaller than max_norm."""
+    """Rescale arrays in place so their joint 2-norm is <= max_norm;
+    returns the pre-clip norm."""
     assert len(arrays) > 0
-    total_norm = 0
-    for arr in arrays:
-        if arr is None:
-            continue
-        norm = float(arr.norm().asscalar())
-        total_norm += norm * norm
-    total_norm = math.sqrt(total_norm)
-    scale = max_norm / (total_norm + 1e-8)
-    if scale < 1.0:
-        for arr in arrays:
-            if arr is not None:
-                arr *= scale
-    return total_norm
+    live = [a for a in arrays if a is not None]
+    total = math.sqrt(sum(float(a.norm().asscalar()) ** 2 for a in live))
+    ratio = max_norm / (total + 1e-8)
+    if ratio < 1.0:
+        for a in live:
+            a *= ratio
+    return total
 
 
-def _indent(s_, numSpaces):
-    s = s_.split("\n")
-    if len(s) == 1:
-        return s_
-    first = s.pop(0)
-    s = [first] + [(numSpaces * " ") + line for line in s]
-    return "\n".join(s)
+def _indent(text, spaces):
+    """Indent every line but the first (block repr nesting)."""
+    head, sep, rest = text.partition("\n")
+    if not sep:
+        return text
+    pad = " " * spaces
+    return head + "\n" + "\n".join(pad + line for line in rest.split("\n"))
 
 
 def check_sha1(filename, sha1_hash):
-    import hashlib
-    sha1 = hashlib.sha1()
+    digest = hashlib.sha1()
     with open(filename, "rb") as f:
-        while True:
-            data = f.read(1048576)
-            if not data:
-                break
-            sha1.update(data)
-    return sha1.hexdigest() == sha1_hash
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest() == sha1_hash
 
 
 def download(url, path=None, overwrite=False, sha1_hash=None):

@@ -269,11 +269,18 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+def _is_h5_dataset(obj):
+    """h5py.Dataset without importing h5py eagerly (it is optional —
+    reference io.py:541 accepts h5py input when the library exists)."""
+    mod = type(obj).__module__
+    return mod.startswith("h5py") and type(obj).__name__ == "Dataset"
+
+
 def _init_data(data, allow_empty, default_name):
     assert (data is not None) or allow_empty
     if data is None:
         data = []
-    if isinstance(data, (np.ndarray, NDArray)):
+    if isinstance(data, (np.ndarray, NDArray)) or _is_h5_dataset(data):
         data = [data]
     if isinstance(data, list):
         if not allow_empty:
@@ -287,7 +294,9 @@ def _init_data(data, allow_empty, default_name):
                         "or dict with them as values")
     out = {}
     for k, v in data.items():
-        if not isinstance(v, NDArray):
+        if _is_h5_dataset(v):
+            pass  # stays lazy: batches slice the dataset out-of-core
+        elif not isinstance(v, NDArray):
             try:
                 v = array(v)
             except Exception:
@@ -307,6 +316,11 @@ class NDArrayIter(DataIter):
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
         self.idx = np.arange(self.data[0][1].shape[0])
         if shuffle:
+            if any(_is_h5_dataset(v) for _, v in self.data + self.label):
+                raise MXNetError(
+                    "shuffle=True cannot reorder an out-of-core h5py "
+                    "dataset; pre-shuffle the file or load it into "
+                    "memory (np.asarray(dset)) first")
             np.random.shuffle(self.idx)
             self.data = [(k, array(v.asnumpy()[self.idx], v.context))
                          for k, v in self.data]
@@ -356,15 +370,24 @@ class NDArrayIter(DataIter):
                              pad=self.getpad(), index=None)
         raise StopIteration
 
+    @staticmethod
+    def _rows(source, lo, hi):
+        """Slice [lo:hi) rows; h5py datasets read just that window."""
+        chunk = source[lo:hi]
+        return chunk if isinstance(chunk, NDArray) \
+            else array(np.asarray(chunk))
+
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
         if self.cursor + self.batch_size <= self.num_data:
-            return [x[1][self.cursor:self.cursor + self.batch_size]
+            return [self._rows(x[1], self.cursor,
+                               self.cursor + self.batch_size)
                     for x in data_source]
         pad = self.batch_size - self.num_data + self.cursor
         return [
-            array(np.concatenate((x[1][self.cursor:].asnumpy(),
-                                  x[1][:pad].asnumpy()), axis=0))
+            array(np.concatenate(
+                (self._rows(x[1], self.cursor, self.num_data).asnumpy(),
+                 self._rows(x[1], 0, pad).asnumpy()), axis=0))
             for x in data_source]
 
     def getdata(self):

@@ -22,13 +22,23 @@ _lib = None
 _tried = False
 
 
+def _run_gxx(cmd, out_path):
+    """Compile to a private temp file, then atomically rename into place:
+    several test workers (pytest-xdist) may rebuild the same .so
+    concurrently, and a half-written library must never be dlopen-able."""
+    tmp = "%s.build.%d" % (out_path, os.getpid())
+    subprocess.run([c if c != out_path else tmp for c in cmd],
+                   check=True, capture_output=True)
+    os.replace(tmp, out_path)
+
+
 def _build():
     srcs = [os.path.join(_SRC_DIR, f) for f in ("recordio.cc", "engine.cc")]
     if not all(os.path.exists(s) for s in srcs):
         return None
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            "-o", _LIB_PATH] + srcs
-    subprocess.run(cmd, check=True, capture_output=True)
+    _run_gxx(cmd, _LIB_PATH)
     return _LIB_PATH
 
 
@@ -299,7 +309,7 @@ def _load_embed_lib(src_name, lib_path, declare):
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                "-I" + sysconfig.get_paths()["include"], "-I" + inc,
                "-o", lib_path, src]
-        subprocess.run(cmd, check=True, capture_output=True)
+        _run_gxx(cmd, lib_path)
     lib = ctypes.PyDLL(lib_path)
     declare(lib)
     return lib
@@ -440,7 +450,7 @@ def get_imgdec_lib():
                        "-I/usr/include/opencv4", "-o", _IMGDEC_PATH, src,
                        "-lopencv_core", "-lopencv_imgcodecs",
                        "-lopencv_imgproc"]
-                subprocess.run(cmd, check=True, capture_output=True)
+                _run_gxx(cmd, _IMGDEC_PATH)
             lib = ctypes.CDLL(_IMGDEC_PATH)
             u8pp = ctypes.POINTER(ctypes.c_void_p)
             f32p = ctypes.POINTER(ctypes.c_float)

@@ -257,7 +257,18 @@ class DataParallelExecutorGroup:
 
     def get_output_shapes(self):
         outputs = self.execs[0].outputs
-        shapes = [out.shape for out in outputs]
+        if outputs:
+            shapes = [out.shape for out in outputs]
+        else:
+            # before the first forward (SequentialModule binds stage i+1
+            # off stage i's output shapes): infer from the bound inputs
+            known = {d[0]: tuple(d[1] if not hasattr(d, "shape")
+                                 else d.shape) for d in self.data_shapes}
+            if self.label_shapes:
+                known.update((l[0], tuple(l[1] if not hasattr(l, "shape")
+                                          else l.shape))
+                             for l in self.label_shapes)
+            _, shapes, _ = self.symbol.infer_shape(**known)
         concat_shapes = []
         for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
                                         self.output_layouts):

@@ -628,7 +628,8 @@ int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
   } else {
     heads = PyList_New(len);
     for (mx_uint i = 0; i < len; ++i) {
-      PyObject *h = (PyObject *)head_grads[i];
+      // a NULL entry means "ones for this head" (reference semantics)
+      PyObject *h = head_grads[i] ? (PyObject *)head_grads[i] : Py_None;
       Py_INCREF(h);
       PyList_SET_ITEM(heads, i, h);
     }
@@ -734,7 +735,8 @@ int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
   } else {
     heads = PyList_New(num_output);
     for (mx_uint i = 0; i < num_output; ++i) {
-      PyObject *h = (PyObject *)ograd_handles[i];
+      PyObject *h = ograd_handles[i] ? (PyObject *)ograd_handles[i]
+                                     : Py_None;
       Py_INCREF(h);
       PyList_SET_ITEM(heads, i, h);
     }

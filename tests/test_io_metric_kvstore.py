@@ -411,3 +411,23 @@ def test_local_kvstore_compression_raises():
     kv = mx.kv.create("local")
     with pytest.raises(mx.base.MXNetError):
         kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_ndarray_iter_h5py(tmp_path):
+    """NDArrayIter accepts h5py datasets (reference io.py:541)."""
+    h5py = pytest.importorskip("h5py")
+    path = str(tmp_path / "data.h5")
+    rng = np.random.RandomState(0)
+    X = rng.randn(20, 3).astype("f")
+    Y = rng.randint(0, 2, (20,)).astype("f")
+    with h5py.File(path, "w") as f:
+        f.create_dataset("x", data=X)
+        f.create_dataset("y", data=Y)
+    with h5py.File(path, "r") as f:
+        it = mx.io.NDArrayIter(f["x"], f["y"], batch_size=5)
+        seen = 0
+        for batch in it:
+            got = batch.data[0].asnumpy()
+            np.testing.assert_allclose(got, X[seen:seen + 5], atol=1e-6)
+            seen += 5
+    assert seen == 20

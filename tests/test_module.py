@@ -189,3 +189,75 @@ def test_kvstore_optimizer_states_roundtrip(tmp_path):
     # and the restored state is non-trivial (momentum exists after a step)
     import pickle
     assert pickle.loads(states_before)
+
+
+def test_sequential_module_chain():
+    """SequentialModule threads outputs into the next stage's data and
+    routes labels to take_labels stages."""
+    import numpy as np
+    net1 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=16,
+                                 name="fc1")
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                 name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=None)) \
+       .add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 4).astype("f")
+    X = rng.randn(128, 8).astype("f")
+    Y = (X @ W).argmax(1).astype("f")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    seq.fit(it, num_epoch=6, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    acc = dict(seq.score(it, "acc"))["accuracy"]
+    assert acc > 0.8, acc
+    args, _ = seq.get_params()
+    assert "fc1_weight" in args and "fc2_weight" in args
+
+
+def test_sequential_module_duplicate_names_raise():
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=None)) \
+       .add(mx.mod.Module(net, label_names=None), auto_wiring=True)
+    seq.bind(data_shapes=[("data", (2, 8))])
+    with pytest.raises(AssertionError):
+        seq.init_params(mx.initializer.Xavier())
+
+
+def test_python_loss_module():
+    """PythonLossModule supplies a custom gradient as the chain tail."""
+    import numpy as np
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2,
+                                name="fc")
+    head = mx.mod.PythonLossModule(
+        grad_func=lambda scores, labels:
+            scores.asnumpy() - labels.asnumpy())
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=None)) \
+       .add(head, take_labels=True, auto_wiring=True)
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 3).astype("f")
+    T = X @ rng.randn(3, 2).astype("f")
+    it = mx.io.NDArrayIter(X, T, batch_size=16,
+                           label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(optimizer_params={"learning_rate": 0.05})
+    losses = []
+    for _ in range(8):
+        it.reset()
+        total = 0.0
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            out = seq.get_outputs()[0].asnumpy()
+            total += float(((out - batch.label[0].asnumpy()) ** 2).mean())
+            seq.backward()
+            seq.update()
+        losses.append(total)
+    assert losses[-1] < losses[0] * 0.5, losses

@@ -305,6 +305,11 @@ def _build_embed_binary(tmp_path, src_rel, libname, lib_path, out_name):
     env["PYTHONPATH"] = os.pathsep.join(
         [repo] + site.getsitepackages() + [site.getusersitepackages()]
         + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    # the spawned binary must not contend with the parent pytest process
+    # for a single tunneled accelerator — two clients on one chip produce
+    # silently-wrong results (observed: LeNet stuck at chance accuracy
+    # only when the full suite holds the axon device)
+    env["JAX_PLATFORMS"] = "cpu"
     return exe, env
 
 

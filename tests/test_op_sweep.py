@@ -77,15 +77,15 @@ def case(name, *args, **kw):
 # which test file covers ops the generic harness cannot (stateful layers,
 # multi-phase protocols, iterator-coupled ops, ...)
 TESTED_ELSEWHERE = {
-    "round": "tests/test_operator.py::test_round_half_away_from_zero",
-    "reshape_like": "tests/test_operator.py::test_reshape_like",
+    "round": "tests/test_operator.py (test_round_half_away_from_zero)",
+    "reshape_like": "tests/test_operator.py (test_reshape_like)",
     "softmax_cross_entropy":
-        "tests/test_operator.py::test_softmax_cross_entropy",
-    "linalg_gelqf": "tests/test_operator.py::test_linalg_gelqf_syevd",
-    "linalg_syevd": "tests/test_operator.py::test_linalg_gelqf_syevd",
-    "khatri_rao": "tests/test_operator.py::test_khatri_rao",
+        "tests/test_operator.py (test_softmax_cross_entropy)",
+    "linalg_gelqf": "tests/test_operator.py (test_linalg_gelqf_syevd)",
+    "linalg_syevd": "tests/test_operator.py (test_linalg_gelqf_syevd)",
+    "khatri_rao": "tests/test_operator.py (test_khatri_rao)",
     "_contrib_bipartite_matching":
-        "tests/test_operator.py::test_bipartite_matching",
+        "tests/test_operator.py (test_bipartite_matching)",
     "RNN": "tests/test_rnn.py",
     "Custom": "tests/test_contrib_custom.py",
     "BatchNorm": "tests/test_module.py (train/eval aux semantics)",
