@@ -159,6 +159,49 @@ int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
 int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
 int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out);
 
+/* -- KVStore group (parity: c_api.cc MXKVStore*) -------------------------
+ * A KVStore aggregates gradients / synchronizes parameters.  Int and
+ * string key forms mirror the reference's paired entry points. */
+typedef void *KVStoreHandle;
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+/* *out is thread-local, valid until the next string-returning call */
+int MXKVStoreGetType(KVStoreHandle kv, const char **out);
+int MXKVStoreGetRank(KVStoreHandle kv, int *out);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
+                                    const char **keys, const char **vals);
+int MXKVStoreBarrier(KVStoreHandle kv);
+
+/* -- DataIter group (parity: c_api.cc MXDataIter*) -----------------------
+ * Iterators create by NAME with string attrs (values parse as python
+ * literals: '32', '(3,224,224)', 'True').  GetData/GetLabel return
+ * fresh handles the caller frees. */
+typedef void *DataIterHandle;
+int MXListDataIters(mx_uint *out_size, const char ***out_array);
+int MXDataIterCreateByName(const char *name, mx_uint num_params,
+                           const char **keys, const char **vals,
+                           DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+/* *out = 1 while batches remain, 0 at epoch end */
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *out);
+
 #ifdef __cplusplus
 }
 #endif

@@ -328,3 +328,117 @@ def symbol_get_internals(sym):
 
 def symbol_get_output(sym, index):
     return sym[int(index)]
+
+
+# -- KVStore group (ref: c_api.cc MXKVStore*) --------------------------------
+
+def kvstore_create(type_name):
+    from . import kvstore
+    return kvstore.create(type_name)
+
+
+def kvstore_type(kv):
+    return kv.type
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_num_workers(kv):
+    return int(kv.num_workers)
+
+
+def _kv_keys(keys):
+    return [k if isinstance(k, str) else int(k) for k in keys]
+
+
+def kvstore_init(kv, keys, values):
+    kv.init(_kv_keys(keys), list(values))
+    return None
+
+
+def kvstore_push(kv, keys, values, priority):
+    kv.push(_kv_keys(keys), list(values), priority=int(priority))
+    return None
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(_kv_keys(keys), out=list(outs), priority=int(priority))
+    return None
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(dict(zip(keys, vals)))
+    return None
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+    return None
+
+
+# -- DataIter group (ref: c_api.cc MXDataIter*) ------------------------------
+
+_DATA_ITER_NAMES = ("NDArrayIter", "MNISTIter", "CSVIter", "LibSVMIter",
+                    "ImageRecordIter", "ImageDetIter")
+
+
+def list_data_iters():
+    return list(_DATA_ITER_NAMES)
+
+
+def data_iter_create(name, keys, vals):
+    """Create an iterator by name from string attrs (the C convention).
+
+    Values parse as python literals where possible ('(3,224,224)' ->
+    tuple, '32' -> int) and stay strings otherwise."""
+    import ast
+    from . import io as io_mod
+    from . import image as image_mod
+    if name not in _DATA_ITER_NAMES:
+        raise ValueError("unknown data iter %r; available: %s"
+                         % (name, _DATA_ITER_NAMES))
+    cls = getattr(io_mod, name, None) or getattr(image_mod, name)
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    return cls(**kwargs)
+
+
+def data_iter_next(it):
+    """Advance; returns the new batch or None at epoch end."""
+    try:
+        it._capi_batch = it.next()
+        return True
+    except StopIteration:
+        it._capi_batch = None
+        return False
+
+
+def data_iter_before_first(it):
+    it.reset()
+    it._capi_batch = None
+    return None
+
+
+def _capi_batch(it):
+    batch = getattr(it, "_capi_batch", None)
+    if batch is None:
+        raise ValueError("no current batch; call MXDataIterNext first")
+    return batch
+
+
+def data_iter_get_data(it):
+    return _capi_batch(it).data[0]
+
+
+def data_iter_get_label(it):
+    return _capi_batch(it).label[0]
+
+
+def data_iter_get_pad(it):
+    return int(_capi_batch(it).pad or 0)

@@ -902,5 +902,274 @@ int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle *out) {
   return 0;
 }
 
+
+// -- KVStore group (ref: src/c_api/c_api.cc MXKVStore*) ---------------------
+
+typedef void *KVStoreHandle;
+
+static int kv_simple(const char *fn, KVStoreHandle kv) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  PyObject *res = support_call(fn, Py_BuildValue("(O)", (PyObject *)kv));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static PyObject *int_keys(const int *keys, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(keys[i]));
+  return l;
+}
+
+static PyObject *handle_list(NDArrayHandle *vals, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *h = (PyObject *)vals[i];
+    Py_INCREF(h);
+    PyList_SET_ITEM(l, i, h);
+  }
+  return l;
+}
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  CHECK_NULL(type, "type");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("kvstore_create",
+                               Py_BuildValue("(s)", type));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle kv, const char **out) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("kvstore_type",
+                               Py_BuildValue("(O)", (PyObject *)kv));
+  if (!res) return -1;
+  const char *s = PyUnicode_AsUTF8(res);
+  tl_json = s ? s : "";
+  if (!s) PyErr_Clear();
+  Py_DECREF(res);
+  *out = tl_json.c_str();
+  return 0;
+}
+
+static int kv_scalar(const char *fn, KVStoreHandle kv, int *out) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(fn, Py_BuildValue("(O)", (PyObject *)kv));
+  if (!res) return -1;
+  *out = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int *out) {
+  return kv_scalar("kvstore_rank", kv, out);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out) {
+  return kv_scalar("kvstore_num_workers", kv, out);
+}
+
+static int kv_kv_op(const char *fn, KVStoreHandle kv, PyObject *keys,
+                    NDArrayHandle *vals, mx_uint n, int priority) {
+  PyObject *res = support_call(
+      fn, Py_BuildValue("(ONNi)", (PyObject *)kv, keys,
+                        handle_list(vals, n), priority));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
+  GIL gil;
+  PyObject *res = support_call(
+      "kvstore_init", Py_BuildValue("(ONN)", (PyObject *)kv,
+                                    int_keys(keys, num),
+                                    handle_list(vals, num)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
+  GIL gil;
+  PyObject *res = support_call(
+      "kvstore_init", Py_BuildValue("(ONN)", (PyObject *)kv,
+                                    str_list(keys, (int)num),
+                                    handle_list(vals, num)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  return kv_kv_op("kvstore_push", kv, int_keys(keys, num), vals, num,
+                  priority);
+}
+
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  return kv_kv_op("kvstore_push", kv, str_list(keys, (int)num), vals, num,
+                  priority);
+}
+
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  return kv_kv_op("kvstore_pull", kv, int_keys(keys, num), vals, num,
+                  priority);
+}
+
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  return kv_kv_op("kvstore_pull", kv, str_list(keys, (int)num), vals, num,
+                  priority);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
+                                    const char **keys, const char **vals) {
+  CHECK_NULL(kv, "KVStoreHandle");
+  GIL gil;
+  PyObject *res = support_call(
+      "kvstore_set_gradient_compression",
+      Py_BuildValue("(ONN)", (PyObject *)kv,
+                    str_list(keys, (int)num_params),
+                    str_list(vals, (int)num_params)));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  return kv_simple("kvstore_barrier", kv);
+}
+
+// -- DataIter group (ref: src/c_api/c_api.cc MXDataIter*) -------------------
+
+typedef void *DataIterHandle;
+
+int MXListDataIters(mx_uint *out_size, const char ***out_array) {
+  CHECK_NULL(out_size, "output pointer");
+  CHECK_NULL(out_array, "output pointer");
+  GIL gil;
+  PyObject *res = support_call("list_data_iters", PyTuple_New(0));
+  if (!res) return -1;
+  stash_str_list(res, tl_list_strings, tl_list_cstrs, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterCreateByName(const char *name, mx_uint num_params,
+                           const char **keys, const char **vals,
+                           DataIterHandle *out) {
+  CHECK_NULL(name, "iterator name");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "data_iter_create",
+      Py_BuildValue("(sNN)", name, str_list(keys, (int)num_params),
+                    str_list(vals, (int)num_params)));
+  if (!res) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  CHECK_NULL(handle, "DataIterHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "data_iter_next", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out = PyObject_IsTrue(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  CHECK_NULL(handle, "DataIterHandle");
+  GIL gil;
+  PyObject *res = support_call(
+      "data_iter_before_first", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static int iter_fetch(const char *fn, DataIterHandle handle,
+                      NDArrayHandle *out) {
+  CHECK_NULL(handle, "DataIterHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(fn,
+                               Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out = res;  // caller frees via MXNDArrayFree
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return iter_fetch("data_iter_get_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return iter_fetch("data_iter_get_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *out) {
+  CHECK_NULL(handle, "DataIterHandle");
+  CHECK_NULL(out, "output pointer");
+  GIL gil;
+  PyObject *res = support_call(
+      "data_iter_get_pad", Py_BuildValue("(O)", (PyObject *)handle));
+  if (!res) return -1;
+  *out = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
 }  // extern "C"
+
 

@@ -525,3 +525,108 @@ int main() {
                          env=env, timeout=300)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "CPP_TRAIN_OK" in res.stdout
+
+
+def test_kvstore_group():
+    """C KVStore surface: create/init/push/pull with both key forms
+    (parity: reference MXKVStore* family)."""
+    def nd(arr):
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_uint * arr.ndim)(*arr.shape)
+        assert lib.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, 0,
+                                     ctypes.byref(h)) == 0
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes) == 0
+        return h
+
+    def to_np(h, shape):
+        out = np.zeros(shape, np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes) == 0
+        return out
+
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    t = ctypes.c_char_p()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    rank, size = ctypes.c_int(-1), ctypes.c_int(-1)
+    assert lib.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value == 1
+
+    w = nd(np.zeros(3, np.float32))
+    keys = (ctypes.c_int * 1)(7)
+    assert lib.MXKVStoreInit(kv, 1, keys, (ctypes.c_void_p * 1)(w)) == 0
+    g = nd(np.array([1.0, 2.0, 3.0], np.float32))
+    assert lib.MXKVStorePush(kv, 1, keys, (ctypes.c_void_p * 1)(g), 0) == 0
+    out = nd(np.zeros(3, np.float32))
+    assert lib.MXKVStorePull(kv, 1, keys, (ctypes.c_void_p * 1)(out),
+                             0) == 0
+    np.testing.assert_allclose(to_np(out, (3,)), [1, 2, 3])
+
+    # string keys
+    skeys = (ctypes.c_char_p * 1)(b"emb")
+    w2 = nd(np.ones((2, 2), np.float32))
+    assert lib.MXKVStoreInitEx(kv, 1, skeys,
+                               (ctypes.c_void_p * 1)(w2)) == 0
+    g2 = nd(np.full((2, 2), 5.0, np.float32))
+    assert lib.MXKVStorePushEx(kv, 1, skeys, (ctypes.c_void_p * 1)(g2),
+                               0) == 0
+    out2 = nd(np.zeros((2, 2), np.float32))
+    assert lib.MXKVStorePullEx(kv, 1, skeys, (ctypes.c_void_p * 1)(out2),
+                               0) == 0
+    np.testing.assert_allclose(to_np(out2, (2, 2)), 5.0)
+
+    # compression on a local store must REFUSE (reference parity)
+    ck = (ctypes.c_char_p * 2)(b"type", b"threshold")
+    cv = (ctypes.c_char_p * 2)(b"2bit", b"0.5")
+    assert lib.MXKVStoreSetGradientCompression(kv, 2, ck, cv) == -1
+    assert lib.MXKVStoreBarrier(kv) == 0
+    for h in (w, g, out, w2, g2, out2):
+        lib.MXNDArrayFree(h)
+    lib.MXKVStoreFree(kv)
+
+
+def test_data_iter_group(tmp_path):
+    """C DataIter surface: list, create-by-name with string attrs,
+    iterate an epoch (parity: reference MXDataIter* family)."""
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = [arr[i].decode() for i in range(n.value)]
+    assert "NDArrayIter" in names and "ImageRecordIter" in names
+
+    # CSVIter through the C surface
+    import numpy as np
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    csv = tmp_path / "x.csv"
+    np.savetxt(csv, data, delimiter=",", fmt="%.1f")
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(3,)", b"4")
+    it = ctypes.c_void_p()
+    assert lib.MXDataIterCreateByName(b"CSVIter", 3, keys, vals,
+                                      ctypes.byref(it)) == 0, \
+        lib.MXGetLastError()
+    seen = 0
+    has = ctypes.c_int(0)
+    while True:
+        assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        d = ctypes.c_void_p()
+        assert lib.MXDataIterGetData(it, ctypes.byref(d)) == 0
+        out = np.zeros((4, 3), np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            d, out.ctypes.data_as(ctypes.c_void_p), out.nbytes) == 0
+        np.testing.assert_allclose(out, data[seen:seen + 4])
+        pad = ctypes.c_int(-1)
+        assert lib.MXDataIterGetPadNum(it, ctypes.byref(pad)) == 0
+        assert pad.value == 0
+        lib.MXNDArrayFree(d)
+        seen += 4
+    assert seen == 8
+    # rewind and take one more batch
+    assert lib.MXDataIterBeforeFirst(it) == 0
+    assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value
+    lib.MXDataIterFree(it)
