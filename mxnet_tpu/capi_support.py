@@ -202,13 +202,13 @@ def _fill_head_grads(head_grads, outputs):
     """None entries mean 'ones for this head' (reference C semantics)."""
     if not head_grads:
         return None
-    from .ndarray import NDArray, ones as nd_ones
+    from .ndarray import ones_like
     filled = []
     for grad, out in zip(head_grads, list(outputs) + [None] * len(head_grads)):
         if grad is not None:
             filled.append(grad)
         elif out is not None:
-            filled.append(nd_ones(out.shape, dtype=out.dtype))
+            filled.append(ones_like(out))  # keeps device + dtype
         else:
             raise ValueError("NULL head grad without a matching output")
     return filled

@@ -1032,6 +1032,10 @@ int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
 int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority) {
   CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
   GIL gil;
   return kv_kv_op("kvstore_push", kv, int_keys(keys, num), vals, num,
                   priority);
@@ -1040,6 +1044,10 @@ int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
 int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
                     NDArrayHandle *vals, int priority) {
   CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
   GIL gil;
   return kv_kv_op("kvstore_push", kv, str_list(keys, (int)num), vals, num,
                   priority);
@@ -1048,6 +1056,10 @@ int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
 int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority) {
   CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
   GIL gil;
   return kv_kv_op("kvstore_pull", kv, int_keys(keys, num), vals, num,
                   priority);
@@ -1056,6 +1068,10 @@ int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
 int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
                     NDArrayHandle *vals, int priority) {
   CHECK_NULL(kv, "KVStoreHandle");
+  if (num > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
   GIL gil;
   return kv_kv_op("kvstore_pull", kv, str_list(keys, (int)num), vals, num,
                   priority);
@@ -1064,6 +1080,10 @@ int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
 int MXKVStoreSetGradientCompression(KVStoreHandle kv, mx_uint num_params,
                                     const char **keys, const char **vals) {
   CHECK_NULL(kv, "KVStoreHandle");
+  if (num_params > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(vals, "values");
+  }
   GIL gil;
   PyObject *res = support_call(
       "kvstore_set_gradient_compression",

@@ -30,6 +30,20 @@ _FAST_MODULES = {
 }
 
 
+def pytest_addoption(parser):
+    # pytest.ini sets `addopts = -n 4` (12-min full suite).  When
+    # pytest-xdist is not installed, register -n ourselves as a no-op so
+    # a plain pytest can still run (serial) instead of dying on an
+    # unrecognized argument.
+    try:
+        import xdist  # noqa: F401
+    except ImportError:
+        parser.addoption("-n", "--numprocesses", action="store",
+                         default=None,
+                         help="ignored: pytest-xdist is not installed; "
+                              "tests run serially")
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: quick iteration tier (run with -m fast)")

@@ -261,3 +261,21 @@ def test_python_loss_module():
             seq.update()
         losses.append(total)
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_sequential_module_input_grads():
+    """bind(inputs_need_grad=True) must flow through to get_input_grads
+    (review regression: the flags were dropped in bind)."""
+    import numpy as np
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                name="fcg")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=None))
+    seq.bind(data_shapes=[("data", (2, 3))], inputs_need_grad=True)
+    assert seq.inputs_need_grad and seq.for_training
+    seq.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.array(np.ones((2, 3), "f"))])
+    seq.forward(batch, is_train=True)
+    seq.backward([mx.nd.array(np.ones((2, 4), "f"))])
+    g = seq.get_input_grads()[0].asnumpy()
+    assert g.shape == (2, 3) and np.abs(g).sum() > 0
