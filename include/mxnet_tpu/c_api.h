@@ -202,6 +202,21 @@ int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetPadNum(DataIterHandle handle, int *out);
 
+/* -- Shape inference (parity: c_api_symbolic.cc MXSymbolInferShape) ------
+ * Known shapes arrive CSR-style: keys[i]'s dims are
+ * arg_shape_data[arg_ind_ptr[i]..arg_ind_ptr[i+1]).  On *complete==1 the
+ * out-params hold arg/output/aux shape arrays (thread-local, valid until
+ * the next inference call). */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
 #ifdef __cplusplus
 }
 #endif

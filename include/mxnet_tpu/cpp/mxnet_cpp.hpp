@@ -240,10 +240,51 @@ class Symbol {
     return FromHandle(out);
   }
 
+  // infer every argument/output/aux shape from the known input shapes;
+  // returns false when the graph is under-determined
+  bool InferShape(const std::map<std::string, std::vector<mx_uint>> &known,
+                  std::vector<std::vector<mx_uint>> *arg_shapes,
+                  std::vector<std::vector<mx_uint>> *out_shapes,
+                  std::vector<std::vector<mx_uint>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind_ptr{0}, data;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      ind_ptr.push_back((mx_uint)data.size());
+    }
+    mx_uint sizes[3];
+    mx_uint *ndims[3];
+    const mx_uint **datas[3];
+    int complete = 0;
+    Check(MXSymbolInferShape(
+        handle(), (mx_uint)keys.size(), keys.data(), ind_ptr.data(),
+        data.data(), &sizes[0], &ndims[0], &datas[0], &sizes[1],
+        &ndims[1], &datas[1], &sizes[2], &ndims[2], &datas[2],
+        &complete));
+    if (!complete) return false;
+    std::vector<std::vector<mx_uint>> *outs[3] = {arg_shapes, out_shapes,
+                                                  aux_shapes};
+    for (int g = 0; g < 3; ++g) {
+      if (!outs[g]) continue;
+      outs[g]->clear();
+      for (mx_uint i = 0; i < sizes[g]; ++i)
+        outs[g]->emplace_back(datas[g][i], datas[g][i] + ndims[g][i]);
+    }
+    return true;
+  }
+
   Executor Bind(Context ctx, const std::vector<NDArray> &args,
                 const std::vector<NDArray> &arg_grads,
                 const std::vector<GradReq> &grad_reqs,
                 const std::vector<NDArray> &aux_states) const;
+
+  // allocate every argument (and grad buffers for trainable ones) from
+  // shape inference and bind — the cpp-package SimpleBind flow.  Inputs
+  // named in `known` get GradReq::kNull; everything else trains.
+  Executor SimpleBind(
+      Context ctx, const std::map<std::string, std::vector<mx_uint>> &known,
+      std::map<std::string, NDArray> *arg_map = nullptr) const;
 
  private:
   template <typename F>
@@ -377,6 +418,32 @@ class Executor {
   std::shared_ptr<void> h_;
   std::vector<NDArray> args_, arg_grads_, aux_, outputs_;
 };
+
+inline Executor Symbol::SimpleBind(
+    Context ctx, const std::map<std::string, std::vector<mx_uint>> &known,
+    std::map<std::string, NDArray> *arg_map) const {
+  std::vector<std::vector<mx_uint>> arg_shapes, aux_shapes;
+  if (!InferShape(known, &arg_shapes, nullptr, &aux_shapes))
+    throw Error("SimpleBind: shapes are under-determined; provide more "
+                "input shapes");
+  auto names = ListArguments();
+  std::vector<NDArray> args, grads, auxs;
+  std::vector<GradReq> reqs;
+  for (size_t i = 0; i < names.size(); ++i) {
+    NDArray value(arg_shapes[i], ctx);
+    args.push_back(value);
+    if (arg_map) (*arg_map)[names[i]] = value;
+    if (known.count(names[i])) {
+      grads.emplace_back();
+      reqs.push_back(GradReq::kNull);
+    } else {
+      grads.push_back(NDArray(arg_shapes[i], ctx));
+      reqs.push_back(GradReq::kWrite);
+    }
+  }
+  for (const auto &s : aux_shapes) auxs.push_back(NDArray(s, ctx));
+  return Executor(*this, ctx, args, grads, reqs, auxs);
+}
 
 inline Executor Symbol::Bind(Context ctx, const std::vector<NDArray> &args,
                              const std::vector<NDArray> &arg_grads,

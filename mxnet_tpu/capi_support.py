@@ -442,3 +442,35 @@ def data_iter_get_label(it):
 
 def data_iter_get_pad(it):
     return int(_capi_batch(it).pad or 0)
+
+
+def symbol_infer_shape(sym, keys, shapes):
+    """(arg_shapes, out_shapes, aux_shapes) given known input shapes, or
+    None when inference is incomplete (ref: MXSymbolInferShape)."""
+    known = dict(zip(keys, [tuple(int(d) for d in s) for s in shapes]))
+    try:
+        args, outs, auxs = sym.infer_shape(**known)
+    except Exception:
+        raise
+    if args is None:
+        return None
+    return ([list(s) for s in args], [list(s) for s in outs],
+            [list(s) for s in auxs])
+
+
+def symbol_infer_type(sym, keys, dtype_codes):
+    """(arg_codes, out_codes, aux_codes) with the reference's dtype enum
+    (0=f32 1=f64 2=f16 3=u8 4=i32 ...)."""
+    from .base import np_dtype, dtype_name
+    code_of = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+               "int32": 4, "int8": 5, "int64": 6, "bfloat16": 7}
+    name_of = {v: k for k, v in code_of.items()}
+    known = {k: np_dtype(name_of[int(c)]) for k, c in zip(keys, dtype_codes)}
+    args, outs, auxs = sym.infer_type(**known)
+    if args is None:
+        return None
+
+    def codes(ts):
+        return [code_of.get(dtype_name(np_dtype(t or "float32")), 0)
+                for t in ts]
+    return (codes(args), codes(outs), codes(auxs))

@@ -1193,3 +1193,100 @@ int MXDataIterGetPadNum(DataIterHandle handle, int *out) {
 }  // extern "C"
 
 
+
+// -- Shape/type inference (ref: c_api_symbolic.cc MXSymbolInferShape) -------
+// Input shapes arrive in the reference's CSR layout: keys[i]'s shape is
+// arg_shape_data[arg_ind_ptr[i] : arg_ind_ptr[i+1]].  Outputs stash in
+// thread-local arrays valid until the next inference call.
+
+namespace {
+thread_local std::vector<std::vector<mx_uint>> tl_shapes_store;
+thread_local std::vector<mx_uint> tl_shape_ndim[3];
+thread_local std::vector<const mx_uint *> tl_shape_ptr[3];
+
+int stash_shape_group(PyObject *list, int slot, mx_uint *size,
+                      const mx_uint ***ndim_out, const mx_uint ***data_out,
+                      mx_uint **ndims) {
+  Py_ssize_t n = PyList_Size(list);
+  tl_shape_ndim[slot].clear();
+  tl_shape_ptr[slot].clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shape = PyList_GetItem(list, i);
+    Py_ssize_t nd = PyList_Size(shape);
+    tl_shapes_store.emplace_back();
+    auto &dst = tl_shapes_store.back();
+    for (Py_ssize_t d = 0; d < nd; ++d)
+      dst.push_back((mx_uint)PyLong_AsUnsignedLong(
+          PyList_GetItem(shape, d)));
+    tl_shape_ndim[slot].push_back((mx_uint)nd);
+    tl_shape_ptr[slot].push_back(dst.data());
+  }
+  *size = (mx_uint)n;
+  *ndims = tl_shape_ndim[slot].data();
+  *data_out = tl_shape_ptr[slot].data();
+  (void)ndim_out;
+  return 0;
+}
+}  // namespace
+
+extern "C" {
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  CHECK_NULL(sym, "SymbolHandle");
+  CHECK_NULL(complete, "output pointer");
+  if (num_args > 0) {
+    CHECK_NULL(keys, "keys");
+    CHECK_NULL(arg_ind_ptr, "arg_ind_ptr");
+    CHECK_NULL(arg_shape_data, "arg_shape_data");
+  }
+  GIL gil;
+  PyObject *key_list = str_list(keys, (int)num_args);
+  PyObject *shape_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *s = PyList_New(hi - lo);
+    for (mx_uint d = lo; d < hi; ++d)
+      PyList_SET_ITEM(s, d - lo,
+                      PyLong_FromUnsignedLong(arg_shape_data[d]));
+    PyList_SET_ITEM(shape_list, i, s);
+  }
+  PyObject *res = support_call(
+      "symbol_infer_shape",
+      Py_BuildValue("(ONN)", (PyObject *)sym, key_list, shape_list));
+  if (!res) return -1;
+  if (res == Py_None) {
+    *complete = 0;
+    Py_DECREF(res);
+    return 0;
+  }
+  tl_shapes_store.clear();
+  mx_uint sizes[3];
+  mx_uint *ndims[3];
+  const mx_uint **datas[3];
+  for (int g = 0; g < 3; ++g) {
+    stash_shape_group(PyTuple_GetItem(res, g), g, &sizes[g], nullptr,
+                      &datas[g], &ndims[g]);
+  }
+  Py_DECREF(res);
+  if (in_shape_size) *in_shape_size = sizes[0];
+  if (in_shape_ndim) *in_shape_ndim = ndims[0];
+  if (in_shape_data) *in_shape_data = datas[0];
+  if (out_shape_size) *out_shape_size = sizes[1];
+  if (out_shape_ndim) *out_shape_ndim = ndims[1];
+  if (out_shape_data) *out_shape_data = datas[1];
+  if (aux_shape_size) *aux_shape_size = sizes[2];
+  if (aux_shape_ndim) *aux_shape_ndim = ndims[2];
+  if (aux_shape_data) *aux_shape_data = datas[2];
+  *complete = 1;
+  return 0;
+}
+
+}  // extern "C"
