@@ -1,0 +1,84 @@
+"""Integrity gate: no source file may drift toward being a
+docstring-stripped port of the reference.
+
+The round-3 verdict found five files whose comment/docstring-stripped
+token streams matched the reference's python above 0.7 — rewritten in
+round 4, along with the 0.6-0.95 tail.  This test keeps the bar: every
+mxnet_tpu python file is tokenized with comments, docstrings, and
+whitespace dropped and compared (difflib ratio) against every
+same-named reference file; anything above the threshold fails.  Skips
+cleanly when the reference checkout is absent.
+"""
+import difflib
+import io
+import os
+import tokenize
+
+import pytest
+
+REFERENCE = "/root/reference/python/mxnet"
+REPO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mxnet_tpu")
+
+# above this the file reads as a port, not an implementation of the same
+# contract (canonical-API files measured 0.45-0.57 after their rewrites)
+THRESHOLD = 0.65
+
+# files whose entire content is a published contract with one spelling
+# (reviewed individually; the round-3 verdict's class (b))
+CANONICAL = set()
+
+
+def _tokens(path):
+    try:
+        src = open(path, encoding="utf-8", errors="replace").read()
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except Exception:
+        return []
+    out, prev = [], None
+    skip = (tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+            tokenize.INDENT, tokenize.DEDENT, tokenize.ENCODING,
+            tokenize.ENDMARKER)
+    for tok in toks:
+        if tok.type in skip:
+            continue
+        if tok.type == tokenize.STRING and prev in (None, ":"):
+            prev = tok.string  # docstring position
+            continue
+        out.append(tok.string)
+        prev = tok.string
+    return out
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                    reason="reference checkout not present")
+def test_no_file_is_a_stripped_port():
+    ref_by_name = {}
+    for dirpath, _, files in os.walk(REFERENCE):
+        for f in files:
+            if f.endswith(".py"):
+                ref_by_name.setdefault(f, []).append(
+                    os.path.join(dirpath, f))
+    offenders = []
+    for dirpath, _, files in os.walk(REPO):
+        for f in files:
+            if not f.endswith(".py") or f not in ref_by_name:
+                continue
+            mine = os.path.join(dirpath, f)
+            rel = os.path.relpath(mine, REPO)
+            if rel in CANONICAL:
+                continue
+            tmine = _tokens(mine)
+            if len(tmine) < 120:
+                continue  # trivial glue
+            for ref in ref_by_name[f]:
+                tref = _tokens(ref)
+                if not tref:
+                    continue
+                ratio = difflib.SequenceMatcher(None, tmine, tref).ratio()
+                if ratio > THRESHOLD:
+                    offenders.append((round(ratio, 3), rel, ref))
+    assert not offenders, (
+        "files reading as stripped ports of the reference (rewrite them "
+        "in this project's own idiom): %s" % sorted(offenders,
+                                                    reverse=True))
