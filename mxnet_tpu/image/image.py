@@ -5,12 +5,20 @@ The reference backs this with C++ OpenCV ops behind the C API
 cv2/PIL on the host (the same library the reference links) and the result
 uploads to device HBM once per batch.  The augmenter pipeline and ImageIter
 API match python/mxnet/image/image.py:482-1160.
+
+Design choices local to this module:
+  * `Augmenter.__init__` both records kwargs for `dumps()` and installs
+    them as attributes, so the dozen concrete augmenters are two-liners;
+  * every builtin augmenter is type-preserving (numpy in -> numpy out),
+    letting ImageIter run the whole per-image chain on the host with no
+    per-image device round-trips.
 """
 from __future__ import annotations
 
-import logging
+import json
 import os
 import random
+import threading
 
 import numpy as np
 
@@ -28,6 +36,15 @@ __all__ = ["imdecode", "imread", "imresize", "scale_down", "resize_short",
            "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
            "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
 
+# NTSC/YIQ luma weights + transform pair, shared by the color jitters
+_LUMA = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+_TO_YIQ = np.array([[0.299, 0.587, 0.114],
+                    [0.596, -0.274, -0.321],
+                    [0.211, -0.523, 0.311]], np.float32)
+_FROM_YIQ = np.array([[1.0, 0.956, 0.621],
+                      [1.0, -0.272, -0.647],
+                      [1.0, -1.107, 1.705]], np.float32)
+
 
 def _cv2():
     import cv2
@@ -41,9 +58,7 @@ def _cv2():
 # threads then cannot change the augmentation a given record receives.
 # Without an installed RNG the process-global generators are used, matching
 # the reference's single-threaded python path.
-import threading as _threading
-
-_aug_tls = _threading.local()
+_aug_tls = threading.local()
 
 
 def _rand():
@@ -79,6 +94,17 @@ def _augs_all_builtin(augs):
     return True
 
 
+def _as_numpy(img):
+    """(array, was_ndarray) — augmenter bodies compute in numpy."""
+    if isinstance(img, NDArray):
+        return img.asnumpy(), True
+    return img, False
+
+
+def _like(arr, was_nd):
+    return nd_array(arr) if was_nd else arr
+
+
 def _imdecode_np(buf, flag=1, to_rgb=True):
     """Decode to a HWC uint8 numpy array — the fast host path (no device
     round-trip; nd_array would place the image on the default backend)."""
@@ -112,8 +138,7 @@ def imresize(src, w, h, interp=2):
     ops would dispatch through jax and serialize on the GIL), NDArray in
     -> NDArray out (public API)."""
     cv2 = _cv2()
-    was_nd = isinstance(src, NDArray)
-    img = src.asnumpy() if was_nd else src
+    img, was_nd = _as_numpy(src)
     out = cv2.resize(img, (w, h), interpolation=interp)
     if out.ndim == 2:
         out = out[:, :, None]
@@ -146,22 +171,25 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
     return out
 
 
-def random_crop(src, size, interp=2):
+def _cropper(src, size, interp, centered):
+    """Shared random/center crop: pick the origin, cut, resize."""
     h, w = src.shape[:2]
     new_w, new_h = scale_down((w, h), size)
-    x0 = _rand().randint(0, w - new_w)
-    y0 = _rand().randint(0, h - new_h)
+    if centered:
+        x0, y0 = (w - new_w) // 2, (h - new_h) // 2
+    else:
+        x0 = _rand().randint(0, w - new_w)
+        y0 = _rand().randint(0, h - new_h)
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    return _cropper(src, size, interp, centered=False)
 
 
 def center_crop(src, size, interp=2):
-    h, w = src.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = (w - new_w) // 2
-    y0 = (h - new_h) // 2
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    return _cropper(src, size, interp, centered=True)
 
 
 def color_normalize(src, mean, std=None):
@@ -191,18 +219,21 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
 
 
 class Augmenter:
-    """Image augmenter base (ref: image.py:482)."""
+    """Image augmenter base (ref: image.py:482).
+
+    kwargs are installed as attributes AND recorded (JSON-safe) for
+    `dumps()`, so concrete augmenters don't repeat the bookkeeping.
+    """
 
     def __init__(self, **kwargs):
         for k, v in kwargs.items():
-            if isinstance(v, NDArray):
-                v = v.asnumpy()
-            if isinstance(v, np.ndarray):
-                kwargs[k] = v.tolist()  # keep dumps() JSON-serializable
-        self._kwargs = kwargs
+            setattr(self, k, v)
+        self._kwargs = {
+            k: (v.asnumpy() if isinstance(v, NDArray) else v).tolist()
+            if isinstance(v, (NDArray, np.ndarray)) else v
+            for k, v in kwargs.items()}
 
     def dumps(self):
-        import json
         return json.dumps([self.__class__.__name__.lower(), self._kwargs])
 
     def __call__(self, src):
@@ -220,11 +251,7 @@ class SequentialAug(Augmenter):
         return src
 
 
-class RandomOrderAug(Augmenter):
-    def __init__(self, ts):
-        super().__init__()
-        self.ts = ts
-
+class RandomOrderAug(SequentialAug):
     def __call__(self, src):
         ts = list(self.ts)
         _rand().shuffle(ts)
@@ -236,8 +263,6 @@ class RandomOrderAug(Augmenter):
 class ResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
 
     def __call__(self, src):
         return resize_short(src, self.size, self.interp)
@@ -246,8 +271,6 @@ class ResizeAug(Augmenter):
 class ForceResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
 
     def __call__(self, src):
         return imresize(src, self.size[0], self.size[1], self.interp)
@@ -256,8 +279,6 @@ class ForceResizeAug(Augmenter):
 class RandomCropAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
 
     def __call__(self, src):
         return random_crop(src, self.size, self.interp)[0]
@@ -267,10 +288,6 @@ class RandomSizedCropAug(Augmenter):
     def __init__(self, size, min_area, ratio, interp=2):
         super().__init__(size=size, min_area=min_area, ratio=ratio,
                          interp=interp)
-        self.size = size
-        self.min_area = min_area
-        self.ratio = ratio
-        self.interp = interp
 
     def __call__(self, src):
         return random_size_crop(src, self.size, self.min_area, self.ratio,
@@ -280,8 +297,6 @@ class RandomSizedCropAug(Augmenter):
 class CenterCropAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
 
     def __call__(self, src):
         return center_crop(src, self.size, self.interp)[0]
@@ -290,7 +305,6 @@ class CenterCropAug(Augmenter):
 class BrightnessJitterAug(Augmenter):
     def __init__(self, brightness):
         super().__init__(brightness=brightness)
-        self.brightness = brightness
 
     def __call__(self, src):
         alpha = 1.0 + _rand().uniform(-self.brightness, self.brightness)
@@ -298,69 +312,49 @@ class BrightnessJitterAug(Augmenter):
 
 
 class ContrastJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
-
     def __init__(self, contrast):
         super().__init__(contrast=contrast)
-        self.contrast = contrast
 
     def __call__(self, src):
         alpha = 1.0 + _rand().uniform(-self.contrast, self.contrast)
-        arr = src.asnumpy() if isinstance(src, NDArray) else src
-        gray = (arr * self._coef).sum()
+        arr, _ = _as_numpy(src)
+        gray = (arr * _LUMA).sum()
         gray = (3.0 * (1.0 - alpha) / arr.size) * gray
         return src * alpha + gray
 
 
 class SaturationJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
-
     def __init__(self, saturation):
         super().__init__(saturation=saturation)
-        self.saturation = saturation
 
     def __call__(self, src):
         alpha = 1.0 + _rand().uniform(-self.saturation, self.saturation)
-        was_nd = isinstance(src, NDArray)
-        arr = src.asnumpy() if was_nd else src
-        gray = (arr * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
-        return src * alpha + (nd_array(gray) if was_nd else gray)
+        arr, was_nd = _as_numpy(src)
+        gray = (arr * _LUMA).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return src * alpha + _like(gray, was_nd)
 
 
 class HueJitterAug(Augmenter):
     def __init__(self, hue):
         super().__init__(hue=hue)
-        self.hue = hue
-        self.tyiq = np.array([[0.299, 0.587, 0.114],
-                              [0.596, -0.274, -0.321],
-                              [0.211, -0.523, 0.311]], np.float32)
-        self.ityiq = np.array([[1.0, 0.956, 0.621],
-                               [1.0, -0.272, -0.647],
-                               [1.0, -1.107, 1.705]], np.float32)
 
     def __call__(self, src):
         alpha = _rand().uniform(-self.hue, self.hue)
-        u = np.cos(alpha * np.pi)
-        w = np.sin(alpha * np.pi)
-        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
-                      np.float32)
-        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
-        was_nd = isinstance(src, NDArray)
-        arr = src.asnumpy() if was_nd else src
-        out = np.dot(arr, t)
-        return nd_array(out) if was_nd else out
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        rot = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       np.float32)
+        t = (_FROM_YIQ @ rot @ _TO_YIQ).T
+        arr, was_nd = _as_numpy(src)
+        return _like(np.dot(arr, t), was_nd)
 
 
 class ColorJitterAug(RandomOrderAug):
     def __init__(self, brightness, contrast, saturation):
-        ts = []
-        if brightness > 0:
-            ts.append(BrightnessJitterAug(brightness))
-        if contrast > 0:
-            ts.append(ContrastJitterAug(contrast))
-        if saturation > 0:
-            ts.append(SaturationJitterAug(saturation))
-        super().__init__(ts)
+        jitters = [cls(amount) for cls, amount in
+                   ((BrightnessJitterAug, brightness),
+                    (ContrastJitterAug, contrast),
+                    (SaturationJitterAug, saturation)) if amount > 0]
+        super().__init__(jitters)
 
 
 class LightingAug(Augmenter):
@@ -368,7 +362,6 @@ class LightingAug(Augmenter):
 
     def __init__(self, alphastd, eigval, eigvec):
         super().__init__(alphastd=alphastd)
-        self.alphastd = alphastd
         self.eigval = eigval
         self.eigvec = eigvec
 
@@ -396,39 +389,33 @@ class ColorNormalizeAug(Augmenter):
             if self._nd_std is None and self.std is not None:
                 self._nd_std = nd_array(self.std)
             return color_normalize(src, self._nd_mean, self._nd_std)
-        out = src.astype(np.float32, copy=False)
-        return color_normalize(out, self.mean, self.std)
+        return color_normalize(src.astype(np.float32, copy=False),
+                               self.mean, self.std)
 
 
 class RandomGrayAug(Augmenter):
+    _gray = np.tile(np.array([[0.21], [0.72], [0.07]], np.float32), 3)
+
     def __init__(self, p):
         super().__init__(p=p)
-        self.p = p
-        self.mat = np.array([[0.21, 0.21, 0.21],
-                             [0.72, 0.72, 0.72],
-                             [0.07, 0.07, 0.07]], np.float32)
 
     def __call__(self, src):
-        if _rand().random() < self.p:
-            was_nd = isinstance(src, NDArray)
-            arr = src.asnumpy() if was_nd else src
-            out = np.dot(arr, self.mat)
-            src = nd_array(out) if was_nd else out
-        return src
+        if _rand().random() >= self.p:
+            return src
+        arr, was_nd = _as_numpy(src)
+        return _like(np.dot(arr, self._gray), was_nd)
 
 
 class HorizontalFlipAug(Augmenter):
     def __init__(self, p):
         super().__init__(p=p)
-        self.p = p
 
     def __call__(self, src):
-        if _rand().random() < self.p:
-            was_nd = isinstance(src, NDArray)
-            arr = src.asnumpy() if was_nd else src
-            out = arr[:, ::-1]
-            src = nd_array(out.copy()) if was_nd else np.ascontiguousarray(out)
-        return src
+        if _rand().random() >= self.p:
+            return src
+        arr, was_nd = _as_numpy(src)
+        out = np.ascontiguousarray(arr[:, ::-1])
+        return _like(out, was_nd)
 
 
 class CastAug(Augmenter):
@@ -482,6 +469,34 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def _parse_imglist_file(path):
+    """.lst file -> (key -> (label, relpath), ordered keys).  Format per
+    line: index<TAB>label...<TAB>path."""
+    table, order = {}, []
+    with open(path) as fin:
+        for line in fin:
+            fields = line.strip().split("\t")
+            if not fields or not fields[0]:
+                continue
+            key = int(fields[0])
+            table[key] = (np.array(fields[1:-1], np.float32), fields[-1])
+            order.append(key)
+    return table, order
+
+
+def _wrap_imglist(entries):
+    """In-memory [(label, path), ...] -> same mapping shape, 1-based
+    string keys (reference quirk kept for compatibility)."""
+    table, order = {}, []
+    for n, record in enumerate(entries, 1):
+        label, path = record[0], record[1]  # extra fields are ignored
+        if not isinstance(label, (list, np.ndarray)):
+            label = [label]
+        table[str(n)] = (np.array(label, np.float32), path)
+        order.append(str(n))
+    return table, order
+
+
 class ImageIter(DataIter):
     """Image iterator over .rec files or .lst/image-folder lists with
     augmentation (ref: image.py:999)."""
@@ -506,41 +521,16 @@ class ImageIter(DataIter):
                 self.imgidx = None
             self.seq = self.imgidx
         if path_imglist:
-            with open(path_imglist) as fin:
-                imglist = {}
-                imgkeys = []
-                for line in iter(fin.readline, ""):
-                    line = line.strip().split("\t")
-                    label = np.array(line[1:-1], dtype=np.float32)
-                    key = int(line[0])
-                    imglist[key] = (label, line[-1])
-                    imgkeys.append(key)
-                self.imglist = imglist
-                self.seq = imgkeys
+            self.imglist, self.seq = _parse_imglist_file(path_imglist)
         elif isinstance(imglist, list):
-            result = {}
-            imgkeys = []
-            index = 1
-            for img in imglist:
-                key = str(index)
-                index += 1
-                if isinstance(img[0], (list, np.ndarray)):
-                    label = np.array(img[0], dtype=np.float32)
-                else:
-                    label = np.array([img[0]], dtype=np.float32)
-                result[key] = (label, img[1])
-                imgkeys.append(str(key))
-            self.imglist = result
-            self.seq = imgkeys
+            self.imglist, self.seq = _wrap_imglist(imglist)
 
         self.path_root = path_root
         self.check_data_shape(data_shape)
         self.provide_data = [DataDesc(data_name, (batch_size,) + data_shape)]
-        if label_width > 1:
-            self.provide_label = [DataDesc(label_name,
-                                           (batch_size, label_width))]
-        else:
-            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self.provide_label = [DataDesc(label_name, label_shape)]
         self.batch_size = batch_size
         self.data_shape = data_shape
         self.label_width = label_width

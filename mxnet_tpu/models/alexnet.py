@@ -1,44 +1,39 @@
-"""AlexNet symbol builder (parity: example/image-classification/symbols/
-alexnet.py; architecture from Krizhevsky et al. 2012, one-column variant).
+"""AlexNet symbol builder (one-column variant, Krizhevsky et al. 2012).
 
-Used by the scoring benchmark (BASELINE.md AlexNet columns)."""
+Parity target: example/image-classification/symbols/alexnet.py — same
+graph, same parameter names (conv1..conv5, fc1..fc3).  The feature
+extractor is a spec table walked by one loop rather than five pasted
+stages; used by the scoring benchmark (BASELINE.md AlexNet columns).
+"""
 from __future__ import annotations
 
 from .. import symbol as sym
 
+# (num_filter, kernel, stride, pad, lrn_after, pool_after) per conv layer
+_FEATURES = (
+    (96, (11, 11), (4, 4), (0, 0), True, True),
+    (256, (5, 5), (1, 1), (2, 2), True, True),
+    (384, (3, 3), (1, 1), (1, 1), False, False),
+    (384, (3, 3), (1, 1), (1, 1), False, False),
+    (256, (3, 3), (1, 1), (1, 1), False, True),
+)
+
 
 def get_symbol(num_classes=1000, dtype="float32", **kwargs):
-    data = sym.var("data")
-    # stage 1
-    net = sym.Convolution(data, kernel=(11, 11), stride=(4, 4), num_filter=96,
-                          name="conv1")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.LRN(net, alpha=0.0001, beta=0.75, knorm=2, nsize=5)
-    net = sym.Pooling(net, pool_type="max", kernel=(3, 3), stride=(2, 2))
-    # stage 2
-    net = sym.Convolution(net, kernel=(5, 5), pad=(2, 2), num_filter=256,
-                          name="conv2")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.LRN(net, alpha=0.0001, beta=0.75, knorm=2, nsize=5)
-    net = sym.Pooling(net, pool_type="max", kernel=(3, 3), stride=(2, 2))
-    # stage 3: three convs
-    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=384,
-                          name="conv3")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=384,
-                          name="conv4")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=256,
-                          name="conv5")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.Pooling(net, pool_type="max", kernel=(3, 3), stride=(2, 2))
-    # classifier
+    net = sym.var("data")
+    for idx, (nf, kern, stride, pad, lrn, pool) in enumerate(_FEATURES, 1):
+        net = sym.Convolution(net, num_filter=nf, kernel=kern, stride=stride,
+                              pad=pad, name=f"conv{idx}")
+        net = sym.Activation(net, act_type="relu")
+        if lrn:
+            net = sym.LRN(net, alpha=1e-4, beta=0.75, knorm=2, nsize=5)
+        if pool:
+            net = sym.Pooling(net, pool_type="max", kernel=(3, 3),
+                              stride=(2, 2))
     net = sym.Flatten(net)
-    net = sym.FullyConnected(net, num_hidden=4096, name="fc1")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.Dropout(net, p=0.5)
-    net = sym.FullyConnected(net, num_hidden=4096, name="fc2")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.Dropout(net, p=0.5)
+    for idx in (1, 2):  # two dropout-regularized 4096-wide hidden layers
+        net = sym.FullyConnected(net, num_hidden=4096, name=f"fc{idx}")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.Dropout(net, p=0.5)
     net = sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
     return sym.SoftmaxOutput(net, name="softmax")

@@ -1,120 +1,63 @@
-"""ResNet symbol builder (parity: example/image-classification/symbols/resnet.py).
+"""ResNet v2 (pre-activation) symbol builder.
 
-Same CLI surface (`get_symbol(num_classes, num_layers, image_shape, ...)`);
-built on the Symbol API so `Module.fit` lowers the whole network — forward,
-backward, and SGD update — into a single XLA computation.
+Parity target: example/image-classification/symbols/resnet.py — same
+depths, same layer names (so reference checkpoints load by name), same
+`get_symbol` CLI surface.  The construction here is table-driven: each
+residual unit is a small conv plan walked by one loop, with the BN->relu
+pre-activation pair emitted before every conv (He et al. 2016,
+"Identity Mappings in Deep Residual Networks").
+
+Built on the Symbol API so `Module.fit` lowers the whole network —
+forward, backward, and optimizer update — into a single XLA program.
+GPU-era knobs from the reference (conv workspace MiB, memonger) have no
+TPU meaning; `get_symbol` still accepts them for CLI compatibility and
+ignores them.
 """
 from __future__ import annotations
 
 from .. import symbol as sym
 
+_BN = dict(fix_gamma=False, eps=2e-5, momentum=0.9)
+
+
+def _conv_plan(num_filter, stride, bottle_neck):
+    """Per-unit conv specs: (filters, kernel, stride, pad) per conv."""
+    if bottle_neck:
+        # 1x1 reduce -> strided 3x3 -> 1x1 expand (stride placement per
+        # the reference's v2 builder: on the middle conv)
+        return [(num_filter // 4, (1, 1), (1, 1), (0, 0)),
+                (num_filter // 4, (3, 3), stride, (1, 1)),
+                (num_filter, (1, 1), (1, 1), (0, 0))]
+    # basic block: strided 3x3 -> 3x3
+    return [(num_filter, (3, 3), stride, (1, 1)),
+            (num_filter, (3, 3), (1, 1), (1, 1))]
+
 
 def residual_unit(data, num_filter, stride, dim_match, name,
-                  bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
-    """One residual unit, pre-activation (ResNet v2) ordering."""
-    if bottle_neck:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5,
-                            momentum=bn_mom, name=name + "_bn1")
-        act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=int(num_filter * 0.25),
-                                kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
-                                name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5,
-                            momentum=bn_mom, name=name + "_bn2")
-        act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=int(num_filter * 0.25),
-                                kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, workspace=workspace,
-                                name=name + "_conv2")
-        bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5,
-                            momentum=bn_mom, name=name + "_bn3")
-        act3 = sym.Activation(data=bn3, act_type="relu", name=name + "_relu3")
-        conv3 = sym.Convolution(data=act3, num_filter=num_filter,
-                                kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
-                                name=name + "_conv3")
-        if dim_match:
-            shortcut = data
-        else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, workspace=workspace,
-                                       name=name + "_sc")
-        return conv3 + shortcut
-    else:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, momentum=bn_mom,
-                            eps=2e-5, name=name + "_bn1")
-        act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(data=act1, num_filter=num_filter,
-                                kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, workspace=workspace,
-                                name=name + "_conv1")
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, momentum=bn_mom,
-                            eps=2e-5, name=name + "_bn2")
-        act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(data=act2, num_filter=num_filter,
-                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                                no_bias=True, workspace=workspace,
-                                name=name + "_conv2")
-        if dim_match:
-            shortcut = data
-        else:
-            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
-                                       kernel=(1, 1), stride=stride,
-                                       no_bias=True, workspace=workspace,
-                                       name=name + "_sc")
-        return conv2 + shortcut
+                  bottle_neck=True, bn_mom=0.9, workspace=None,
+                  memonger=False):
+    """Pre-activation residual unit.
 
-
-def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256, dtype="float32",
-           memonger=False):
-    num_unit = len(units)
-    assert num_unit == num_stages
-    data = sym.Variable(name="data")
-    if dtype != "float32":
-        data = sym.Cast(data=data, dtype=dtype)
-    data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,
-                         momentum=bn_mom, name="bn_data")
-    nchannel, height, width = image_shape
-    if height <= 32:  # cifar/mnist-scale inputs
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
-    else:  # imagenet-scale
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
-        body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
-                             momentum=bn_mom, name="bn0")
-        body = sym.Activation(data=body, act_type="relu", name="relu0")
-        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                           pad=(1, 1), pool_type="max")
-
-    for i in range(num_stages):
-        body = residual_unit(body, filter_list[i + 1],
-                             (1 if i == 0 else 2,) * 2, False,
-                             name="stage%d_unit%d" % (i + 1, 1),
-                             bottle_neck=bottle_neck, workspace=workspace,
-                             memonger=memonger)
-        for j in range(units[i] - 1):
-            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
-                                 name="stage%d_unit%d" % (i + 1, j + 2),
-                                 bottle_neck=bottle_neck,
-                                 workspace=workspace, memonger=memonger)
-    bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
-                        momentum=bn_mom, name="bn1")
-    relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
-    pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
-                        pool_type="avg", name="pool1")
-    flat = sym.Flatten(data=pool1)
-    fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
-    if dtype != "float32":
-        fc1 = sym.Cast(data=fc1, dtype="float32")
-    return sym.SoftmaxOutput(data=fc1, name="softmax")
+    The first BN->relu activation is shared with the projection
+    shortcut (when one is needed), exactly as in the reference graph —
+    that sharing is what makes v2 "full pre-activation" rather than a
+    plain reordering.  `workspace`/`memonger` are GPU-era knobs with no
+    TPU meaning, accepted and ignored for signature compatibility.
+    """
+    bn = dict(_BN, momentum=bn_mom)
+    body, entry_act = data, None
+    for k, (nf, kern, st, pad) in enumerate(_conv_plan(num_filter, stride,
+                                                       bottle_neck), 1):
+        body = sym.BatchNorm(body, name=f"{name}_bn{k}", **bn)
+        body = sym.Activation(body, act_type="relu", name=f"{name}_relu{k}")
+        entry_act = entry_act if entry_act is not None else body
+        body = sym.Convolution(body, num_filter=nf, kernel=kern, stride=st,
+                               pad=pad, no_bias=True, name=f"{name}_conv{k}")
+    if dim_match:
+        return body + data
+    proj = sym.Convolution(entry_act, num_filter=num_filter, kernel=(1, 1),
+                           stride=stride, no_bias=True, name=f"{name}_sc")
+    return body + proj
 
 
 def depth_config(num_layers, height):
@@ -150,16 +93,53 @@ def depth_config(num_layers, height):
     return units, filter_list, bottle_neck
 
 
+def _stem(data, width, small_input):
+    """Input stem: a bare 3x3 conv at CIFAR scale, the classic
+    7x7/s2 + BN + relu + maxpool at ImageNet scale."""
+    if small_input:
+        return sym.Convolution(data, num_filter=width, kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name="conv0")
+    net = sym.Convolution(data, num_filter=width, kernel=(7, 7),
+                          stride=(2, 2), pad=(3, 3), no_bias=True,
+                          name="conv0")
+    net = sym.BatchNorm(net, name="bn0", **_BN)
+    net = sym.Activation(net, act_type="relu", name="relu0")
+    return sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max")
+
+
 def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
                dtype="float32", **kwargs):
-    """Build a ResNet symbol by depth for the given image shape."""
-    image_shape = [int(x) for x in image_shape.split(",")] \
+    """Build a ResNet-v2 symbol by depth for the given image shape."""
+    shape = [int(x) for x in image_shape.split(",")] \
         if isinstance(image_shape, str) else list(image_shape)
-    nchannel, height, width = image_shape
-    units, filter_list, bottle_neck = depth_config(num_layers, height)
-    num_stages = len(units)
+    height = shape[1]
+    units, filters, bottle_neck = depth_config(num_layers, height)
 
-    return resnet(units=units, num_stages=num_stages,
-                  filter_list=filter_list, num_classes=num_classes,
-                  image_shape=image_shape, bottle_neck=bottle_neck,
-                  workspace=conv_workspace, dtype=dtype)
+    net = sym.var("data")
+    if dtype != "float32":
+        net = sym.Cast(net, dtype=dtype)
+    # v2 normalizes the raw input with a scale-frozen BN before conv0
+    net = sym.BatchNorm(net, fix_gamma=True, eps=2e-5, momentum=0.9,
+                        name="bn_data")
+    net = _stem(net, filters[0], height <= 32)
+
+    for i, n in enumerate(units):
+        stride = (1, 1) if i == 0 else (2, 2)
+        for j in range(n):
+            net = residual_unit(net, filters[i + 1],
+                                stride if j == 0 else (1, 1), j > 0,
+                                f"stage{i + 1}_unit{j + 1}", bottle_neck)
+
+    # the trunk ends un-activated (units emit conv+shortcut), so one
+    # final BN->relu precedes global pooling
+    net = sym.BatchNorm(net, name="bn1", **_BN)
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.Pooling(net, global_pool=True, kernel=(7, 7), pool_type="avg",
+                      name="pool1")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=num_classes,
+                             name="fc1")
+    if dtype != "float32":
+        net = sym.Cast(net, dtype="float32")
+    return sym.SoftmaxOutput(net, name="softmax")
