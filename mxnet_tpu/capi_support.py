@@ -445,17 +445,23 @@ def data_iter_get_pad(it):
 
 
 def symbol_infer_shape(sym, keys, shapes):
-    """(arg_shapes, out_shapes, aux_shapes) given known input shapes, or
-    None when inference is incomplete (ref: MXSymbolInferShape)."""
+    """(arg_shapes, out_shapes, aux_shapes, complete) given known input
+    shapes.  Incomplete inference is SUCCESS with complete=0 and the
+    partial results filled in — fully-unknown shapes become ndim-0
+    entries, partially-known ones keep their 0 dims — matching the
+    reference's MXSymbolInferShape (c_api_symbolic.cc:495)."""
     known = dict(zip(keys, [tuple(int(d) for d in s) for s in shapes]))
-    try:
-        args, outs, auxs = sym.infer_shape(**known)
-    except Exception:
-        raise
-    if args is None:
-        return None
-    return ([list(s) for s in args], [list(s) for s in outs],
-            [list(s) for s in auxs])
+    args, outs, auxs = sym.infer_shape_partial(**known)
+
+    def _unknown(s):
+        return s is None or any(int(d) == 0 for d in s)
+
+    def _fill(group):
+        return [[] if s is None else [int(d) for d in s] for s in group]
+
+    complete = not any(_unknown(s)
+                       for group in (args, outs, auxs) for s in group)
+    return (_fill(args), _fill(outs), _fill(auxs), int(complete))
 
 
 def symbol_infer_type(sym, keys, dtype_codes):
@@ -465,7 +471,14 @@ def symbol_infer_type(sym, keys, dtype_codes):
     code_of = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
                "int32": 4, "int8": 5, "int64": 6, "bfloat16": 7}
     name_of = {v: k for k, v in code_of.items()}
-    known = {k: np_dtype(name_of[int(c)]) for k, c in zip(keys, dtype_codes)}
+    known = {}
+    for k, c in zip(keys, dtype_codes):
+        c = int(c)
+        if c not in name_of:
+            raise ValueError(
+                "unknown dtype code %d for argument %r (valid codes: %s)"
+                % (c, k, sorted(name_of)))
+        known[k] = np_dtype(name_of[c])
     args, outs, auxs = sym.infer_type(**known)
     if args is None:
         return None

@@ -102,12 +102,20 @@ class ShardedModule(BaseModule):
         _check_input_names(symbol, self._data_names, "data", True)
         _check_input_names(symbol, self._label_names, "label", False)
 
+        self._reset_bind()
+
+    def _reset_bind(self):
+        """Pristine unbound state — everything keyed to one bind's
+        shapes/shardings (also used by bind(force_rebind=True) so a
+        rebind can never train through stale compiled closures)."""
         self._host_args = None     # name -> cpu NDArray (masters' source)
         self._host_aux = None
         self._optimizer = None
         self._step = None
         self._fwd = None
         self._outputs = []
+        self.optimizer_initialized = False
+        self.params_initialized = False
 
     # -- introspection -------------------------------------------------------
     @property
@@ -143,6 +151,16 @@ class ShardedModule(BaseModule):
         if inputs_need_grad or shared_module is not None:
             raise MXNetError("ShardedModule does not support inputs_need_"
                              "grad or shared_module")
+        preserved = None
+        if self.binded:
+            # force_rebind: drop everything compiled against the old
+            # shapes/shardings (stale jitted closures would silently
+            # train the old program), but carry the trained parameter
+            # masters across — param shapes are batch-independent, and
+            # the reference Module preserves them too (module.py:196)
+            if self.params_initialized:
+                preserved = self.get_params()
+            self._reset_bind()
         self.for_training = for_training
         self.binded = True
 
@@ -208,6 +226,14 @@ class ShardedModule(BaseModule):
                 (l.name, batch_spec(l.name, l.shape))
                 for l in self._label_shapes)
         self._full_batch = int(self._data_shapes[0].shape[0])
+        batch_set = set(self._data_names) | set(self._label_names)
+        self._batch_arg_names = [n for n in prog.arg_names
+                                 if n in batch_set]
+
+        if preserved is not None:
+            # re-upload the carried masters under the NEW shardings
+            self.init_params(initializer=None, arg_params=preserved[0],
+                             aux_params=preserved[1], force_init=True)
 
     def _check_divisibility(self):
         """Clear errors beat XLA's at trace time."""
@@ -311,9 +337,7 @@ class ShardedModule(BaseModule):
         param_names = list(self._param_names)
         fixed_names = list(self._fixed_param_names)
         aux_names = list(prog.aux_names)
-        batch_names = [n for n in prog.arg_names
-                       if n in set(self._data_names) | set(self._label_names)]
-        self._batch_arg_names = batch_names
+        batch_names = self._batch_arg_names
 
         # f32 masters for half-width params under multi_precision —
         # sharded exactly like their parameter
@@ -400,6 +424,18 @@ class ShardedModule(BaseModule):
             out_shardings=(None, param_sh, state_sh,
                            {n: repl for n in aux_names}))
 
+        self._build_fwd()
+        self.optimizer_initialized = True
+
+    def _build_fwd(self):
+        """The eval-mode program; optimizer-independent, so forward()
+        can build it lazily after a rebind with no optimizer."""
+        prog = self._prog
+        param_names = list(self._param_names)
+        fixed_names = list(self._fixed_param_names)
+        aux_names = list(prog.aux_names)
+        batch_names = self._batch_arg_names
+
         def _fwd(params, fixed_vals, batch_vals, aux_vals, keys):
             amap = dict(zip(fixed_names, fixed_vals))
             amap.update(zip(batch_names, batch_vals))
@@ -409,7 +445,6 @@ class ShardedModule(BaseModule):
             return outs
 
         self._fwd = jax.jit(_fwd)
-        self.optimizer_initialized = True
 
     def _per_step_scalars(self):
         optimizer = self._optimizer
@@ -482,8 +517,7 @@ class ShardedModule(BaseModule):
                 "attach to")
         assert self.binded and self.params_initialized
         if self._fwd is None:
-            raise MXNetError("call init_optimizer (or fit) before forward: "
-                             "the eval program compiles there")
+            self._build_fwd()
         batch_vals = self._batch_vals(data_batch)
         keys = tuple(_random.next_key()
                      for _ in range(len(self._prog.rng_nodes)))

@@ -577,6 +577,9 @@ int MXExecutorBind(SymbolHandle symbol, int dev_type, int dev_id,
     CHECK_NULL(in_args, "in_args");
     CHECK_NULL(grad_req_type, "grad_req_type");
   }
+  if (aux_states_len > 0) {
+    CHECK_NULL(aux_states, "aux_states");
+  }
   GIL gil;
   PyObject *args = PyList_New(len);
   PyObject *grads = PyList_New(len);
@@ -791,6 +794,9 @@ int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
 int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
                     const char **keys, SymbolHandle *args) {
   CHECK_NULL(sym, "SymbolHandle");
+  if (num_args > 0) {
+    CHECK_NULL(args, "args");
+  }
   GIL gil;
   PyObject *arg_list = PyList_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
@@ -817,6 +823,9 @@ int MXSymbolComposeEx(SymbolHandle sym, const char *name, mx_uint num_args,
                       SymbolHandle *out) {
   CHECK_NULL(sym, "SymbolHandle");
   CHECK_NULL(out, "output pointer");
+  if (num_args > 0) {
+    CHECK_NULL(args, "args");
+  }
   GIL gil;
   PyObject *arg_list = PyList_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
@@ -972,8 +981,14 @@ static int kv_scalar(const char *fn, KVStoreHandle kv, int *out) {
   GIL gil;
   PyObject *res = support_call(fn, Py_BuildValue("(O)", (PyObject *)kv));
   if (!res) return -1;
-  *out = (int)PyLong_AsLong(res);
+  long v = PyLong_AsLong(res);
   Py_DECREF(res);
+  if (v == -1 && PyErr_Occurred()) {
+    PyErr_Clear();
+    last_error = std::string(fn) + " returned a non-integer";
+    return -1;
+  }
+  *out = (int)v;
   return 0;
 }
 
@@ -1275,6 +1290,13 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
     stash_shape_group(PyTuple_GetItem(res, g), g, &sizes[g], nullptr,
                       &datas[g], &ndims[g]);
   }
+  // 4th element: completeness flag — partial inference still fills the
+  // groups above (unknown shapes arrive as ndim-0 entries), matching
+  // the reference's MXSymbolInferShape contract
+  int comp = 1;
+  if (PyTuple_Size(res) > 3) {
+    comp = (int)PyLong_AsLong(PyTuple_GetItem(res, 3));
+  }
   Py_DECREF(res);
   if (in_shape_size) *in_shape_size = sizes[0];
   if (in_shape_ndim) *in_shape_ndim = ndims[0];
@@ -1285,7 +1307,7 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
   if (aux_shape_size) *aux_shape_size = sizes[2];
   if (aux_shape_ndim) *aux_shape_ndim = ndims[2];
   if (aux_shape_data) *aux_shape_data = datas[2];
-  *complete = 1;
+  *complete = comp;
   return 0;
 }
 

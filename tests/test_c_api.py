@@ -727,3 +727,46 @@ int main() {
                          env=env, timeout=300)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "SIMPLE_BIND_OK" in res.stdout
+
+
+def test_symbol_infer_shape_partial_reports_incomplete():
+    """Partially-known inputs are SUCCESS with *complete=0, not an error
+    (parity: c_api_symbolic.cc:495 MXSymbolInferShape)."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    s = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(net.tojson().encode(),
+                                      ctypes.byref(s)) == 0, _err()
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u32pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))
+    sizes = [ctypes.c_uint32() for _ in range(3)]
+    ndims = [u32p() for _ in range(3)]
+    datas = [u32pp() for _ in range(3)]
+    complete = ctypes.c_int(-1)
+
+    def infer(keys, ind_ptr, shape_data):
+        key_arr = (ctypes.c_char_p * len(keys))(*[k.encode() for k in keys])
+        ind = (ctypes.c_uint32 * len(ind_ptr))(*ind_ptr)
+        dat = (ctypes.c_uint32 * max(1, len(shape_data)))(*shape_data)
+        return lib.MXSymbolInferShape(
+            s, len(keys), key_arr, ind, dat,
+            ctypes.byref(sizes[0]), ctypes.byref(ndims[0]),
+            ctypes.byref(datas[0]),
+            ctypes.byref(sizes[1]), ctypes.byref(ndims[1]),
+            ctypes.byref(datas[1]),
+            ctypes.byref(sizes[2]), ctypes.byref(ndims[2]),
+            ctypes.byref(datas[2]), ctypes.byref(complete))
+
+    # nothing known -> success, complete=0, partial results still
+    # populated (all three args present, unknown shapes as ndim 0)
+    assert infer([], [0], []) == 0, _err()
+    assert complete.value == 0
+    assert sizes[0].value == 3
+    assert all(ndims[0][i] == 0 for i in range(3))
+    # data known -> complete=1 and fc weight inferred as (4, 7)
+    assert infer(["data"], [0, 2], [2, 7]) == 0, _err()
+    assert complete.value == 1
+    assert sizes[0].value == 3
+    w = [datas[0][1][d] for d in range(ndims[0][1])]
+    assert w == [4, 7]
+    lib.MXSymbolFree(s)

@@ -163,3 +163,38 @@ def test_batch_not_divisible_raises():
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     with pytest.raises(mx.base.MXNetError):
         mod.init_params(mx.initializer.Xavier())
+
+
+def test_force_rebind_resets_compiled_state():
+    """bind(force_rebind=True) after training must drop the jitted
+    step/forward closures and optimizer state built over the old batch
+    shapes, while carrying the trained parameters across — the standard
+    train-then-rebind-for-new-batch-size workflow (round-4 advisory;
+    param preservation matches Module.bind, module.py:196)."""
+    rng = np.random.RandomState(3)
+    X, Y = _toy_problem(rng)
+    mod = mx.mod.ShardedModule(_mlp(), mesh=_mesh(dp=2, tp=2))
+    it64 = mx.io.NDArrayIter(X, Y, batch_size=64,
+                             label_name="softmax_label")
+    mod.fit(it64, num_epoch=10, initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc64 = dict(mod.score(it64, "acc"))["accuracy"]
+    assert mod.optimizer_initialized and mod._step is not None
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy()
+
+    it32 = mx.io.NDArrayIter(X, Y, batch_size=32,
+                             label_name="softmax_label")
+    mod.bind(data_shapes=it32.provide_data,
+             label_shapes=it32.provide_label, force_rebind=True)
+    # stale compiled state is gone...
+    assert mod._step is None and mod._fwd is None
+    assert not mod.optimizer_initialized
+    # ...but the trained weights survived the rebind
+    assert mod.params_initialized
+    assert np.allclose(mod.get_params()[0]["fc1_weight"].asnumpy(),
+                       w_before)
+    # and scoring at the new batch size needs no re-initialization
+    acc32 = dict(mod.score(it32, "acc"))["accuracy"]
+    assert abs(acc32 - acc64) < 0.02, (acc32, acc64)
+    assert acc32 > 0.9, acc32
