@@ -49,45 +49,104 @@ PEAK_TFLOPS = {
 }
 
 
-def _flops_of(compiled):
-    """Total flops from an AOT-compiled computation's cost analysis."""
+def _cost_of(compiled):
+    """(flops, bytes_accessed) from an AOT-compiled computation's cost
+    analysis.  bytes_accessed is XLA's estimate of HBM traffic for one
+    execution — the numerator of the roofline fraction."""
     try:
         ca = compiled.cost_analysis()
     except Exception:
-        return 0.0
+        return 0.0, 0.0
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
-    return float(ca.get("flops", 0.0)) if ca else 0.0
+    if not ca:
+        return 0.0, 0.0
+    return (float(ca.get("flops", 0.0)),
+            float(ca.get("bytes accessed", 0.0)))
+
+
+def _flops_of(compiled):
+    return _cost_of(compiled)[0]
+
+
+def _bench_hbm(jax):
+    """Measured achievable HBM bandwidth: a STREAM-style triad
+    (y = a*y + x) over 512 MiB f32 arrays inside one chained fori_loop —
+    3 array passes (read x, read y, write y) per iteration, loop-carried
+    so XLA:TPU executes every pass (measured 760 GB/s on v5e, 93% of
+    the 819 GB/s spec).  Returns bytes/sec.
+
+    CPU caveat: XLA:CPU blocks elementwise recurrences ACROSS loop
+    iterations, so a cpu smoke run over-reports — the number is only
+    meaningful on the chip (cpu runs of bench.py are smoke-only
+    already)."""
+    import jax.numpy as jnp
+    n = 128 * 1024 * 1024  # 128M f32 = 512 MiB per array
+
+    @jax.jit
+    def loop(k, x, y):
+        def body(i, carry):
+            x, y = carry
+            return (x, y * jnp.float32(0.999) + x)
+        x, y = jax.lax.fori_loop(0, k, body, (x, y))
+        return jnp.sum(y)
+
+    @jax.jit
+    def make():
+        i = jnp.arange(n, dtype=jnp.float32)
+        return i % 997.0 * 1e-3, i % 991.0 * 1e-3
+
+    x, y = make()
+
+    def run(k, x, y):
+        return float(loop(k, x, y))  # host fetch
+
+    sec_per_iter = _timed_windows(run, x, y)
+    return 3.0 * n * 4 / sec_per_iter
 
 
 def _timed_windows(loop_fn, *args, reps=None):
-    """Run (small, large) window pairs; BEST (smallest positive) marginal
-    seconds per iteration across reps.  loop_fn must end in a host fetch.
+    """Marginal seconds/iteration between a small and an ADAPTIVELY
+    SIZED large window; median of paired marginals across reps.
+    loop_fn must end in a host fetch.
 
-    Host/tunnel interference is one-sided — contention only ever slows a
-    window — so the fastest rep is the least-biased estimate of the
-    uncontended chip rate (the same reason timeit documents min-time);
-    a median would fold other processes' noise into the chip's number.
-    The chained-loop construction still guarantees the work is real."""
+    Estimator forensics from rounds 4-5, recorded so the choice is not
+    re-litigated: the tunnel's fixed per-call cost C jitters by tens of
+    ms between calls.  (a) min-of-paired-diffs (r04) is biased FAST —
+    a contention spike landing on a pair's small window deflates that
+    pair's difference, and the min picks exactly the most deflated pair
+    (observed: f32 inference "99% MFU"); (b) difference-of-per-window-
+    minima is garbage whenever (N_large-N_small)*iter is comparable to
+    C's jitter (observed: 4 TB/s "HBM bandwidth", 5x the spec).  So:
+    size the large window such that the marginal COMPUTE is ~1s — an
+    order of magnitude above C jitter — and take the median of paired
+    marginals, which cancels the slowly-varying part of C pairwise and
+    is robust to spikes in either direction."""
     if reps is None:
         reps = REPS  # resolved at call time so main() can shrink it for cpu
     loop_fn(2, *args)  # warm (compile + caches)
+
+    def pair(n_lo, n_hi):
+        t0 = time.perf_counter()
+        loop_fn(n_lo, *args)
+        t1 = time.perf_counter()
+        loop_fn(n_hi, *args)
+        t2 = time.perf_counter()
+        return ((t2 - t1) - (t1 - t0)) / (n_hi - n_lo)
+
+    # scale probe -> window size targeting ~1s of marginal compute
+    rough = max(pair(N_SMALL, N_LARGE), 1e-5)
+    n_large = N_SMALL + max(N_LARGE - N_SMALL,
+                            min(int(1.0 / rough), 2000))
     for attempt in range(3):
-        estimates = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            loop_fn(N_SMALL, *args)
-            t1 = time.perf_counter()
-            loop_fn(N_LARGE, *args)
-            t2 = time.perf_counter()
-            estimates.append(((t2 - t1) - (t1 - t0)) / (N_LARGE - N_SMALL))
-        positive = [e for e in estimates if e > 0]
-        if positive:
-            return min(positive)
-        # host noise made every marginal estimate non-positive; re-measure
-        # rather than emit a negative/infinite rate in the JSON of record
-    raise RuntimeError(
-        "non-positive marginal sec/iter after retries: %r" % (estimates,))
+        estimates = sorted(e for e in
+                           (pair(N_SMALL, n_large) for _ in range(reps))
+                           if e > 0)
+        if estimates:
+            return estimates[len(estimates) // 2]
+        # pathological host noise; re-measure rather than emit a
+        # negative/infinite rate in the JSON of record
+    raise RuntimeError("non-positive marginal sec/iter after retries")
 
 
 def _build_resnet_exe(mx, ctx, rng, grad_req):
@@ -154,12 +213,19 @@ def _bench_inference(mx, jax, ctx, rng, compute_dtype=None):
     return BATCH / sec_per_iter, flops / sec_per_iter
 
 
-def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
-                    compute_dtype=None):
-    """compute_dtype=bfloat16 is the mixed-precision mode the framework's
-    FusedTrainStep runs under optimizer multi_precision: f32 master weights
-    and momentum, half-width cast inside the step, f32 gradients through
-    the cast's vjp (ref semantics: optimizer.py:446-476 mp_sgd_mom_update)."""
+def build_resnet_train_loop(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
+                            compute_dtype=None):
+    """The fused ResNet-50 SGD-momentum training loop used by BOTH the
+    throughput bench below and tools/roofline_probe.py (one
+    construction to keep in sync).  Returns
+    (loop, params0, mom0, aux0, flops, step_bytes) where loop(n, ...)
+    runs n chained steps on-device and returns a scalar accumulator.
+
+    compute_dtype=bfloat16 is the mixed-precision mode the framework's
+    FusedTrainStep runs under optimizer multi_precision: f32 master
+    weights and momentum, half-width cast inside the step, f32
+    gradients through the cast's vjp (ref semantics:
+    optimizer.py:446-476 mp_sgd_mom_update)."""
     import jax.numpy as jnp
     exe = _build_resnet_exe(mx, ctx, rng, grad_req="write")
     prog = exe._prog
@@ -200,9 +266,10 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
             new_mom.append(m2)
         return tuple(new_params), tuple(new_mom), new_aux, outs
 
-    # per-step flops from the compiled single step
+    # per-step flops + HBM bytes from the compiled single step
     mom0 = tuple(jnp.zeros_like(p) for p in params0)
-    flops = _flops_of(jax.jit(sgd_step).lower(params0, mom0, aux0).compile())
+    flops, step_bytes = _cost_of(
+        jax.jit(sgd_step).lower(params0, mom0, aux0).compile())
 
     @jax.jit
     def loop(n, params, mom, aux):
@@ -216,11 +283,101 @@ def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
             0, n, body, (params, mom, aux, jnp.float32(0.0)))
         return acc
 
+    return loop, params0, mom0, aux0, flops, step_bytes
+
+
+def _bench_training(mx, jax, ctx, rng, lr=0.01, momentum=0.9,
+                    compute_dtype=None):
+    loop, params0, mom0, aux0, flops, step_bytes = \
+        build_resnet_train_loop(mx, jax, ctx, rng, lr, momentum,
+                                compute_dtype)
+
     def run(n, params, mom, aux):
         return float(loop(n, params, mom, aux))  # host fetch
 
     sec_per_iter = _timed_windows(run, params0, mom0, aux0)
-    return BATCH / sec_per_iter, flops / sec_per_iter
+    return BATCH / sec_per_iter, flops / sec_per_iter, sec_per_iter, \
+        step_bytes
+
+
+def _bench_lstm(mx, jax, ctx, rng, batch=32, seq=35, hidden=200,
+                embed=200, layers=2, vocab=10000):
+    """BASELINE.json config 4: the LSTM language model of
+    examples/rnn/lstm_bucketing.py (fused RNN cells — cudnn_rnn-inl.h's
+    capability), one full SGD training step per iteration, chained.
+    Returns (tokens/sec, flops/sec)."""
+    import jax.numpy as jnp
+    stack = mx.rnn.FusedRNNCell(hidden, num_layers=layers, mode="lstm")
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    net = mx.sym.Embedding(data=data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    outputs, _ = stack.unroll(seq, inputs=net, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab, name="pred")
+    flat = mx.sym.Reshape(label, shape=(-1,))
+    sym = mx.sym.SoftmaxOutput(data=pred, label=flat, name="softmax")
+
+    exe = sym.simple_bind(ctx, grad_req="write", data=(batch, seq),
+                          softmax_label=(batch, seq))
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = rng.randint(0, vocab, arr.shape).astype(np.float32)
+        elif name == "softmax_label":
+            arr[:] = rng.randint(0, vocab, arr.shape).astype(np.float32)
+        else:
+            arr[:] = rng.normal(0, 0.02, arr.shape).astype(np.float32)
+    prog = exe._prog
+    arg_names, aux_names = prog.arg_names, prog.aux_names
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    other_names = [n for n in arg_names if n not in set(param_names)]
+    other_vals = tuple(exe.arg_dict[n]._h.array for n in other_names)
+    params0 = tuple(exe.arg_dict[n]._h.array for n in param_names)
+    aux0 = tuple(exe.aux_dict[n]._h.array for n in aux_names)
+    # fixed PRNG keys for the graph's rng nodes (dropout etc.): loop-
+    # invariant is fine for a throughput measurement
+    rng_keys = tuple(jax.random.PRNGKey(i)
+                     for i in range(len(prog.rng_nodes)))
+    lr = 0.01
+
+    def sgd_step(params, aux):
+        amap = dict(zip(other_names, other_vals))
+        aux_map = dict(zip(aux_names, aux))
+
+        def f(pvals):
+            m = dict(amap)
+            m.update(zip(param_names, pvals))
+            outs, new_aux = prog.evaluate(m, aux_map, rng_keys, True)
+            return outs, tuple(new_aux[n] for n in aux_names)
+
+        (outs, new_aux), vjp_fn = jax.vjp(f, params)
+        heads = [jnp.ones_like(o) for o in outs]
+        zeros_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+        (grads,) = vjp_fn((heads, zeros_aux))
+        new_params = tuple(w - lr / (batch * seq) * g
+                           for w, g in zip(params, grads))
+        return new_params, new_aux, outs
+
+    flops, _ = _cost_of(jax.jit(sgd_step).lower(params0, aux0).compile())
+
+    @jax.jit
+    def loop(n, params, aux):
+        def body(i, carry):
+            params, aux, acc = carry
+            params, aux, outs = sgd_step(params, aux)
+            return (params, aux,
+                    acc + jnp.mean(outs[0].astype(jnp.float32)))
+
+        _, _, acc = jax.lax.fori_loop(0, n, body,
+                                      (params, aux, jnp.float32(0.0)))
+        return acc
+
+    def run(n, params, aux):
+        return float(loop(n, params, aux))
+
+    sec_per_iter = _timed_windows(run, params0, aux0)
+    return batch * seq / sec_per_iter, flops / sec_per_iter
 
 
 def main():
@@ -242,10 +399,21 @@ def main():
     cdt = jnp.bfloat16  # the framework's native TPU precision mode
     infer_img_s, infer_flops_s = _bench_inference(mx, jax, ctx, rng,
                                                   compute_dtype=cdt)
-    train_img_s, train_flops_s = _bench_training(mx, jax, ctx, rng,
-                                                 compute_dtype=cdt)
+    (train_img_s, train_flops_s, train_sec_iter,
+     train_bytes) = _bench_training(mx, jax, ctx, rng, compute_dtype=cdt)
     infer32_img_s, infer32_flops_s = _bench_inference(mx, jax, ctx, rng)
-    train32_img_s, train32_flops_s = _bench_training(mx, jax, ctx, rng)
+    train32_img_s, train32_flops_s, _, _ = _bench_training(mx, jax, ctx,
+                                                           rng)
+    hbm_bps = _bench_hbm(jax)
+    lstm_tok_s, lstm_flops_s = _bench_lstm(mx, jax, ctx, rng)
+    # roofline evidence: XLA's bytes-accessed is an UPPER bound on real
+    # HBM traffic (it counts operand bytes at HLO boundaries, ignoring
+    # fusion reuse — measured ~2.5x the physical traffic on this step),
+    # so the fraction is reported as a bound, not a proof by itself; the
+    # MFU number is the primary evidence.
+    roofline_sec = train_bytes / hbm_bps if hbm_bps else 0.0
+    roofline_fraction = roofline_sec / train_sec_iter \
+        if train_sec_iter else None
 
     def tf(x):
         return round(x / 1e12, 2) if x else None
@@ -275,6 +443,19 @@ def main():
         "inference_f32_mfu": mfu(infer32_flops_s),
         "device_kind": kind,
         "peak_tflops_bf16": peak,
+        # roofline evidence for the train-MFU ceiling (round-4 verdict 3);
+        # bytes are XLA's cost-analysis UPPER bound on HBM traffic, so
+        # fraction >1 means the bound is loose, not that the step beat
+        # the memory system
+        "hbm_gbps_measured": round(hbm_bps / 1e9, 1),
+        "train_bytes_per_step_xla_bound": int(train_bytes),
+        "roofline_fraction_upper_bound": round(roofline_fraction, 3)
+        if roofline_fraction is not None else None,
+        # BASELINE config 4: LSTM LM (batch 32, seq 35, 2x200 fused LSTM,
+        # vocab 10k), full SGD step
+        "lstm_tokens_s": round(lstm_tok_s, 1),
+        "lstm_tflops": tf(lstm_flops_s),
+        "lstm_mfu": mfu(lstm_flops_s),
     }))
 
 

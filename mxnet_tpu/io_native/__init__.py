@@ -15,7 +15,19 @@ import os
 import subprocess
 import threading
 
-_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "src")
+def _find_src_dir():
+    """Native sources: <repo>/src from a checkout, the package-data copy
+    (mxnet_tpu/_native/src, bundled by setup.py) from an installed
+    wheel.  Headers live at <src>/../include in both layouts."""
+    here = os.path.dirname(__file__)
+    for cand in (os.path.join(here, "..", "..", "src"),
+                 os.path.join(here, "..", "_native", "src")):
+        if os.path.isdir(cand):
+            return cand
+    return os.path.join(here, "..", "..", "src")  # checkout default
+
+
+_SRC_DIR = _find_src_dir()
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libmxnet_tpu_native.so")
 _lock = threading.Lock()
 _lib = None

@@ -1,0 +1,484 @@
+"""PipelineModule: pipeline parallelism through the Module API.
+
+The reference's frontend seam for model parallelism was per-layer
+context groups (example/model-parallel/lstm/lstm.py:65 group2ctx +
+AttrScope(ctx_group=...)): the user said WHERE layers live and the
+executor inserted cross-device copies.  The TPU-native seam is the mesh:
+here the user says WHAT repeats — the model is
+
+    stem  ->  n_stages x body  ->  head
+
+exactly the shape of a pipelined transformer (N identical blocks).  The
+body is ONE Symbol whose parameters are instantiated per stage, stacked
+on a leading dim sharded over the mesh's `pp` axis; training runs the
+GPipe microbatch schedule (parallel/pipeline.py) inside a single jitted
+step (parallel/train.py ShardedTrainStep), with dp riding the batch dim
+of the same mesh.
+
+Symbol contracts:
+  stem: maps the data variable to the pipeline input  (optional)
+  body: input variable named "x", single output, SAME shape as input
+  head: input variable named "x" (+ the label variable), must end in
+        SoftmaxOutput — training minimizes its NLL, whose logit
+        gradient (p - onehot) is exactly SoftmaxOutput's backward
+Auxiliary states (BatchNorm moving stats) are not supported inside
+pipeline stages in this module; use ShardedModule or express the norm
+statelessly (LayerNorm).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import cpu
+from ..initializer import Uniform, InitDesc
+from ..io import DataDesc
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+
+def _parse_desc(shapes):
+    out = []
+    for d in shapes or []:
+        out.append(d if isinstance(d, DataDesc) else DataDesc(d[0], d[1]))
+    return out
+
+
+class PipelineModule(BaseModule):
+    """Train stem -> n_stages x body -> head with pp x dp parallelism."""
+
+    def __init__(self, body, n_stages, head, stem=None, mesh=None,
+                 n_micro=None, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging):
+        super().__init__(logger=logger)
+        from .sharded import _as_mesh
+        self.mesh = _as_mesh(mesh)
+        self._body = body
+        self._head = head
+        self._stem = stem
+        self._n_stages = int(n_stages)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._n_micro = n_micro
+        pp = self.mesh.shape.get("pp", 1)
+        if self._n_stages % max(pp, 1):
+            raise MXNetError("n_stages=%d must divide over pp=%d"
+                             % (self._n_stages, pp))
+        for name, sym in (("body", body), ("head", head), ("stem", stem)):
+            if sym is not None and sym.list_auxiliary_states():
+                raise MXNetError(
+                    "%s symbol has auxiliary states (%s); PipelineModule "
+                    "stages are stateless — see module docstring"
+                    % (name, sym.list_auxiliary_states()))
+        self._n_micro_arg = n_micro  # user request; resolved per bind
+        self._reset_bind()
+
+    def _reset_bind(self):
+        """Pristine unbound state: everything compiled against one
+        bind's shapes (also run by bind(force_rebind=True) so a rebind
+        can never train through stale closures — the jitted step bakes
+        in rescale_grad=1/batch and the microbatch split)."""
+        self._step = None
+        self._fwd = None
+        self._loss = None
+        self._mom = None
+        self._n_micro = self._n_micro_arg
+        self.optimizer_initialized = False
+        self.params_initialized = False
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._head.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._output_shapes
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if inputs_need_grad or shared_module is not None:
+            raise MXNetError("PipelineModule does not support "
+                             "inputs_need_grad or shared_module")
+        preserved = None
+        if self.binded:
+            # carry trained params across the rebind (shapes are
+            # batch-independent), drop every compiled closure
+            if self.params_initialized:
+                preserved = self.get_params()[0]
+            self._reset_bind()
+        from ..executor import _Program
+
+        self._data_shapes = _parse_desc(data_shapes)
+        self._label_shapes = _parse_desc(label_shapes)
+        self.for_training = for_training
+        batch = int(self._data_shapes[0].shape[0])
+        self._full_batch = batch
+        dp = self.mesh.shape.get("dp", 1)
+        if batch % dp:
+            raise MXNetError("batch %d does not divide over dp=%d"
+                             % (batch, dp))
+        if self._n_micro is None:
+            # >=2 microbatches per dp replica keeps the bubble bounded
+            # (pipeline.py's layout heuristic); must divide the batch,
+            # so take the largest batch divisor <= 2*dp
+            want = min(batch, 2 * dp)
+            self._n_micro = next(m for m in range(want, 0, -1)
+                                 if batch % m == 0)
+        if batch % self._n_micro:
+            raise MXNetError("batch %d not divisible by n_micro %d"
+                             % (batch, self._n_micro))
+
+        data_name = self._data_names[0]
+        known = {d.name: tuple(d.shape) for d in self._data_shapes}
+
+        # stem: data -> x
+        if self._stem is not None:
+            self._stem_prog = _Program(self._stem)
+            self._stem_prog.finalize_shapes(known)
+            _, stem_outs, _ = self._stem.infer_shape(**known)
+            x_shape = tuple(stem_outs[0])
+        else:
+            self._stem_prog = None
+            x_shape = tuple(self._data_shapes[0].shape)
+        self._x_shape = x_shape
+
+        # body: x -> x, shape-preserving
+        self._body_prog = _Program(self._body)
+        self._body_prog.finalize_shapes({"x": x_shape})
+        body_args, body_outs, _ = self._body.infer_shape(x=x_shape)
+        if tuple(body_outs[0]) != x_shape:
+            raise MXNetError(
+                "body must preserve shape: x %s -> %s"
+                % (x_shape, tuple(body_outs[0])))
+        self._body_param_shapes = {
+            n: tuple(s) for n, s in zip(self._body.list_arguments(),
+                                        body_args) if n != "x"}
+
+        # head: x (+label) -> outputs
+        hk = dict({"x": x_shape},
+                  **{l.name: tuple(l.shape) for l in self._label_shapes})
+        head_known = {k: v for k, v in hk.items()
+                      if k in self._head.list_arguments()}
+        self._head_prog = _Program(self._head)
+        self._head_prog.finalize_shapes(head_known)
+        _, head_outs, _ = self._head.infer_shape(**head_known)
+        self._output_shapes = list(zip(self._head.list_outputs(),
+                                       [tuple(s) for s in head_outs]))
+        for tag, prog in (("stem", self._stem_prog),
+                          ("body", self._body_prog),
+                          ("head", self._head_prog)):
+            if prog is not None and prog.rng_nodes:
+                raise MXNetError(
+                    "%s graph contains rng ops (Dropout etc.); "
+                    "PipelineModule's fused step does not thread PRNG "
+                    "keys through the pipeline schedule yet" % tag)
+        self.binded = True
+        if preserved is not None:
+            self.init_params(initializer=None, arg_params=preserved,
+                             force_init=True)
+
+    def _prog_param_names(self, prog, sym, inputs):
+        return [n for n in sym.list_arguments() if n not in inputs]
+
+    # -- parameters ----------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import shard_params_rule
+
+        attrs = {}
+        for sym in (self._stem, self._body, self._head):
+            if sym is not None:
+                attrs.update(sym.attr_dict())
+        def host_init(name, shape, attr_name=None):
+            if arg_params and name in arg_params:
+                return np.asarray(arg_params[name].asnumpy(), np.float32)
+            if arg_params is not None and not allow_missing:
+                raise MXNetError(
+                    "%s is not presented (pass allow_missing=True to "
+                    "initializer-fill parameters absent from arg_params)"
+                    % name)
+            fill = initializer or Uniform(0.01)
+            from ..ndarray import zeros as nd_zeros
+            h = nd_zeros(shape, cpu(), dtype=np.float32)
+            fill(InitDesc(name, attrs.get(attr_name or name)), h)
+            return np.asarray(h.asnumpy())
+
+        params, sharding = {}, {}
+        inputs = set(self._data_names) | set(self._label_names) | {"x"}
+
+        # stage params: n_stages independent inits stacked on dim 0,
+        # sharded over pp (each stage group's chips hold their slice).
+        # attr lookup uses the body symbol's ORIGINAL arg name (attrs
+        # are keyed pre-stage-prefixing).
+        for n, shp in self._body_param_shapes.items():
+            stack = np.stack(
+                [host_init("stage%d_%s" % (s, n), shp, attr_name=n)
+                 for s in range(self._n_stages)])
+            key = "body:" + n
+            sharding[key] = NamedSharding(
+                self.mesh, P(*(("pp",) + (None,) * len(shp))))
+            params[key] = jax.device_put(stack, sharding[key])
+
+        for tag, sym in (("stem", self._stem), ("head", self._head)):
+            if sym is None:
+                continue
+            known = {d.name: tuple(d.shape) for d in self._data_shapes} \
+                if tag == "stem" else {"x": self._x_shape}
+            if tag == "head":
+                known.update((l.name, tuple(l.shape))
+                             for l in self._label_shapes
+                             if l.name in sym.list_arguments())
+            arg_shapes, _, _ = sym.infer_shape(**known)
+            for n, shp in zip(sym.list_arguments(), arg_shapes):
+                if n in inputs:
+                    continue
+                key = tag + ":" + n
+                sharding[key] = shard_params_rule(self.mesh, n, tuple(shp))
+                params[key] = jax.device_put(host_init(n, tuple(shp)),
+                                             sharding[key])
+
+        self._params = params
+        self._param_sharding = sharding
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        import jax
+        args = {}
+        for key, v in self._params.items():
+            tag, n = key.split(":", 1)
+            host = np.asarray(jax.device_put(v, cpu().jax_device()))
+            if tag == "body":
+                for s in range(self._n_stages):
+                    args["stage%d_%s" % (s, n)] = NDArray(
+                        jax.device_put(host[s], cpu().jax_device()))
+            else:
+                args[n] = NDArray(jax.device_put(host, cpu().jax_device()))
+        return args, {}
+
+    # -- the fused pipelined step --------------------------------------------
+    # NOTE on gradients: the head ends in SoftmaxOutput, whose
+    # custom_vjp IGNORES the upstream cotangent and emits (p - onehot)
+    # per sample — SoftmaxOutput IS the loss (ops/nn.py:813, the
+    # reference's Executor.backward convention).  So the step follows
+    # the same protocol as ShardedModule/_Program training: jax.vjp
+    # with ones head-gradients, then the optimizer's rescale_grad
+    # (1/batch) — NOT value_and_grad over an extra NLL, which would
+    # double-count the loss scale through the custom backward.
+    def _build_loss_fn(self, is_train=True):
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.pipeline import pipeline_stages
+        from jax.sharding import PartitionSpec as P
+
+        stem_prog, body_prog, head_prog = (self._stem_prog,
+                                           self._body_prog, self._head_prog)
+        stem_sym, body_sym, head_sym = self._stem, self._body, self._head
+        data_name = self._data_names[0]
+        label_name = self._label_names[0] if self._label_names else None
+        n_micro, mesh = self._n_micro, self.mesh
+        body_param_names = list(self._body_param_shapes)
+        pp = mesh.shape.get("pp", 1)
+        stages_per_chip = self._n_stages // max(pp, 1)
+
+        def body_fn(stage_params, xm):
+            # stage_params: this chip's [stages_per_chip, ...] slices;
+            # apply its stages in order (virtual stages per chip)
+            def one(x, s):
+                m = {"x": x}
+                m.update((n, stage_params[n][s])
+                         for n in body_param_names)
+                outs, _ = body_prog.evaluate(m, {}, (), is_train)
+                return outs[0]
+            x = xm
+            for s in range(stages_per_chip):
+                x = one(x, s)
+            return x
+
+        def loss_fn(params, batch):
+            data = batch[data_name]
+            if stem_prog is not None:
+                m = {data_name: data}
+                m.update((k.split(":", 1)[1], v) for k, v in params.items()
+                         if k.startswith("stem:"))
+                outs, _ = stem_prog.evaluate(m, {}, (), is_train)
+                x = outs[0]
+            else:
+                x = data
+            stage_params = {n: params["body:" + n]
+                            for n in body_param_names}
+            # reshape stacked [n_stages, ...] -> [pp, per_chip, ...] so the
+            # pp shard boundary hands each chip its stage group
+            grouped = {
+                n: p.reshape((pp, stages_per_chip) + p.shape[1:])
+                for n, p in stage_params.items()}
+            x = pipeline_stages(
+                grouped, x,
+                lambda sp, xm: body_fn(sp, xm),
+                n_micro=n_micro, mesh=mesh,
+                params_spec={n: P("pp") for n in body_param_names},
+                batch_axis="dp")
+            hm = {"x": x}
+            if label_name is not None and \
+                    label_name in head_sym.list_arguments():
+                hm[label_name] = batch[label_name]
+            hm.update((k.split(":", 1)[1], v) for k, v in params.items()
+                      if k.startswith("head:"))
+            outs, _ = head_prog.evaluate(hm, {}, (), is_train)
+            return outs
+
+        def nll_of(outs, batch):
+            probs = outs[0]
+            labels = batch[label_name].astype(jnp.int32)
+            logp = jnp.log(jnp.clip(probs, 1e-30, 1.0))
+            return jnp.mean(-jnp.take_along_axis(logp, labels[..., None],
+                                                 axis=-1))
+
+        return loss_fn, nll_of
+
+    def init_optimizer(self, kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        opts = dict(optimizer_params)
+        if not isinstance(optimizer, str) or optimizer not in ("sgd",):
+            raise MXNetError("PipelineModule compiles an sgd(+momentum) "
+                             "step; got %r" % (optimizer,))
+        lr = float(opts.get("learning_rate", 0.01))
+        momentum = float(opts.get("momentum", 0.0))
+        wd = float(opts.get("wd", 0.0))
+        rescale = float(opts.get("rescale_grad", 1.0 / self._full_batch))
+        fwd_fn, nll_of = self._build_loss_fn(is_train=True)
+        param_sharding = self._param_sharding
+        batch_sharding = self._batch_shardings()
+        import jax.numpy as jnp
+
+        def step(params, mom, batch):
+            outs, vjp_fn = jax.vjp(lambda p: fwd_fn(p, batch), params)
+            heads = [jnp.ones_like(o) for o in outs]
+            (grads,) = vjp_fn(heads)
+            loss = nll_of(outs, batch)
+            new_p, new_m = {}, {}
+            for k in params:
+                g = grads[k] * rescale + wd * params[k]
+                m = momentum * mom[k] + g
+                new_p[k] = params[k] - lr * m
+                new_m[k] = m
+            return new_p, new_m, loss, outs
+
+        repl = NamedSharding(self.mesh, P())
+        self._mom = {
+            k: jax.device_put(np.zeros(v.shape, v.dtype),
+                              param_sharding[k])
+            for k, v in self._params.items()}
+        self._step = jax.jit(
+            step,
+            in_shardings=(param_sharding, param_sharding, batch_sharding),
+            out_shardings=(param_sharding, param_sharding, repl, None))
+        self.optimizer_initialized = True
+
+    def _batch_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return {
+            d.name: NamedSharding(
+                self.mesh, P(*(("dp",) + (None,) * (len(d.shape) - 1))))
+            for d in self._data_shapes + self._label_shapes}
+
+    def _build_eval(self):
+        """The eval-mode program; optimizer-independent, built lazily so
+        bind -> init_params -> score works without an optimizer."""
+        import jax
+        eval_fn, _ = self._build_loss_fn(is_train=False)
+        self._fwd = jax.jit(
+            lambda params, batch: eval_fn(params, batch),
+            in_shardings=(self._param_sharding, self._batch_shardings()))
+
+    # -- compute -------------------------------------------------------------
+    def _batch_dict(self, data_batch):
+        # host numpy -> ONE explicit device_put per input onto the mesh
+        # sharding: handing raw numpy to the jitted step would stage it
+        # through the DEFAULT backend, which under the driver may be a
+        # broken/poisoned TPU runtime while the mesh is CPU devices.
+        # Label-less batches (predict/score without labels) get zero
+        # labels of the bound shape — SoftmaxOutput's forward ignores
+        # label values, and a fixed pytree keeps the jit cache to one
+        # entry per bind.
+        import jax
+        shardings = self._batch_shardings()
+        out = {}
+        for n, v in zip(self._data_names, data_batch.data):
+            out[n] = jax.device_put(np.asarray(v.asnumpy()), shardings[n])
+        labels = data_batch.label or []
+        for i, l in enumerate(self._label_shapes):
+            if i < len(labels) and labels[i] is not None:
+                host = np.asarray(labels[i].asnumpy())
+            else:
+                host = np.zeros(l.shape, np.float32)
+            out[l.name] = jax.device_put(host, shardings[l.name])
+        return out
+
+    def forward_backward(self, data_batch):
+        assert self.optimizer_initialized, "call init_optimizer first"
+        batch = self._batch_dict(data_batch)
+        self._params, self._mom, loss, outs = self._step(
+            self._params, self._mom, batch)
+        self._loss = loss
+        self._outputs = [NDArray(o) for o in outs]
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if self._fwd is None:
+            self._build_eval()
+        outs = self._fwd(self._params, self._batch_dict(data_batch))
+        self._outputs = [NDArray(o) for o in outs]
+
+    def backward(self, out_grads=None):
+        raise MXNetError("PipelineModule fuses backward into "
+                         "forward_backward")
+
+    def update(self):
+        pass  # the fused step already applied the optimizer
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._outputs)
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._outputs)
+
+    @property
+    def loss(self):
+        """Mean NLL of the last forward_backward step (replicated)."""
+        return None if self._loss is None else float(np.asarray(self._loss))

@@ -1,0 +1,29 @@
+"""Wheel build: bundle the native C++ sources as package data.
+
+The io_native layer compiles src/*.cc lazily at first use (atomic-rename
+.so cache).  From a checkout those sources live at <repo>/src and
+<repo>/include; a wheel has no repo, so build_py copies them into
+mxnet_tpu/_native/{src,include} and io_native falls back to that
+location (see mxnet_tpu/io_native/__init__.py::_SRC_DIR).
+"""
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeSources(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        dest = os.path.join(self.build_lib, "mxnet_tpu", "_native")
+        for sub in ("src", "include"):
+            src_dir = os.path.join(here, sub)
+            dst_dir = os.path.join(dest, sub)
+            if os.path.isdir(dst_dir):
+                shutil.rmtree(dst_dir)
+            shutil.copytree(src_dir, dst_dir)
+
+
+setup(cmdclass={"build_py": BuildWithNativeSources})

@@ -1295,7 +1295,15 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
   // the reference's MXSymbolInferShape contract
   int comp = 1;
   if (PyTuple_Size(res) > 3) {
-    comp = (int)PyLong_AsLong(PyTuple_GetItem(res, 3));
+    long v = PyLong_AsLong(PyTuple_GetItem(res, 3));
+    if (v == -1 && PyErr_Occurred()) {
+      PyErr_Clear();
+      Py_DECREF(res);
+      last_error = "symbol_infer_shape returned a non-integer "
+                   "completeness flag";
+      return -1;
+    }
+    comp = (int)v;
   }
   Py_DECREF(res);
   if (in_shape_size) *in_shape_size = sizes[0];

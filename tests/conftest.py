@@ -11,7 +11,12 @@ import os
 
 import pytest
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# MXTPU_CHIP_TESTS=1: leave the platform alone so the real chip is the
+# default backend — the once-per-round accelerator tier (consistency
+# sweep etc.).  Run it SERIALLY (-n 0): two processes sharing the one
+# tunneled chip produce silently-wrong results.
+if os.environ.get("MXTPU_CHIP_TESTS") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -47,6 +52,16 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: quick iteration tier (run with -m fast)")
+    # self-enforce the chip tier's serial-only contract: parallel
+    # workers sharing the one tunneled chip compute garbage silently
+    if os.environ.get("MXTPU_CHIP_TESTS") == "1" and (
+            os.environ.get("PYTEST_XDIST_WORKER")
+            or getattr(config.option, "numprocesses", None) not in (None,
+                                                                    0, "0")):
+        raise pytest.UsageError(
+            "MXTPU_CHIP_TESTS=1 must run serially (-n 0): parallel "
+            "workers sharing the tunneled chip produce silently-wrong "
+            "results")
 
 
 # long-running convergence tests inside otherwise-fast modules; they stay

@@ -11,12 +11,14 @@ from mxnet_tpu.test_utils import check_consistency
 
 
 def _second_ctx():
-    import jax
-    try:
+    # chip comparisons only in the opt-in serial tier (MXTPU_CHIP_TESTS=1
+    # -n 0): the axon plugin exposes the tunneled chip even under
+    # JAX_PLATFORMS=cpu, and parallel workers sharing it compute garbage
+    import os
+    if os.environ.get("MXTPU_CHIP_TESTS") == "1":
+        import jax
         if any(d.platform != "cpu" for d in jax.local_devices()):
             return mx.tpu(0)
-    except Exception:
-        pass
     return mx.cpu(1)
 
 

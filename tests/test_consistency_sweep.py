@@ -1,0 +1,263 @@
+"""Registry-driven cpu <-> device consistency sweep.
+
+The reference re-runs its ENTIRE operator suite on the second backend
+(tests/python/gpu/test_operator_gpu.py:29 re-imports test_operator and
+compares with check_consistency).  This module does the same thing
+structurally: every Case in test_op_sweep's registry-enforced table is
+re-executed on a context pair — forward outputs AND symbolic gradients
+computed on each device from identical inputs/head-grads — and compared
+under a per-dtype tolerance policy.
+
+Context pair:
+  * CI (cpu-only): cpu(0) vs cpu(1) — same XLA backend, exercises the
+    machinery and placement paths;
+  * chip tier: ``MXTPU_CHIP_TESTS=1 pytest tests/test_consistency_sweep.py
+    -n 0`` — cpu(0) vs tpu(0).  Run serially: the tunneled chip gives
+    silently-wrong answers under process sharing.
+
+Tolerance policy (the honest part): TPU f32 matmul/conv run at XLA's
+default precision (bf16 passes on the MXU), so MXU-backed ops compare at
+2e-2 on an accelerator while elementwise ops hold 1e-3; the bf16 lane
+casts inputs and compares against the f32 cpu ground truth at 6e-2.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import _invoke
+from mxnet_tpu.test_utils import assert_almost_equal
+
+import test_op_sweep as sweep
+
+RNG = np.random.RandomState(11)
+
+# The chip tier must be OPTED INTO, never auto-detected: the axon
+# platform plugin exposes the tunneled chip even under JAX_PLATFORMS=cpu,
+# and 4 xdist workers sharing that one chip produce silently-wrong
+# results.  MXTPU_CHIP_TESTS=1 (serial, -n 0) is the only chip path.
+CHIP_TIER = os.environ.get("MXTPU_CHIP_TESTS") == "1"
+
+
+def _second_ctx():
+    if CHIP_TIER:
+        import jax
+        if any(d.platform != "cpu" for d in jax.devices()):
+            return mx.tpu(0), True
+    return mx.cpu(1), False
+
+
+SECOND_CTX, ON_ACCEL = _second_ctx()
+
+# device-local RNG streams: values legitimately differ across backends;
+# these compare shape/dtype/finiteness and distribution moments instead
+_NONDETERMINISTIC = {
+    "_shuffle", "_sample_uniform", "_sample_normal", "_sample_gamma",
+    "_sample_exponential", "_sample_poisson", "_sample_multinomial",
+    "_sample_negative_binomial", "_sample_generalized_negative_binomial",
+    "_image_random_flip_left_right", "_image_random_flip_top_bottom",
+    "_image_random_brightness",
+    "_image_random_contrast", "_image_random_saturation",
+    "_image_random_hue", "_image_random_color_jitter",
+    "_image_random_lighting",
+}
+
+# ops whose FLOPs land on the MXU: f32 deviates at default precision
+_MXU_OPS = {
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "linalg_gemm", "linalg_gemm2", "linalg_trsm", "linalg_trmm",
+    "linalg_potrf", "linalg_potri", "linalg_gelqf", "linalg_syrk",
+    "khatri_rao", "RNN", "Correlation",
+}
+
+# per-dtype forward tolerance: accelerator pairs absorb the MXU's
+# default-precision bf16 operand rounding (8 mantissa bits => absolute
+# error ~1e-2 at unit operand scale — measured on v5e; the
+# precision-pinned test below proves this is the precision MODE, not an
+# op bug) and the chip's transcendental approximations; cpu pairs must
+# agree tightly.
+def _fwd_tol(name):
+    if ON_ACCEL:
+        if name in _MXU_OPS:
+            return dict(rtol=2e-2, atol=1e-2)
+        return dict(rtol=5e-3, atol=1e-4)
+    return dict(rtol=1e-3, atol=1e-5)
+
+
+def _grad_tol(name):
+    if ON_ACCEL:
+        if name in _MXU_OPS:
+            return dict(rtol=3e-2, atol=2e-2)
+        return dict(rtol=8e-3, atol=2e-4)
+    return dict(rtol=2e-3, atol=1e-5)
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _build(name, c, ctx):
+    """bind the case's symbol on ctx with grads where requested."""
+    variables = [mx.sym.Variable("in%d" % i) for i in range(len(c.inputs))]
+    sym = getattr(mx.sym, name)(*variables, **c.attrs)
+    args = {"in%d" % i: mx.nd.array(a, ctx=ctx)
+            for i, a in enumerate(c.inputs)}
+    if c.grad_nodes is not None:
+        gnodes = set(c.grad_nodes)
+    else:
+        gnodes = {"in%d" % i for i, a in enumerate(c.inputs)
+                  if np.issubdtype(np.asarray(a).dtype, np.floating)}
+    grad_req = {n: ("write" if n in gnodes else "null") for n in args}
+    args_grad = {n: mx.nd.zeros(np.asarray(c.inputs[int(n[2:])]).shape,
+                                ctx=ctx)
+                 for n in gnodes} if c.grad and gnodes else None
+    exe = sym.bind(ctx, args=args, args_grad=args_grad, grad_req=grad_req)
+    return sym, exe, sorted(gnodes)
+
+
+def _run_pair_case(name, c):
+    """Forward (+ backward when the case is differentiable) on both
+    contexts from identical inputs; compare everything."""
+    sym0, exe0, gnodes = _build(name, c, mx.cpu(0))
+    sym1, exe1, _ = _build(name, c, SECOND_CTX)
+
+    outs0 = [o.asnumpy() for o in _as_list(exe0.forward(is_train=c.train))]
+    outs1 = [o.asnumpy() for o in _as_list(exe1.forward(is_train=c.train))]
+    assert len(outs0) == len(outs1)
+    tol = _fwd_tol(name)
+    for a, b in zip(outs0, outs1):
+        if np.issubdtype(np.asarray(a).dtype, np.floating):
+            assert_almost_equal(b, a, names=("device", "cpu"), **tol)
+        else:
+            np.testing.assert_array_equal(b, a)
+
+    if not (c.grad and gnodes):
+        return
+    # identical head gradients on both devices, drawn from a PER-CASE
+    # seeded stream so the comparison (and its tolerance headroom) does
+    # not depend on which tests ran earlier in the process
+    import zlib
+    case_rng = np.random.RandomState(zlib.crc32(name.encode()))
+    heads = [case_rng.standard_normal(o.shape).astype(np.float32)
+             for o in outs0]
+    for exe, ctx in ((exe0, mx.cpu(0)), (exe1, SECOND_CTX)):
+        exe.forward(is_train=True)
+        exe.backward([mx.nd.array(h, ctx=ctx) for h in heads])
+    gtol = _grad_tol(name)
+    for n in gnodes:
+        g0 = exe0.grad_dict[n].asnumpy()
+        g1 = exe1.grad_dict[n].asnumpy()
+        assert_almost_equal(g1, g0, names=("device-grad", "cpu-grad"),
+                            **gtol)
+
+
+def _run_imperative_case(name, c):
+    def on(ctx):
+        nds = [mx.nd.array(a, ctx=ctx) for a in c.inputs]
+        return [o.asnumpy()
+                for o in _as_list(_invoke(name, nds, dict(c.attrs)))]
+
+    outs0, outs1 = on(mx.cpu(0)), on(SECOND_CTX)
+    tol = _fwd_tol(name)
+    for a, b in zip(outs0, outs1):
+        if np.issubdtype(np.asarray(a).dtype, np.floating):
+            assert_almost_equal(b, a, names=("device", "cpu"), **tol)
+        else:
+            np.testing.assert_array_equal(b, a)
+
+
+@pytest.mark.parametrize(
+    "name,idx",
+    [(n, i) for n in sorted(sweep.CASES) for i in range(len(sweep.CASES[n]))],
+    ids=lambda v: str(v))
+def test_cross_device_case(name, idx):
+    c = sweep.CASES[name][idx]
+    if not c.inputs:
+        pytest.skip("attrs-only op: nothing to place on a device")
+    if name in _NONDETERMINISTIC:
+        _run_stochastic_case(name, c)
+    elif c.mode == "imperative":
+        _run_imperative_case(name, c)
+    else:
+        _run_pair_case(name, c)
+
+
+def _run_stochastic_case(name, c):
+    """Different backends draw from different RNG streams; assert the
+    structural contract (shape/dtype/finite) and, for the samplers,
+    that both devices' draws share distribution moments."""
+    def on(ctx):
+        nds = [mx.nd.array(a, ctx=ctx) for a in c.inputs]
+        return [o.asnumpy()
+                for o in _as_list(_invoke(name, nds, dict(c.attrs)))]
+
+    outs0, outs1 = on(mx.cpu(0)), on(SECOND_CTX)
+    assert len(outs0) == len(outs1)
+    for a, b in zip(outs0, outs1):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        if np.issubdtype(a.dtype, np.floating):
+            assert np.isfinite(a).all() and np.isfinite(b).all()
+    if name == "_shuffle":
+        # a permutation: same multiset on both devices
+        np.testing.assert_allclose(np.sort(outs0[0], axis=None),
+                                   np.sort(outs1[0], axis=None))
+    elif name.startswith("_sample") and outs0[0].size >= 64:
+        m0, m1 = float(outs0[0].mean()), float(outs1[0].mean())
+        s = max(float(outs0[0].std()), 1e-3)
+        assert abs(m0 - m1) < 5 * s, (name, m0, m1, s)
+
+
+@pytest.mark.skipif(not ON_ACCEL, reason="chip tier only")
+@pytest.mark.parametrize("name", ["dot", "FullyConnected", "Convolution"])
+def test_mxu_deviation_is_precision_mode_not_bug(name):
+    """Pin matmul precision to 'highest' and the chip must match the cpu
+    at ELEMENTWISE tolerance — demonstrating the loose _MXU_OPS bars
+    above absorb the default bf16 operand pass, not a kernel defect."""
+    import jax
+    c = sweep.CASES[name][0]
+    with jax.default_matmul_precision("highest"):
+        def on(ctx):
+            nds = [mx.nd.array(a, ctx=ctx) for a in c.inputs]
+            return [o.asnumpy()
+                    for o in _as_list(_invoke(name, nds, dict(c.attrs)))]
+        outs0, outs1 = on(mx.cpu(0)), on(SECOND_CTX)
+    for a, b in zip(outs0, outs1):
+        assert_almost_equal(b, a, rtol=2e-3, atol=2e-4,
+                            names=("device@highest", "cpu"))
+
+
+# -- bf16 lane --------------------------------------------------------------
+# The framework's native TPU precision: inputs cast to bfloat16, outputs
+# compared against the f32 cpu ground truth.  Focused on the op families
+# a bf16 training step actually runs.
+_BF16_OPS = [
+    "Convolution", "FullyConnected", "dot", "batch_dot", "Activation",
+    "Pooling", "BatchNorm", "softmax", "relu", "sigmoid", "tanh",
+    "elemwise_add", "elemwise_mul", "broadcast_add", "broadcast_mul",
+    "sum", "mean", "exp", "sqrt",
+]
+
+
+@pytest.mark.parametrize("name", [n for n in _BF16_OPS
+                                  if n in sweep.CASES])
+def test_bf16_lane_matches_f32(name):
+    import jax.numpy as jnp
+    c = sweep.CASES[name][0]
+    if c.mode != "pair" or not c.inputs:
+        pytest.skip("bf16 lane needs a bindable pair-mode case")
+    # f32 cpu ground truth
+    _, exe0, _ = _build(name, c, mx.cpu(0))
+    outs0 = [o.asnumpy() for o in _as_list(exe0.forward(is_train=c.train))]
+    # bf16 on the second ctx
+    variables = [mx.sym.Variable("in%d" % i) for i in range(len(c.inputs))]
+    sym = getattr(mx.sym, name)(*variables, **c.attrs)
+    args = {"in%d" % i: mx.nd.array(a, ctx=SECOND_CTX).astype("bfloat16")
+            for i, a in enumerate(c.inputs)}
+    exe1 = sym.bind(SECOND_CTX, args=args, grad_req="null")
+    outs1 = _as_list(exe1.forward(is_train=c.train))
+    for a, b in zip(outs0, outs1):
+        bb = np.asarray(b.astype("float32").asnumpy())
+        if np.issubdtype(np.asarray(a).dtype, np.floating):
+            assert_almost_equal(bb, a, rtol=6e-2, atol=1e-2,
+                                names=("bf16-device", "f32-cpu"))

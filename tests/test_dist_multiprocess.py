@@ -56,6 +56,39 @@ out2 = mx.nd.zeros((2, 2))
 kv.pull("emb", out=out2)
 assert np.allclose(out2.asnumpy(), sum(range(size))), out2.asnumpy()
 
+# --- update_on_kvstore semantics (ref: kvstore_dist_server.h:187
+# ApplyUpdates): the optimizer runs ON the store against the reduced
+# gradient; result must match local mode applying the same optimizer to
+# the same summed gradient, including optimizer STATE across steps ---
+def mk_sgd():
+    return mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                            rescale_grad=1.0, wd=0.0)
+
+w0 = (np.arange(6, dtype=np.float32).reshape(2, 3) * 0.1)
+g_mine = np.full((2, 3), 0.5, np.float32) * (rank + 1)
+g_sum = sum(np.full((2, 3), 0.5, np.float32) * (r + 1)
+            for r in range(size))
+
+kv.set_optimizer(mk_sgd())
+kv.init("uw", mx.nd.array(w0))
+kv_local = mx.kv.create("local")
+kv_local.set_optimizer(mk_sgd())
+kv_local.init("uw", mx.nd.array(w0))
+
+dist_w = mx.nd.zeros((2, 3))
+local_w = mx.nd.zeros((2, 3))
+for step in range(3):  # 3 steps: momentum state must track exactly
+    kv.push("uw", mx.nd.array(g_mine))
+    kv.pull("uw", out=dist_w)
+    kv_local.push("uw", mx.nd.array(g_sum))
+    kv_local.pull("uw", out=local_w)
+    assert np.allclose(dist_w.asnumpy(), local_w.asnumpy(),
+                       rtol=1e-6, atol=1e-6), \
+        (rank, step, dist_w.asnumpy(), local_w.asnumpy())
+# the weights really moved (the optimizer ran, not a no-op)
+assert not np.allclose(dist_w.asnumpy(), w0)
+kv._updater = None  # later sections use plain-sum semantics
+
 # --- 2-bit gradient compression: packed codes are the wire payload ---
 before = kv.wire_bytes_pushed
 kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
