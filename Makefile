@@ -1,0 +1,40 @@
+# Canonical entry points (parity: the reference's make targets +
+# tools/pip_package).  Native C++ compiles lazily at import; `make
+# native` just forces it ahead of time.
+
+PY ?= python
+
+.PHONY: test fast chip bench wheel sdist native clean lint
+
+test:            ## full suite (~14 min, 4 xdist workers)
+	$(PY) -m pytest tests/ -q
+
+fast:            ## <5-minute iteration tier
+	$(PY) -m pytest tests/ -q -m fast
+
+chip:            ## serial accelerator tier (needs the real chip)
+	MXTPU_CHIP_TESTS=1 $(PY) -m pytest tests/test_consistency_sweep.py \
+		tests/test_consistency.py tests/test_convergence.py -q -n 0
+
+bench:           ## throughput numbers of record (run on an IDLE host)
+	$(PY) bench.py
+
+roofline:        ## kernel-class decomposition of the train step
+	$(PY) tools/roofline_probe.py
+
+e2e:             ## input-pipeline -> train composition benchmark
+	$(PY) tools/e2e_bench.py
+
+wheel:
+	$(PY) -m pip wheel . --no-build-isolation --no-deps -w dist/
+
+sdist:
+	$(PY) setup.py -q sdist
+
+native:          ## force-build the lazy C++ libraries now
+	$(PY) -c "from mxnet_tpu import io_native as n; \
+	          print(n.get_lib()); print(n.get_capi_lib())"
+
+clean:
+	rm -rf build dist *.egg-info mxnet_tpu/_native \
+	       mxnet_tpu/io_native/*.so

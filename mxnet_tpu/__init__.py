@@ -52,6 +52,7 @@ from . import model
 from .model import FeedForward
 from . import gluon
 from . import recordio
+from . import filesystem
 from . import profiler
 from . import engine
 from . import test_utils

@@ -619,9 +619,10 @@ class ImageIter(DataIter):
         return _imdecode_np(s)
 
     def read_image(self, fname):
+        from ..filesystem import open_uri
         path = os.path.join(self.path_root, fname) if self.path_root \
             else fname
-        with open(path, "rb") as fin:
+        with open_uri(path, "rb") as fin:
             return fin.read()
 
     def augmentation_transform(self, data):

@@ -85,6 +85,7 @@ class PipelineModule(BaseModule):
         self._loss = None
         self._mom = None
         self._n_micro = self._n_micro_arg
+        self._batch_sharding_cache = None
         self.optimizer_initialized = False
         self.params_initialized = False
 
@@ -151,7 +152,6 @@ class PipelineModule(BaseModule):
             raise MXNetError("batch %d not divisible by n_micro %d"
                              % (batch, self._n_micro))
 
-        data_name = self._data_names[0]
         known = {d.name: tuple(d.shape) for d in self._data_shapes}
 
         # stem: data -> x
@@ -182,6 +182,22 @@ class PipelineModule(BaseModule):
                   **{l.name: tuple(l.shape) for l in self._label_shapes})
         head_known = {k: v for k, v in hk.items()
                       if k in self._head.list_arguments()}
+        head_args = self._head.list_arguments()
+        if not self._label_shapes and self._label_names \
+                and self._label_names[0] in head_args:
+            # label-less bind (predict-style) but the head graph still
+            # takes the label input (SoftmaxOutput always does): infer
+            # its shape from x and synthesize zero labels at feed time
+            # — SoftmaxOutput's forward ignores label values
+            p_args, _, _ = self._head.infer_shape_partial(**head_known)
+            shp = dict(zip(head_args, p_args)).get(self._label_names[0])
+            if not shp or any(int(d) == 0 for d in shp):
+                raise MXNetError(
+                    "cannot infer the %r shape from the head graph for a "
+                    "label-less bind; pass label_shapes"
+                    % self._label_names[0])
+            self._label_shapes = [DataDesc(self._label_names[0],
+                                           tuple(int(d) for d in shp))]
         self._head_prog = _Program(self._head)
         self._head_prog.finalize_shapes(head_known)
         _, head_outs, _ = self._head.infer_shape(**head_known)
@@ -199,9 +215,6 @@ class PipelineModule(BaseModule):
         if preserved is not None:
             self.init_params(initializer=None, arg_params=preserved,
                              force_init=True)
-
-    def _prog_param_names(self, prog, sym, inputs):
-        return [n for n in sym.list_arguments() if n not in inputs]
 
     # -- parameters ----------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -411,11 +424,17 @@ class PipelineModule(BaseModule):
         self.optimizer_initialized = True
 
     def _batch_shardings(self):
+        # cached per bind: this sits in the per-batch hot path
+        cached = getattr(self, "_batch_sharding_cache", None)
+        if cached is not None:
+            return cached
         from jax.sharding import NamedSharding, PartitionSpec as P
-        return {
+        out = {
             d.name: NamedSharding(
                 self.mesh, P(*(("dp",) + (None,) * (len(d.shape) - 1))))
             for d in self._data_shapes + self._label_shapes}
+        self._batch_sharding_cache = out
+        return out
 
     def _build_eval(self):
         """The eval-mode program; optimizer-independent, built lazily so

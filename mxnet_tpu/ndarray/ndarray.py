@@ -672,33 +672,45 @@ def save(fname, data):
     else:
         names = [""] * len(data)
         arrays = list(data)
+    from ..filesystem import is_remote, open_uri
+    if is_remote(fname):
+        # remote stream: the backend owns atomicity (object stores
+        # publish on close); no tmp+rename dance
+        with open_uri(fname, "wb") as f:
+            _save_stream(f, names, arrays)
+        return
     # atomic: write to temp + rename so a crash mid-save never leaves a
     # truncated .params file for elastic resume to trip over
     tmp = fname + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(_NDAR_MAGIC)
-        f.write(struct.pack("<q", len(arrays)))
-        for name, nd in zip(names, arrays):
-            nb = name.encode()
-            f.write(struct.pack("<q", len(nb)))
-            f.write(nb)
-            npa = nd.asnumpy() if isinstance(nd, NDArray) else np.asarray(nd)
-            dt = dtype_name(npa.dtype).encode()
-            if npa.dtype == jnp.bfloat16:
-                npa = npa.astype(np.float32)
-                dt = b"bfloat16"
-            f.write(struct.pack("<q", len(dt)))
-            f.write(dt)
-            f.write(struct.pack("<q", npa.ndim))
-            f.write(struct.pack("<%dq" % npa.ndim, *npa.shape))
-            buf = npa.tobytes()
-            f.write(struct.pack("<q", len(buf)))
-            f.write(buf)
+        _save_stream(f, names, arrays)
     os.replace(tmp, fname)
 
 
+def _save_stream(f, names, arrays):
+    f.write(_NDAR_MAGIC)
+    f.write(struct.pack("<q", len(arrays)))
+    for name, nd in zip(names, arrays):
+        nb = name.encode()
+        f.write(struct.pack("<q", len(nb)))
+        f.write(nb)
+        npa = nd.asnumpy() if isinstance(nd, NDArray) else np.asarray(nd)
+        dt = dtype_name(npa.dtype).encode()
+        if npa.dtype == jnp.bfloat16:
+            npa = npa.astype(np.float32)
+            dt = b"bfloat16"
+        f.write(struct.pack("<q", len(dt)))
+        f.write(dt)
+        f.write(struct.pack("<q", npa.ndim))
+        f.write(struct.pack("<%dq" % npa.ndim, *npa.shape))
+        buf = npa.tobytes()
+        f.write(struct.pack("<q", len(buf)))
+        f.write(buf)
+
+
 def load(fname):
-    with open(fname, "rb") as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "rb") as f:
         return _load_stream(f, fname)
 
 

@@ -27,6 +27,19 @@ def pShape(v):
     return shape_attr(v)
 
 
+def pShapeN(v):
+    """Shape tuple whose ELEMENTS may be None (slice begin/end/step:
+    'None' means from-start/to-end/step-1 per axis, ref slice_op-inl.h)."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        from ..base import str_to_attr
+        v = str_to_attr(v)
+    if isinstance(v, int):
+        return (v,)
+    return tuple(None if e is None else int(e) for e in v)
+
+
 def pInt(v):
     if isinstance(v, str):
         v = str_to_attr(v)

@@ -15,7 +15,7 @@ import numpy as np
 from jax import lax
 
 from ..base import np_dtype, MXNetError
-from .registry import register, pShape, pInt, pFloat, pBool, pStr, pDtype, pAny
+from .registry import register, pShape, pShapeN, pInt, pFloat, pBool, pStr, pDtype, pAny
 
 # ---------------------------------------------------------------------------
 # Elementwise binary (same-shape) + broadcast variants
@@ -34,8 +34,15 @@ _LOGIC = {
 }
 
 
-def _mk_binary(fn, logic=False):
+def _mk_binary(fn, logic=False, elemwise=False):
     def impl(lhs, rhs):
+        if elemwise and lhs.shape != rhs.shape:
+            # the reference's elemwise_* ops REQUIRE equal shapes
+            # (elemwise_binary_op.h); broadcasting is the broadcast_*
+            # family's explicit job
+            raise MXNetError(
+                "elemwise op needs equal shapes, got %s and %s — use the "
+                "broadcast_* variant" % (lhs.shape, rhs.shape))
         out = fn(lhs, rhs)
         if logic:
             out = out.astype(lhs.dtype)
@@ -44,7 +51,7 @@ def _mk_binary(fn, logic=False):
 
 
 for _n, _f in _BINARY.items():
-    register("elemwise_%s" % _n, _mk_binary(_f), num_inputs=2,
+    register("elemwise_%s" % _n, _mk_binary(_f, elemwise=True), num_inputs=2,
              aliases=("_%s" % _n, "_Plus" if _n == "add" else "_%s_" % _n))
 for _n, _f in _BINARY.items():
     register("broadcast_%s" % _n, _mk_binary(_f), num_inputs=2,
@@ -185,8 +192,27 @@ register("max", _mk_reduce(jnp.max), num_inputs=1, params=_REDUCE_PARAMS,
          aliases=("max_axis",))
 register("min", _mk_reduce(jnp.min), num_inputs=1, params=_REDUCE_PARAMS,
          aliases=("min_axis",))
-register("norm", lambda x: jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,)),
-         num_inputs=1)
+def _norm(x, ord=2, axis=None, keepdims=False):
+    """L1/L2 norm (ref: broadcast_reduce_op_value.cc norm — ord 1 or 2,
+    whole-array default returns shape (1,) like the reference)."""
+    ord = int(ord)
+    if ord not in (1, 2):
+        raise ValueError("norm only supports ord=1 or ord=2, got %d" % ord)
+    whole = axis is None or axis == ()
+    ax = None if whole else _norm_axis(axis, x.ndim)
+    if ord == 1:
+        out = jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(keepdims))
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax,
+                               keepdims=bool(keepdims)))
+    if whole and not keepdims:
+        out = out.reshape((1,))
+    return out
+
+
+register("norm", _norm, num_inputs=1,
+         params={"ord": (pInt, 2), "axis": (pShape, None),
+                 "keepdims": (pBool, False)})
 
 
 def _argminmax(fn):
@@ -343,7 +369,8 @@ def _slice(x, begin=None, end=None, step=None):
 
 
 register("slice", _slice, num_inputs=1, aliases=("crop",),
-         params={"begin": (pShape, None), "end": (pShape, None), "step": (pShape, None)})
+         params={"begin": (pShapeN, None), "end": (pShapeN, None),
+                 "step": (pShapeN, None)})
 
 
 def _slice_axis(x, axis=0, begin=0, end=None):
@@ -409,7 +436,25 @@ register("one_hot", _one_hot, num_inputs=1,
          params={"depth": (pInt, 1), "on_value": (pFloat, 1.0),
                  "off_value": (pFloat, 0.0), "dtype": (pDtype, "float32")})
 
-register("where", lambda cond, x, y: jnp.where(cond.astype(bool), x, y), num_inputs=3)
+def _where(cond, x, y):
+    """Same-shape elementwise select, OR a 1-D condition choosing whole
+    rows along axis 0 (ref: control_flow_op.h WhereOpForward — the
+    vector form selects x[i] vs y[i] per batch element; any other 1-D
+    length is an ERROR, never a silent broadcast)."""
+    if cond.ndim == 1 and x.ndim > 1:
+        if cond.shape[0] != x.shape[0]:
+            raise MXNetError(
+                "where: 1-D condition of length %d must match "
+                "x.shape[0]=%d" % (cond.shape[0], x.shape[0]))
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    elif cond.shape != x.shape:
+        raise MXNetError(
+            "where: condition shape %s must equal x shape %s (or be a "
+            "length-%d vector)" % (cond.shape, x.shape, x.shape[0]))
+    return jnp.where(cond.astype(bool), x, y)
+
+
+register("where", _where, num_inputs=3)
 register("tile", lambda x, reps=(1,): jnp.tile(x, reps), num_inputs=1,
          params={"reps": (pShape, (1,))})
 

@@ -76,6 +76,65 @@ def dump_profile():
             json.dump(payload, f)
 
 
+def aggregate_stats(_events_snapshot=None):
+    """Per-name aggregate statistics over the recorded spans:
+    name -> dict(count, total_ms, min_ms, max_ms, avg_ms), per category
+    (ref: AggregateStats — MXAggregateProfileStatsPrint's table)."""
+    if _events_snapshot is not None:
+        events = _events_snapshot
+    else:
+        with _lock:
+            events = list(_events)
+    open_ts = {}
+    stats = {}
+    for e in events:
+        key = (e["cat"], e["name"], e["tid"], e["pid"])
+        if e["ph"] == "B":
+            open_ts[key] = e["ts"]
+        elif e["ph"] == "E" and key in open_ts:
+            dur_ms = (e["ts"] - open_ts.pop(key)) / 1e3
+            s = stats.setdefault((e["cat"], e["name"]), {
+                "count": 0, "total_ms": 0.0, "min_ms": float("inf"),
+                "max_ms": 0.0})
+            s["count"] += 1
+            s["total_ms"] += dur_ms
+            s["min_ms"] = min(s["min_ms"], dur_ms)
+            s["max_ms"] = max(s["max_ms"], dur_ms)
+    out = {}
+    for (cat, name), s in stats.items():
+        out.setdefault(cat, {})[name] = dict(
+            s, avg_ms=s["total_ms"] / s["count"])
+    return out
+
+
+def dumps(reset=False, sort_by="total_ms"):
+    """Aggregate-statistics table as text (ref: profiler.dumps /
+    MXAggregateProfileStatsPrint).  reset=True atomically swaps the
+    event buffer out, so spans recorded concurrently land in the NEXT
+    window instead of being silently dropped."""
+    if reset:
+        with _lock:
+            snapshot = list(_events)
+            _events.clear()
+        agg = aggregate_stats(snapshot)
+    else:
+        agg = aggregate_stats()
+    lines = []
+    for cat in sorted(agg):
+        lines.append("%s" % cat)
+        lines.append("%-40s %8s %12s %12s %12s %12s"
+                     % ("Name", "Calls", "Total(ms)", "Min(ms)",
+                        "Max(ms)", "Avg(ms)"))
+        rows = sorted(agg[cat].items(),
+                      key=lambda kv: -kv[1].get(sort_by, 0.0))
+        for name, s in rows:
+            lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f"
+                         % (name[:40], s["count"], s["total_ms"],
+                            s["min_ms"], s["max_ms"], s["avg_ms"]))
+        lines.append("")
+    return "\n".join(lines)
+
+
 def start_jax_trace(logdir="/tmp/mxnet_tpu_trace"):
     import jax
     jax.profiler.start_trace(logdir)

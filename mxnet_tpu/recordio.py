@@ -46,12 +46,16 @@ class MXRecordIO:
         self.open()
 
     def open(self):
-        native = _native_lib()
+        from .filesystem import is_remote, open_uri
+        # remote URIs (s3://, hdfs://, ... via filesystem.register_scheme)
+        # stream through the python path — the native reader mmaps local
+        # files
+        native = None if is_remote(self.uri) else _native_lib()
         if self.flag == "w":
             if native is not None:
                 self._native = native.NativeRecordWriter(self.uri)
             else:
-                self.handle = open(self.uri, "wb")
+                self.handle = open_uri(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
             if native is not None:
@@ -59,7 +63,7 @@ class MXRecordIO:
                 self._native = native.NativeRecordReader(self.uri,
                                                          prefetch=False)
             else:
-                self.handle = open(self.uri, "rb")
+                self.handle = open_uri(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -133,18 +137,20 @@ class MXIndexedRecordIO(MXRecordIO):
         super().__init__(uri, flag)
 
     def open(self):
+        from .filesystem import is_remote, open_uri
         super().open()
         self.idx = {}
         self.keys = []
-        if self.flag == "r" and os.path.isfile(self.idx_path):
-            self.fidx = open(self.idx_path, "r")
+        if self.flag == "r" and (is_remote(self.idx_path)
+                                 or os.path.isfile(self.idx_path)):
+            self.fidx = open_uri(self.idx_path, "r")
             for line in iter(self.fidx.readline, ""):
                 line = line.strip().split("\t")
                 key = self.key_type(line[0])
                 self.idx[key] = int(line[1])
                 self.keys.append(key)
         elif self.flag == "w":
-            self.fidx = open(self.idx_path, "w")
+            self.fidx = open_uri(self.idx_path, "w")
 
     def close(self):
         if not self.is_open:

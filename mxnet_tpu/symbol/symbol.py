@@ -573,6 +573,11 @@ class Symbol:
                           indent=2)
 
     def save(self, fname):
+        from ..filesystem import is_remote, open_uri
+        if is_remote(fname):
+            with open_uri(fname, "w") as f:
+                f.write(self.tojson())
+            return
         # write-to-temp + rename: a crash mid-save must never leave a
         # truncated file where a checkpoint is expected (elastic resume
         # picks the newest file by name)
@@ -705,7 +710,8 @@ def load_json(json_str):
 
 
 def load(fname):
-    with open(fname) as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "r") as f:
         return load_json(f.read())
 
 

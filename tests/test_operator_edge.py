@@ -1,0 +1,274 @@
+"""Edge-of-contract operator semantics.
+
+The registry sweep (test_op_sweep) proves every op EXISTS and matches
+its own symbol path; this module pins the mxnet-SPECIFIC corners a
+port actually trips over — the reference encodes these in
+tests/python/unittest/test_operator.py and the op headers cited below.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+pytestmark = pytest.mark.fast
+
+RNG = np.random.RandomState(3)
+
+
+def _x(*shape):
+    return mx.nd.array(RNG.standard_normal(shape).astype(np.float32))
+
+
+# -- reshape special codes (matrix_op-inl.h InferReshapeShape) --------------
+
+@pytest.mark.parametrize("in_shape,spec,want", [
+    ((2, 3, 4), (-1,), (24,)),
+    ((2, 3, 4), (0, -1), (2, 12)),
+    ((2, 3, 4), (-2,), (2, 3, 4)),
+    ((2, 3, 4), (0, 0, 4), (2, 3, 4)),
+    ((2, 3, 4), (-3, 4), (6, 4)),
+    ((2, 3, 4), (-3, -2), (6, 4)),
+    ((2, 3, 4), (0, -3), (2, 12)),
+    ((2, 3, 4), (-4, 1, 2, -2), (1, 2, 3, 4)),
+    ((2, 3, 4), (-4, -1, 2, -2), (1, 2, 3, 4)),
+    ((2, 3, 4), (0, -4, -1, 3, 0), (2, 1, 3, 4)),
+    ((8, 6), (-4, 2, 4, -1), (2, 4, 6)),
+])
+def test_reshape_special_codes(in_shape, spec, want):
+    x = mx.nd.array(np.arange(int(np.prod(in_shape)), dtype=np.float32)
+                    .reshape(in_shape))
+    out = mx.nd.reshape(x, shape=spec)
+    assert out.shape == want
+    np.testing.assert_array_equal(out.asnumpy().ravel(),
+                                  x.asnumpy().ravel())
+
+
+# -- reductions: exclude / negative / multi-axis ----------------------------
+
+def test_reduce_exclude_and_negative_axes():
+    x = _x(2, 3, 4)
+    np.testing.assert_allclose(
+        mx.nd.sum(x, axis=1, exclude=True).asnumpy(),
+        x.asnumpy().sum(axis=(0, 2)), rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.sum(x, axis=-1).asnumpy(), x.asnumpy().sum(axis=2),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.mean(x, axis=(0, 2), keepdims=True).asnumpy(),
+        x.asnumpy().mean(axis=(0, 2), keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.max(x, axis=(-2, -1)).asnumpy(),
+        x.asnumpy().max(axis=(1, 2)), rtol=1e-6)
+
+
+def test_norm_ord_and_axes():
+    x = _x(2, 3, 4)
+    # whole-array default keeps the reference's shape-(1,) contract
+    assert mx.nd.norm(x).shape == (1,)
+    np.testing.assert_allclose(
+        mx.nd.norm(x).asnumpy()[0],
+        np.linalg.norm(x.asnumpy().ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.norm(x, ord=1, axis=1).asnumpy(),
+        np.abs(x.asnumpy()).sum(axis=1), rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.norm(x, ord=2, axis=-1, keepdims=True).asnumpy(),
+        np.sqrt((x.asnumpy() ** 2).sum(axis=2, keepdims=True)), rtol=1e-5)
+
+
+# -- slice family (slice_op-inl.h) ------------------------------------------
+
+def test_slice_none_entries_and_negative_step():
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    out = mx.nd.slice(x, begin=(None, 2, None), end=(None, 0, None),
+                      step=(None, -1, None))
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, 2:0:-1, :])
+    out2 = mx.nd.slice(x, begin=(0, None), end=(1, None))
+    np.testing.assert_array_equal(out2.asnumpy(), x.asnumpy()[0:1])
+
+
+def test_slice_axis_negative_axis_and_take_modes():
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_array_equal(
+        mx.nd.slice_axis(x, axis=-1, begin=1, end=3).asnumpy(),
+        x.asnumpy()[..., 1:3])
+    # take: clip pins out-of-range, wrap wraps (indexing_op.h)
+    np.testing.assert_array_equal(
+        mx.nd.take(x, mx.nd.array([5.0]), axis=0, mode="clip").asnumpy(),
+        x.asnumpy()[[1]])
+    np.testing.assert_array_equal(
+        mx.nd.take(x, mx.nd.array([-1.0]), axis=0, mode="wrap").asnumpy(),
+        x.asnumpy()[[1]])
+
+
+def test_pick_negative_axis():
+    x = _x(2, 3)
+    idx = mx.nd.array(np.array([0, 2], np.float32))
+    np.testing.assert_allclose(
+        mx.nd.pick(x, idx, axis=-1).asnumpy(),
+        x.asnumpy()[np.arange(2), [0, 2]], rtol=1e-6)
+
+
+# -- where: vector-condition row select (control_flow_op.h) ------------------
+
+def test_where_vector_condition_selects_rows():
+    xv, yv = _x(3, 4), _x(3, 4)
+    cond = mx.nd.array(np.array([1, 0, 1], np.float32))
+    out = mx.nd.where(cond, xv, yv).asnumpy()
+    np.testing.assert_array_equal(out[0], xv.asnumpy()[0])
+    np.testing.assert_array_equal(out[1], yv.asnumpy()[1])
+    np.testing.assert_array_equal(out[2], xv.asnumpy()[2])
+
+
+# -- broadcasting contracts --------------------------------------------------
+
+def test_broadcast_ops_degenerate_dims():
+    a = _x(2, 1, 4)
+    b = _x(1, 3, 1)
+    np.testing.assert_allclose(
+        mx.nd.broadcast_add(a, b).asnumpy(), a.asnumpy() + b.asnumpy(),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.broadcast_axis(mx.nd.ones((1, 3, 1)), axis=(0, 2),
+                             size=(2, 4)).asnumpy(),
+        np.ones((2, 3, 4)), rtol=1e-6)
+
+
+def test_elemwise_requires_same_shape():
+    with pytest.raises(Exception):
+        (mx.nd.elemwise_add(_x(2, 3), _x(2, 1))).asnumpy()
+
+
+# -- train/eval semantics -----------------------------------------------------
+
+def test_dropout_eval_identity_train_scales():
+    from mxnet_tpu import autograd
+    x = mx.nd.ones((64, 64))
+    # eval: identity
+    np.testing.assert_allclose(mx.nd.Dropout(x, p=0.5).asnumpy(),
+                               x.asnumpy())
+    # train: inverted dropout — survivors scaled by 1/(1-p), mean ~1
+    with autograd.record(train_mode=True):
+        out = mx.nd.Dropout(x, p=0.5)
+    o = out.asnumpy()
+    kept = o[o != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+    assert 0.3 < (o == 0).mean() < 0.7
+
+
+def test_batchnorm_eval_uses_moving_stats():
+    x = _x(8, 3, 5, 5)
+    gamma, beta = mx.nd.ones((3,)), mx.nd.zeros((3,))
+    mean = mx.nd.array(np.array([0.5, -0.5, 0.0], np.float32))
+    var = mx.nd.array(np.array([4.0, 1.0, 0.25], np.float32))
+    out = mx.nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False,
+                          eps=1e-5)
+    want = (x.asnumpy() - mean.asnumpy().reshape(1, 3, 1, 1)) / \
+        np.sqrt(var.asnumpy().reshape(1, 3, 1, 1) + 1e-5)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+# -- ordering ops -------------------------------------------------------------
+
+def test_topk_ret_typ_and_argsort_descending():
+    x = mx.nd.array(np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]],
+                             np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.topk(x, k=2, ret_typ="value").asnumpy(),
+        np.array([[3.0, 2.0], [5.0, 4.0]], np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.argsort(x, is_ascend=False).asnumpy(),
+        np.array([[0, 2, 1], [1, 2, 0]], np.float32))
+
+
+# -- gluon losses vs closed forms --------------------------------------------
+
+def test_gluon_losses_match_formulas():
+    from mxnet_tpu import gluon
+    p = _x(4, 5)
+    q = _x(4, 5)
+    np.testing.assert_allclose(
+        gluon.loss.L2Loss()(p, q).asnumpy(),
+        ((p.asnumpy() - q.asnumpy()) ** 2).mean(axis=1) / 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        gluon.loss.L1Loss()(p, q).asnumpy(),
+        np.abs(p.asnumpy() - q.asnumpy()).mean(axis=1), rtol=1e-5)
+    # Huber: quadratic inside rho, linear outside
+    h = gluon.loss.HuberLoss(rho=1.0)(p, q).asnumpy()
+    d = np.abs(p.asnumpy() - q.asnumpy())
+    want = np.where(d <= 1.0, 0.5 * d * d, d - 0.5).mean(axis=1)
+    np.testing.assert_allclose(h, want, rtol=1e-5)
+
+
+# -- optimizer oracles beyond sgd/adam/rmsprop --------------------------------
+
+def _one_update(name, w0, g, **kw):
+    opt = mx.optimizer.create(name, learning_rate=0.1, rescale_grad=1.0,
+                              wd=0.0, **kw)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0.copy())
+    upd(0, mx.nd.array(g.copy()), w)
+    return w.asnumpy(), upd
+
+
+def test_adagrad_matches_numpy():
+    w0 = RNG.rand(5).astype(np.float32)
+    g = RNG.rand(5).astype(np.float32)
+    got, upd = _one_update("adagrad", w0, g, eps=1e-7)
+    hist = g * g
+    np.testing.assert_allclose(
+        got, w0 - 0.1 * g / (np.sqrt(hist) + 1e-7), rtol=1e-5)
+    # second step accumulates history
+    w2 = mx.nd.array(got.copy())
+    upd(0, mx.nd.array(g.copy()), w2)
+    hist += g * g
+    np.testing.assert_allclose(
+        w2.asnumpy(), got - 0.1 * g / (np.sqrt(hist) + 1e-7), rtol=1e-5)
+
+
+def test_signum_matches_numpy():
+    w0 = RNG.rand(5).astype(np.float32)
+    g = RNG.standard_normal(5).astype(np.float32)
+    got, _ = _one_update("signum", w0, g, momentum=0.9)
+    # first step: m = -lr * sign(g) with momentum buffer starting at 0
+    np.testing.assert_allclose(got, w0 - 0.1 * np.sign(0.1 * g),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_nag_matches_numpy():
+    w0 = RNG.rand(5).astype(np.float32)
+    g = RNG.standard_normal(5).astype(np.float32)
+    got, _ = _one_update("nag", w0, g, momentum=0.9)
+    # nesterov first step from zero momentum: w -= lr*(g + mom*g)
+    mom = 0.9 * (0.1 * g)
+    np.testing.assert_allclose(got, w0 - (mom + 0.1 * g), rtol=1e-4,
+                               atol=1e-6)
+
+
+# -- profiler aggregate stats (AggregateStats parity) ------------------------
+
+def test_profiler_aggregate_stats_table():
+    from mxnet_tpu import profiler
+    profiler.profiler_set_config(mode="all", filename="/tmp/prof_edge.json")
+    profiler.profiler_set_state("run")
+    x = mx.nd.ones((64, 64))
+    for _ in range(3):
+        (x + x).wait_to_read()
+        mx.nd.dot(x, x).wait_to_read()
+    agg = profiler.aggregate_stats()
+    flat = {n: s for cat in agg.values() for n, s in cat.items()}
+    assert any("dot" in n for n in flat), flat.keys()
+    some = next(iter(flat.values()))
+    assert some["count"] >= 1 and some["total_ms"] >= some["max_ms"] > 0
+    table = profiler.dumps(reset=True)
+    assert "Calls" in table and "Avg(ms)" in table and "dot" in table
+    profiler.profiler_set_state("stop")
+    assert profiler.aggregate_stats() == {}
+
+
+def test_where_mismatched_vector_condition_raises():
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.where(mx.nd.array([1.0] * 4), _x(3, 4), _x(3, 4)).asnumpy()
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.where(mx.nd.ones((2, 2)), _x(3, 4), _x(3, 4)).asnumpy()

@@ -220,3 +220,19 @@ def test_labelless_forward_and_odd_batch_divisor():
     out = pm.get_outputs()[0].asnumpy()
     assert out.shape == (6, CLASSES)
     assert np.allclose(out.sum(1), 1.0, atol=1e-4)
+
+
+def test_labelless_bind_predict_flow():
+    """bind WITHOUT label_shapes (the predict workflow): the head's
+    label shape is inferred from the graph and zero-filled at feed."""
+    rng = np.random.RandomState(8)
+    mesh = _mesh(dp=2, pp=2)
+    pm = _pipeline_module(mesh)
+    pm.bind(data_shapes=[("data", (8, D))], for_training=False)
+    pm.init_params(mx.initializer.Xavier())
+    from mxnet_tpu.io import DataBatch
+    X = rng.standard_normal((8, D)).astype(np.float32)
+    pm.forward(DataBatch([mx.nd.array(X)], None))
+    out = pm.get_outputs()[0].asnumpy()
+    assert out.shape == (8, CLASSES)
+    assert np.allclose(out.sum(1), 1.0, atol=1e-4)
