@@ -53,6 +53,8 @@ from .model import FeedForward
 from . import gluon
 from . import recordio
 from . import filesystem
+from . import log
+from . import misc
 from . import profiler
 from . import engine
 from . import test_utils

@@ -146,3 +146,53 @@ def test_kvstore_server_roles(monkeypatch):
     kvstore_server._init_kvstore_server_module()
     srv = kvstore_server.KVStoreServer()
     assert srv._controller(0, "") is None
+
+
+def test_log_and_misc_compat_modules():
+    """Legacy mx.log / mx.misc namespace parity (python/mxnet/log.py,
+    misc.py)."""
+    import io, logging, warnings
+    import mxnet_tpu as mx
+    logger = mx.log.getLogger("nsparity_test", level=mx.log.INFO)
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    h.setFormatter(mx.log.GlogFormatter(colored=False))
+    logger.addHandler(h)
+    logger.info("msg %d", 7)
+    assert "msg 7" in buf.getvalue() and buf.getvalue().startswith("I")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sched = mx.misc.FactorScheduler(step=10, factor=0.5)
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    sched.base_lr = 1.0
+    assert abs(sched(25) - 0.25) < 1e-9
+
+
+def test_log_idempotent_and_exception_traceback():
+    import io, logging
+    import mxnet_tpu as mx
+    logger = mx.log.getLogger("nsparity_idem", level=mx.log.INFO)
+    n_before = len(logger.handlers)
+    mx.log.getLogger("nsparity_idem")  # second call must not stack
+    assert len(logger.handlers) == n_before
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    h.setFormatter(mx.log.GlogFormatter(colored=False))
+    logger.addHandler(h)
+    try:
+        raise ValueError("boom-trace")
+    except ValueError:
+        logger.exception("step failed")
+    out = buf.getvalue()
+    assert "step failed" in out and "boom-trace" in out \
+        and "Traceback" in out
+    # misc.FactorScheduler is a real class: isinstance + subclass work
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = mx.misc.FactorScheduler(step=5)
+        assert isinstance(s, mx.misc.FactorScheduler)
+
+        class Mine(mx.misc.FactorScheduler):
+            pass
+        assert isinstance(Mine(step=2), mx.misc.FactorScheduler)
