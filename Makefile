@@ -3,18 +3,25 @@
 # native` just forces it ahead of time.
 
 PY ?= python
+# 4 xdist workers when pytest-xdist is installed (~12 min full suite vs
+# ~35 serial); empty otherwise so bare environments still run
+XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && printf -- "-n 4")
 
 .PHONY: test fast chip bench wheel sdist native clean lint
 
-test:            ## full suite (~14 min, 4 xdist workers)
-	$(PY) -m pytest tests/ -q
+test: lint       ## full suite (~14 min with 4 xdist workers)
+	$(PY) -m pytest tests/ -q $(XDIST)
 
-fast:            ## <5-minute iteration tier
-	$(PY) -m pytest tests/ -q -m fast
+fast: lint       ## <5-minute iteration tier
+	$(PY) -m pytest tests/ -q -m fast $(XDIST)
+
+lint:            ## graftlint + verifier: fail on NEW findings only
+	$(PY) tools/graftcheck.py mxnet_tpu --baseline .graftlint-baseline.json
 
 chip:            ## serial accelerator tier (needs the real chip)
 	MXTPU_CHIP_TESTS=1 $(PY) -m pytest tests/test_consistency_sweep.py \
-		tests/test_consistency.py tests/test_convergence.py -q -n 0
+		tests/test_consistency.py tests/test_convergence.py -q \
+		--numprocesses 0
 
 bench:           ## throughput numbers of record (run on an IDLE host)
 	$(PY) bench.py

@@ -1,0 +1,36 @@
+"""Static analysis for the TPU port: graftlint + the Symbol-graph verifier.
+
+The reference MXNet spends a whole layer on static graph checking before
+execution (nnvm passes: Gradient, PlaceDevice, PlanMemory, plus shape/type
+validation at bind).  This package is that layer's TPU-native analog, split
+in two:
+
+* **graftlint** (`lint_core`, `lint_rules`, `baseline`) — an AST linter
+  (stdlib `ast` only) whose rules encode the JAX/TPU failure modes this
+  codebase actually hits: silent device→host syncs in hot paths, Python
+  control flow on traced values, `np.`/`jnp.` mixing inside kernels,
+  dead-code port vestiges, mutable default args in registry signatures and
+  bare excepts near the engine.  Findings diff against a checked-in
+  baseline so CI fails only on *new* problems.
+
+* **graph_verify** — a bind-time Symbol verifier in the nnvm pass idiom:
+  cycles, name collisions, dead nodes, incomplete shape/dtype inference
+  and a PlanMemory-lite byte estimate.  Exposed as `Symbol.validate()` and
+  run automatically inside `Executor` under `MXNET_TPU_VERIFY_GRAPH=1`.
+
+`tools/graftcheck.py` drives both from the command line; `make lint` runs
+it over the package against `.graftlint-baseline.json`.
+"""
+from .lint_core import (Finding, LintContext, Rule, RULES, lint_source,
+                        lint_file, lint_paths, iter_py_files)
+from . import lint_rules  # noqa: F401  (imports register the rule set)
+from .baseline import (load_baseline, save_baseline, finding_counts,
+                       new_findings)
+from .graph_verify import GraphIssue, GraphReport, verify_graph, verify_json
+
+__all__ = [
+    "Finding", "LintContext", "Rule", "RULES",
+    "lint_source", "lint_file", "lint_paths", "iter_py_files",
+    "load_baseline", "save_baseline", "finding_counts", "new_findings",
+    "GraphIssue", "GraphReport", "verify_graph", "verify_json",
+]

@@ -1,0 +1,59 @@
+"""graftlint baseline: gate CI on *new* findings only.
+
+The baseline file maps finding keys (relpath::rule::stripped-source-line)
+to occurrence counts.  A run fails when any key's live count exceeds its
+baselined count — so pre-existing debt is visible but non-blocking, fixed
+findings shrink naturally (counts above live usage are harmless), and any
+freshly introduced hazard trips the gate.  Same ratchet idea as
+mypy/ruff baselines.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+BASELINE_VERSION = 1
+
+
+def finding_counts(findings):
+    """Counter over baseline keys for a list of findings."""
+    return Counter(f.key() for f in findings)
+
+
+def load_baseline(path):
+    """Load {key: count}; a missing file is an empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError("unsupported baseline version in %s" % path)
+    return {k: int(v) for k, v in data.get("counts", {}).items()}
+
+
+def save_baseline(path, findings):
+    counts = finding_counts(findings)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION,
+                   "counts": {k: counts[k] for k in sorted(counts)}},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_findings(findings, baseline_counts):
+    """Findings beyond the baselined count for their key, in input order.
+
+    For a key baselined at N with M > N live occurrences, the M - N
+    later occurrences are reported (the earlier ones are assumed to be
+    the pre-existing ones).
+    """
+    budget = dict(baseline_counts)
+    out = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out

@@ -1,0 +1,361 @@
+"""Symbol-graph verifier — the nnvm validation passes, TPU-native.
+
+The reference walks every graph through nnvm passes (Gradient,
+PlaceDevice, PlanMemory) that implicitly validate it: a cycle, a name
+collision or an unplannable node fails loudly before execution.  Here the
+whole graph lowers to one jitted XLA program, so nothing between Symbol
+composition and jax.jit ever *looks* at the graph — a malformed Symbol
+(hand-edited JSON, a buggy composition helper, a collision between
+auto-created weights) surfaces as an opaque trace error deep inside XLA.
+
+`verify_graph` closes that gap: structural checks (cycles, duplicate
+names, unknown ops, dead nodes) plus, when input shapes are supplied,
+an inference-completeness check and a PlanMemory-lite byte estimate
+(sum of inferred output buffers — the number the reference's PlanMemory
+pass would hand the allocator).
+
+Entry points: `Symbol.validate()`, `verify_json()` for saved graphs, the
+`tools/graftcheck.py --symbol` CLI, and `Executor` bind under
+`MXNET_TPU_VERIFY_GRAPH=1`.
+
+All framework imports are lazy so `mxnet_tpu.analysis` stays importable
+(for pure linting) in environments where jax is not initialized.
+"""
+from __future__ import annotations
+
+import json
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+class GraphIssue:
+    __slots__ = ("check", "severity", "message", "node_name")
+
+    def __init__(self, check, severity, message, node_name=None):
+        self.check = check
+        self.severity = severity
+        self.message = message
+        self.node_name = node_name
+
+    def to_dict(self):
+        return {"check": self.check, "severity": self.severity,
+                "message": self.message, "node": self.node_name}
+
+    def __repr__(self):
+        return "[%s] %s: %s" % (self.severity, self.check, self.message)
+
+
+class GraphReport:
+    def __init__(self, issues, num_nodes, num_ops, num_vars, memory=None):
+        self.issues = issues
+        self.num_nodes = num_nodes
+        self.num_ops = num_ops
+        self.num_vars = num_vars
+        self.memory = memory  # PlanMemory-lite estimate, or None
+
+    @property
+    def errors(self):
+        return [i for i in self.issues if i.severity == SEV_ERROR]
+
+    @property
+    def warnings(self):
+        return [i for i in self.issues if i.severity == SEV_WARNING]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def to_dict(self):
+        return {"ok": self.ok, "num_nodes": self.num_nodes,
+                "num_ops": self.num_ops, "num_vars": self.num_vars,
+                "memory": self.memory,
+                "issues": [i.to_dict() for i in self.issues]}
+
+    def format(self):
+        lines = ["graph: %d nodes (%d ops, %d variables) — %s"
+                 % (self.num_nodes, self.num_ops, self.num_vars,
+                    "OK" if self.ok else "INVALID")]
+        for i in self.issues:
+            lines.append("  %r" % i)
+        if self.memory is not None:
+            lines.append("  memory plan: %.2f MiB total (%.2f param, "
+                         "%.2f activation)"
+                         % (self.memory["total_bytes"] / 2**20,
+                            self.memory["param_bytes"] / 2**20,
+                            self.memory["activation_bytes"] / 2**20))
+            for name, nbytes in self.memory["largest"]:
+                lines.append("    top: %-40s %10.2f KiB"
+                             % (name, nbytes / 1024.0))
+        return "\n".join(lines)
+
+
+def _reachable(entries):
+    """Nodes reachable from the output entries (cycle-safe walk)."""
+    seen, stack = set(), [n for n, _ in entries]
+    order = []
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        order.append(node)
+        stack.extend(n for n, _ in node.inputs)
+    return order
+
+
+def _find_cycle(entries):
+    """Iterative 3-color DFS; returns a node on a cycle, or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {}
+    for root, _ in entries:
+        if color.get(id(root), WHITE) != WHITE:
+            continue
+        stack = [(root, iter([n for n, _ in root.inputs]))]
+        color[id(root)] = GRAY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for child in it:
+                c = color.get(id(child), WHITE)
+                if c == GRAY:
+                    return child
+                if c == WHITE:
+                    color[id(child)] = GRAY
+                    stack.append(
+                        (child, iter([n for n, _ in child.inputs])))
+                    advanced = True
+                    break
+            if not advanced:
+                color[id(node)] = BLACK
+                stack.pop()
+    return None
+
+
+def _safe_num_outputs(node):
+    try:
+        return node.num_outputs()
+    except Exception:
+        return 1
+
+
+def verify_graph(symbol, shapes=None, dtypes=None, universe=None):
+    """Run all verifier checks over `symbol`.
+
+    shapes: optional {arg_name: shape} — enables the inference
+        completeness check and the memory estimate.
+    universe: optional full node list (e.g. from a deserialized JSON
+        graph); nodes in it but unreachable from the outputs are
+        reported as dead.  Defaults to the reachable set, in which case
+        the dead-node check is vacuous.
+    """
+    from ..ops.registry import get_op
+
+    issues = []
+    entries = symbol._entries
+
+    # 1. cycles — everything else assumes a DAG
+    cyc = _find_cycle(entries)
+    if cyc is not None:
+        issues.append(GraphIssue(
+            "cycle", SEV_ERROR,
+            "graph contains a cycle through node %r — not a DAG; "
+            "evaluation would never terminate" % cyc.name, cyc.name))
+    reachable = _reachable(entries)
+    num_vars = sum(1 for n in reachable if n.is_var)
+    num_ops = len(reachable) - num_vars
+
+    # 2. unknown operators (hand-edited JSON, version skew)
+    for node in reachable:
+        if node.is_var:
+            continue
+        try:
+            get_op(node.op_name)
+        except Exception:
+            issues.append(GraphIssue(
+                "unknown-op", SEV_ERROR,
+                "node %r uses unregistered operator %r"
+                % (node.name, node.op_name), node.name))
+
+    # 3. name collisions: distinct nodes sharing a name.  Two variables
+    # with one name is an error — bind maps args by name, so one of the
+    # two silently shadows the other.  Op-node collisions only corrupt
+    # output naming: warning.
+    by_name = {}
+    for node in reachable:
+        by_name.setdefault(node.name, []).append(node)
+    for name, nodes in sorted(by_name.items()):
+        if len(nodes) < 2:
+            continue
+        n_var = sum(1 for n in nodes if n.is_var)
+        sev = SEV_ERROR if n_var >= 2 else SEV_WARNING
+        issues.append(GraphIssue(
+            "name-collision", sev,
+            "%d distinct nodes named %r (%d variables) — bind resolves "
+            "arguments by name" % (len(nodes), name, n_var), name))
+
+    # 4. dead nodes (unreachable from any output)
+    if universe is not None:
+        live = {id(n) for n in reachable}
+        for node in universe:
+            if id(node) not in live:
+                issues.append(GraphIssue(
+                    "dead-node", SEV_WARNING,
+                    "node %r (%s) is unreachable from every output"
+                    % (node.name, node.op_name or "variable"), node.name))
+
+    # 5.+6. inference completeness and PlanMemory-lite (needs shapes, an
+    # acyclic graph, and every op resolvable — _infer calls get_op
+    # unguarded, so an unknown-op graph must stop at its diagnosis
+    # instead of crashing inside inference)
+    memory = None
+    structural_errs = any(i.severity == SEV_ERROR for i in issues)
+    if shapes is not None and not structural_errs:
+        try:
+            memory = _check_inference(symbol, reachable, shapes, dtypes,
+                                      issues)
+        except Exception as e:  # pathological graph: report, don't crash
+            issues.append(GraphIssue(
+                "inference-failed", SEV_ERROR,
+                "shape/dtype inference raised %s: %s"
+                % (type(e).__name__, e)))
+
+    return GraphReport(issues, len(reachable), num_ops, num_vars, memory)
+
+
+def _check_inference(symbol, reachable, shapes, dtypes, issues):
+    import numpy as np
+    from ..base import np_dtype
+
+    known_shapes = {k: tuple(v) for k, v in dict(shapes).items()}
+    known_dtypes = {k: np_dtype(v) for k, v in dict(dtypes or {}).items()}
+    # unspecified variable dtypes default to float32 at bind
+    # (simple_bind's `dt or np.float32`), so judge inference under the
+    # same premise — remaining dtype gaps are then real propagation holes
+    for node in reachable:
+        if node.is_var and node.name not in known_dtypes \
+                and "__dtype__" not in node.attrs:
+            known_dtypes[node.name] = np.float32
+    inf_shapes, inf_dtypes = symbol._infer(known_shapes, known_dtypes)
+
+    def complete(s):
+        return s is not None and all(int(d) != 0 for d in s)
+
+    incomplete = []
+    for node in reachable:
+        n_out = 1 if node.is_var else _safe_num_outputs(node)
+        for i in range(n_out):
+            if not complete(inf_shapes.get((node, i))):
+                incomplete.append((node, i))
+    for node, i in incomplete[:8]:
+        issues.append(GraphIssue(
+            "incomplete-inference", SEV_ERROR,
+            "shape of %s output %d could not be fully inferred from the "
+            "given argument shapes (got %s)"
+            % (node.name, i, inf_shapes.get((node, i)),), node.name))
+    if len(incomplete) > 8:
+        issues.append(GraphIssue(
+            "incomplete-inference", SEV_ERROR,
+            "... and %d more entries with incomplete shapes"
+            % (len(incomplete) - 8)))
+
+    # dtype gaps are a softer signal: the executor defaults missing
+    # dtypes to float32, so report the gap without failing validation
+    n_missing_dt = sum(
+        1 for node in reachable
+        for i in range(1 if node.is_var else _safe_num_outputs(node))
+        if inf_dtypes.get((node, i)) is None)
+    if n_missing_dt:
+        issues.append(GraphIssue(
+            "incomplete-inference", SEV_WARNING,
+            "%d graph entries have no inferred dtype (executor will "
+            "default them to float32)" % n_missing_dt))
+
+    # PlanMemory-lite: bytes of every output buffer the executor would
+    # materialize — the figure the reference's PlanMemory hands the
+    # allocator (upper bound here: XLA's liveness reuse only shrinks it)
+    param_bytes = activation_bytes = 0
+    per_entry = []
+    for node in reachable:
+        n_out = 1 if node.is_var else _safe_num_outputs(node)
+        for i in range(n_out):
+            s = inf_shapes.get((node, i))
+            if not complete(s):
+                continue
+            dt = inf_dtypes.get((node, i)) or np.float32
+            nbytes = int(np.prod([int(d) for d in s], dtype=np.int64)
+                         * np.dtype(dt).itemsize)
+            per_entry.append((node.name, nbytes))
+            if node.is_var:
+                param_bytes += nbytes
+            else:
+                activation_bytes += nbytes
+    per_entry.sort(key=lambda kv: (-kv[1], kv[0]))
+    return {"total_bytes": param_bytes + activation_bytes,
+            "param_bytes": param_bytes,
+            "activation_bytes": activation_bytes,
+            "largest": per_entry[:5],
+            "skipped_entries": len(incomplete)}
+
+
+def verify_json(json_str, shapes=None, dtypes=None):
+    """Verify a saved graph JSON (tolerant parse, full-universe checks).
+
+    Unlike `symbol.load_json`, keeps every node in the "nodes" array as
+    the universe — so nodes a hand edit orphaned are reported dead
+    instead of silently dropped.
+    """
+    from ..symbol.symbol import Symbol, _Node
+
+    data = json.loads(json_str)
+    issues = []
+    built = []
+    for idx, meta in enumerate(data.get("nodes", [])):
+        attrs = meta.get("attrs", meta.get("param", {})) or {}
+        if meta.get("op", "null") == "null":
+            built.append(_Node(None, meta.get("name", "node%d" % idx),
+                               attrs))
+            continue
+        inputs = []
+        for ref in meta.get("inputs", []):
+            try:
+                nid, out_idx = int(ref[0]), int(ref[1])
+            except (TypeError, ValueError, IndexError, KeyError):
+                issues.append(GraphIssue(
+                    "bad-input-ref", SEV_ERROR,
+                    "node %r has malformed input ref %r (want "
+                    "[node_id, output_idx, ...])"
+                    % (meta.get("name"), ref), meta.get("name")))
+                continue
+            if not 0 <= nid < len(built):
+                issues.append(GraphIssue(
+                    "bad-input-ref", SEV_ERROR,
+                    "node %r input refers to node id %d (only %d nodes "
+                    "precede it)" % (meta.get("name"), nid, len(built)),
+                    meta.get("name")))
+                continue
+            inputs.append((built[nid], out_idx))
+        built.append(_Node(meta["op"], meta.get("name", "node%d" % idx),
+                           attrs, inputs))
+    heads = data.get("heads") or [[len(built) - 1, 0, 0]]
+    entries = []
+    for h in heads:
+        try:
+            nid, idx = int(h[0]), int(h[1])
+        except (TypeError, ValueError, IndexError, KeyError):
+            issues.append(GraphIssue(
+                "bad-head-ref", SEV_ERROR,
+                "malformed heads entry %r (want [node_id, output_idx, "
+                "...])" % (h,)))
+            continue
+        if 0 <= nid < len(built):
+            entries.append((built[nid], idx))
+        else:
+            issues.append(GraphIssue(
+                "bad-head-ref", SEV_ERROR,
+                "heads entry refers to node id %d but the graph has only "
+                "%d nodes" % (nid, len(built))))
+    report = verify_graph(Symbol(entries), shapes=shapes, dtypes=dtypes,
+                          universe=built)
+    report.issues[:0] = issues
+    return report

@@ -1,0 +1,267 @@
+"""graftlint core: rule registry, suppression handling, file walking.
+
+Pure stdlib (`ast`, `os`, `re`) — the linter must run in any environment
+the package installs into, including the wheel-smoke venv that has no dev
+dependencies.  Rules live in `lint_rules.py`; this module provides the
+machinery they plug into.
+
+Suppression syntax (mirrors pylint's, scoped to this tool):
+
+    x.asnumpy()  # graftlint: disable=GL001
+    # graftlint: disable-file=GL003   (anywhere in the file, whole file)
+
+A finding's identity for baseline purposes is (relpath, rule, stripped
+source line) — stable across unrelated edits that only shift line numbers.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#.*?graftlint:\s*disable=([A-Z0-9, ]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#.*?graftlint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+class Finding:
+    """One lint hit: where, which rule, and how to fix it."""
+
+    __slots__ = ("rule", "severity", "path", "line", "col", "message",
+                 "hint", "snippet")
+
+    def __init__(self, rule, severity, path, line, col, message, hint,
+                 snippet):
+        self.rule = rule
+        self.severity = severity
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.hint = hint
+        self.snippet = snippet
+
+    def key(self):
+        """Baseline identity: survives pure line-number drift."""
+        return "%s::%s::%s" % (self.path, self.rule, self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "hint": self.hint,
+                "snippet": self.snippet}
+
+    def __repr__(self):
+        return "%s:%d: %s [%s] %s" % (self.path, self.line, self.severity,
+                                      self.rule, self.message)
+
+
+class LintContext:
+    """Parsed file + suppression tables, handed to every rule."""
+
+    def __init__(self, src, path):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self._line_suppress = {}
+        self._file_suppress = set()
+        self._comment_lines = set()
+        # markers live in real COMMENT tokens only — the same text inside
+        # a string literal or docstring must NOT disable anything
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(src).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            lineno = tok.start[0]
+            self._comment_lines.add(lineno)
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                self._line_suppress.setdefault(lineno, set()).update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+            m = _SUPPRESS_FILE_RE.search(tok.string)
+            if m:
+                self._file_suppress.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+
+    def suppressed(self, line, rule_id):
+        """A finding is suppressed by a marker on its own line, or by a
+        pure-comment line (block) directly above it — the natural place
+        to write the justification the hint asks for."""
+        if rule_id in self._file_suppress:
+            return True
+        while line >= 1:
+            if rule_id in self._line_suppress.get(line, ()):
+                return True
+            # climb only over PURE comment lines, as judged by the
+            # tokenizer: a '#'-leading line inside a string literal is
+            # not in _comment_lines and must not be climbed through
+            prev = line - 1
+            if prev >= 1 and prev in self._comment_lines \
+                    and self.lines[prev - 1].lstrip().startswith("#"):
+                line = prev
+                continue
+            return False
+        return False
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # -- shared AST helpers used by several rules ---------------------------
+    def functions(self):
+        """Every function/method definition in the file."""
+        return [n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    @staticmethod
+    def dotted(node):
+        """`jax.jit` -> "jax.jit"; returns None for non-name expressions."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    @classmethod
+    def is_jitted(cls, fn):
+        """True when `fn` carries any recognized jit decoration — even one
+        whose static_argnums can't be resolved (hotness doesn't depend on
+        which args are static)."""
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = cls.dotted(target)
+            if name in ("jax.jit", "jit"):
+                return True
+            if name in ("functools.partial", "partial") \
+                    and isinstance(dec, ast.Call) and dec.args \
+                    and cls.dotted(dec.args[0]) in ("jax.jit", "jit"):
+                return True
+        return False
+
+    @classmethod
+    def jit_static_argnums(cls, fn):
+        """If `fn` is jit-decorated, return the set of static positional
+        indices (empty set when none are declared); None when not jitted
+        OR when a static_argnums spec exists but is not a literal (we
+        then cannot tell traced from static, so rules must not guess).
+
+        Recognizes `@jax.jit`, `@jit`, and
+        `@functools.partial(jax.jit, static_argnums=(...))`.
+        """
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = cls.dotted(target)
+            if name in ("jax.jit", "jit"):
+                if isinstance(dec, ast.Call):
+                    return cls._static_argnums_of(dec)
+                return set()
+            if name in ("functools.partial", "partial") \
+                    and isinstance(dec, ast.Call) and dec.args:
+                inner = cls.dotted(dec.args[0])
+                if inner in ("jax.jit", "jit"):
+                    return cls._static_argnums_of(dec)
+        return None
+
+    @staticmethod
+    def _static_argnums_of(call):
+        statics = set()
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                try:
+                    val = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    return None  # non-literal spec: can't reason, opt out
+                if isinstance(val, (int, str)):
+                    val = (val,)
+                statics.update(val)  # argnums AND argnames both apply
+        return statics
+
+
+class Rule:
+    """Base class: subclass, set the class attrs, implement check()."""
+
+    id = None
+    severity = SEV_WARNING
+    title = ""
+    hint = ""
+
+    def check(self, ctx):
+        """Yield (lineno, col, message) triples."""
+        raise NotImplementedError
+
+    def run(self, ctx):
+        for lineno, col, message in self.check(ctx):
+            if ctx.suppressed(lineno, self.id):
+                continue
+            yield Finding(self.id, self.severity, ctx.path, lineno, col,
+                          message, self.hint, ctx.line_text(lineno))
+
+
+RULES = {}
+
+
+def register(rule_cls):
+    """Class decorator adding a rule instance to the global registry."""
+    inst = rule_cls()
+    assert inst.id and inst.id not in RULES, rule_cls
+    RULES[inst.id] = inst
+    return rule_cls
+
+
+def lint_source(src, path="<string>", rules=None):
+    """Lint one source string; returns findings sorted by position."""
+    try:
+        ctx = LintContext(src, path)
+    except SyntaxError as e:
+        return [Finding("GL000", SEV_ERROR, path, e.lineno or 0, 0,
+                        "syntax error: %s" % e.msg, "fix the parse error",
+                        "")]
+    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    findings = []
+    for rule in selected:
+        findings.extend(rule.run(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path, root=None, rules=None):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    return lint_source(src, rel.replace(os.sep, "/"), rules=rules)
+
+
+def iter_py_files(paths):
+    """Expand files/dirs into .py files, skipping caches and build dirs."""
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git", "build",
+                                          "dist", ".graft"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def lint_paths(paths, root=None, rules=None):
+    findings = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, root=root, rules=rules))
+    return findings

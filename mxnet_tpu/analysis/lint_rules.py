@@ -1,0 +1,286 @@
+"""The graftlint rule set — six rules tuned to this codebase's TPU port.
+
+Each rule encodes a failure mode that has actually bitten (or nearly
+bitten) this repo: host syncs hiding in hot paths erase XLA's async
+dispatch win, Python branches on traced values blow up under jit, `np.`
+calls inside kernels silently fall back to host compute, `if False`
+vestiges survive porting, mutable defaults leak state across op
+registrations, and bare excepts near the engine swallow real errors.
+"""
+from __future__ import annotations
+
+import ast
+
+from .lint_core import LintContext, Rule, SEV_ERROR, SEV_WARNING, register
+
+# function names that are hot paths by contract: per-batch code where a
+# blocking device->host transfer stalls XLA's async pipeline
+HOT_NAMES = frozenset({
+    "forward", "backward", "forward_backward", "hybrid_forward",
+})
+
+# device->host sync spellings on NDArray / jax.Array values
+_SYNC_METHODS = frozenset({"asnumpy", "item", "tolist"})
+_NUMPY_MODULES = frozenset({"np", "numpy", "onp"})
+
+
+def _is_sync_call(node):
+    """True for `x.asnumpy()` / `x.item()` / `np.asarray(x)` shapes."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _SYNC_METHODS:
+            return True
+        if fn.attr == "asarray" and isinstance(fn.value, ast.Name) \
+                and fn.value.id in _NUMPY_MODULES:
+            return True
+    return False
+
+
+def _contains_sync_call(node):
+    return any(_is_sync_call(n) for n in ast.walk(node))
+
+
+def _own_nodes(fn):
+    """Walk `fn` excluding the subtrees of nested function defs — each
+    def gets judged on its own body only."""
+    nested = set()
+    for inner in ast.walk(fn):
+        if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and inner is not fn:
+            nested.update(id(n) for n in ast.walk(inner))
+    return [n for n in ast.walk(fn) if id(n) not in nested]
+
+
+@register
+class HostSyncInHotPath(Rule):
+    """GL001: device->host sync inside forward/backward or a jitted fn."""
+
+    id = "GL001"
+    severity = SEV_WARNING
+    title = "host-sync-in-hot-path"
+    hint = ("hoist the transfer out of the per-batch path (sync once after "
+            "the loop), or keep the value on device with jnp; if the sync "
+            "is deliberate, suppress with a comment saying why")
+
+    def check(self, ctx):
+        for fn in ctx.functions():
+            hot = fn.name in HOT_NAMES or ctx.is_jitted(fn)
+            if not hot:
+                continue
+            # syncs already reported as part of a float()/int() wrapper
+            # must not be re-reported on their own (one hazard, one key)
+            consumed = set()
+            # nested defs get their own hot/cold decision (_own_nodes)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in ("float", "int", "bool") \
+                        and node.args and _contains_sync_call(node.args[0]):
+                    consumed.update(id(n) for n in ast.walk(node.args[0])
+                                    if _is_sync_call(n))
+                    yield (node.lineno, node.col_offset,
+                           "`%s(...)` over a host sync inside hot path "
+                           "`%s`" % (node.func.id, fn.name))
+                elif _is_sync_call(node) and id(node) not in consumed:
+                    desc = ast.unparse(node.func) if hasattr(ast, "unparse") \
+                        else "sync call"
+                    yield (node.lineno, node.col_offset,
+                           "device->host sync `%s(...)` inside hot path "
+                           "`%s`" % (desc, fn.name))
+
+
+@register
+class TracedControlFlow(Rule):
+    """GL002: Python `if`/`while` on a traced argument of a jitted fn."""
+
+    id = "GL002"
+    severity = SEV_ERROR
+    title = "python-branch-on-traced-value"
+    hint = ("branching on a tracer raises ConcretizationTypeError at trace "
+            "time (or silently specializes); use jnp.where / lax.cond, or "
+            "declare the argument static via static_argnums")
+
+    def check(self, ctx):
+        for fn in ctx.functions():
+            statics = ctx.jit_static_argnums(fn)
+            if statics is None:
+                continue
+            params = [a.arg for a in
+                      fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs]
+            traced = {p for i, p in enumerate(params)
+                      if i not in statics and p not in statics
+                      and p != "self"}
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                # `arg is None` / `is not None` is static at trace time
+                # (the standard optional-argument idiom), not a branch on
+                # traced VALUES — exempt those comparisons
+                exempt = set()
+                for cmp_node in ast.walk(node.test):
+                    if isinstance(cmp_node, ast.Compare) \
+                            and all(isinstance(op, (ast.Is, ast.IsNot))
+                                    for op in cmp_node.ops) \
+                            and all(isinstance(c, ast.Constant)
+                                    and c.value is None
+                                    for c in cmp_node.comparators):
+                        exempt.update(id(n) for n in ast.walk(cmp_node))
+                used = {n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name) and id(n) not in exempt}
+                hits = sorted(used & traced)
+                if hits:
+                    yield (node.lineno, node.col_offset,
+                           "Python `%s` on traced value(s) %s inside jitted "
+                           "`%s`" % ("if" if isinstance(node, ast.If)
+                                     else "while", ", ".join(hits), fn.name))
+
+
+# numpy calls that *produce or transform arrays* — inside a function that
+# also uses jnp, these run on host and break the trace.  Scalar/dtype
+# helpers (np.float32, np.prod over a shape tuple, np.dtype) are fine and
+# are not in this set.
+_NP_ARRAY_FUNCS = frozenset({
+    "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+    "linspace", "concatenate", "stack", "where", "sum", "mean", "exp",
+    "log", "sqrt", "abs", "clip", "maximum", "minimum", "dot", "matmul",
+    "transpose", "reshape", "pad", "split", "tile", "repeat", "einsum",
+    "cumsum", "argmax", "argmin", "sort", "argsort", "take", "squeeze",
+    "expand_dims", "broadcast_to",
+})
+
+
+@register
+class NumpyInKernel(Rule):
+    """GL003: `np.` array math inside a function that traces with jnp."""
+
+    id = "GL003"
+    severity = SEV_WARNING
+    title = "np-jnp-mixing-in-kernel"
+    hint = ("use jnp.* so the computation stays in the traced XLA program; "
+            "np.* materializes on host and blocks fusion (np on static "
+            "shapes/attrs is fine — suppress if that is the case)")
+
+    def check(self, ctx):
+        # each function is judged on its OWN body (_own_nodes): a nested
+        # jit kernel must not make its host-side enclosing function count
+        # as tracing, and each np call belongs to exactly one function so
+        # the baseline ratchet can never double-count a source line
+        for fn in ctx.functions():
+            own = _own_nodes(fn)
+            uses_jnp = any(isinstance(n, ast.Name) and n.id == "jnp"
+                           for n in own)
+            if not uses_jnp:
+                continue
+            for node in own:
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in _NUMPY_MODULES
+                        and node.func.attr in _NP_ARRAY_FUNCS):
+                    continue
+                yield (node.lineno, node.col_offset,
+                       "host-numpy `%s.%s(...)` inside jnp-tracing `%s`"
+                       % (node.func.value.id, node.func.attr, fn.name))
+
+
+def _const_truth(node):
+    """Constant truthiness of an expression, or None if not constant."""
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (bool, int)):
+        return bool(node.value)
+    return None
+
+
+@register
+class DeadCode(Rule):
+    """GL004: `if False` vestiges and statements after return/raise."""
+
+    id = "GL004"
+    severity = SEV_ERROR
+    title = "dead-code-vestige"
+    hint = ("delete the dead branch — constant-test code is a port "
+            "vestige, and unreachable statements confuse every future "
+            "reader")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.If, ast.While)):
+                truth = _const_truth(node.test)
+                if truth is False:
+                    yield (node.lineno, node.col_offset,
+                           "`%s False:` — body can never run"
+                           % ("if" if isinstance(node, ast.If) else "while"))
+                elif truth is True and isinstance(node, ast.If) \
+                        and node.orelse:
+                    yield (node.orelse[0].lineno, node.orelse[0].col_offset,
+                           "`else` of `if True:` can never run")
+            elif isinstance(node, ast.IfExp):
+                truth = _const_truth(node.test)
+                if truth is not None:
+                    dead = node.body if truth is False else node.orelse
+                    yield (node.lineno, node.col_offset,
+                           "conditional expression with constant test — the "
+                           "`%s` arm is dead"
+                           % ("if" if truth is False else "else"))
+            # unreachable statements after a terminating statement
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(node, field, None)
+                if not isinstance(block, list):
+                    continue
+                for prev, stmt in zip(block, block[1:]):
+                    if isinstance(prev, (ast.Return, ast.Raise, ast.Break,
+                                         ast.Continue)):
+                        yield (stmt.lineno, stmt.col_offset,
+                               "unreachable statement after `%s`"
+                               % type(prev).__name__.lower())
+                        break  # one report per block is enough
+
+
+@register
+class MutableDefaultArg(Rule):
+    """GL005: mutable default argument (shared across all calls)."""
+
+    id = "GL005"
+    severity = SEV_WARNING
+    title = "mutable-default-arg"
+    hint = ("default to None and create the container in the body; a "
+            "mutable default is one object shared by every call — in op "
+            "registration signatures it leaks state between ops")
+
+    _MUT_CALLS = frozenset({"list", "dict", "set", "defaultdict",
+                            "OrderedDict", "Counter"})
+
+    def check(self, ctx):
+        for fn in ctx.functions():
+            for default in fn.args.defaults + fn.args.kw_defaults:
+                if default is None:
+                    continue
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set)) \
+                    or (isinstance(default, ast.Call)
+                        and isinstance(default.func, ast.Name)
+                        and default.func.id in self._MUT_CALLS)
+                if bad:
+                    yield (default.lineno, default.col_offset,
+                           "mutable default argument in `%s`" % fn.name)
+
+
+@register
+class BareExcept(Rule):
+    """GL006: bare `except:` — swallows KeyboardInterrupt/SystemExit."""
+
+    id = "GL006"
+    severity = SEV_WARNING
+    title = "bare-except"
+    hint = ("catch Exception (or the specific error) instead; a bare "
+            "except around engine-adjacent code hides real failures and "
+            "eats Ctrl-C")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield (node.lineno, node.col_offset, "bare `except:`")

@@ -13,6 +13,7 @@ match between forward and backward.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -126,6 +127,19 @@ class Executor:
     def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
         self._symbol = symbol
         self._ctx = ctx
+        if os.environ.get("MXNET_TPU_VERIFY_GRAPH") == "1":
+            # opt-in bind-time verifier (nnvm validation-pass analog):
+            # structural checks only — a malformed graph fails here, with
+            # a named node, BEFORE _Program's own get_op walk can throw a
+            # nameless registry error.  Shape completeness is the
+            # executor's own job (finalize_shapes / jit tracing), so it
+            # is not re-judged here.
+            from .analysis.graph_verify import verify_graph
+            report = verify_graph(symbol)
+            if not report.ok:
+                raise MXNetError(
+                    "MXNET_TPU_VERIFY_GRAPH: refusing to bind an invalid "
+                    "graph:\n%s" % report.format())
         self._prog = _Program(symbol)
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict
@@ -205,7 +219,13 @@ class Executor:
             if k not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % k)
             dst = self.arg_dict[k]
-            src = v._h.array if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
+            if isinstance(v, NDArray):
+                src = v._h.array
+            else:
+                # graftlint: disable=GL001,GL003 — host->device UPLOAD
+                # of user-fed python/numpy forward(**kwargs) data, not a
+                # device sync or traced math
+                src = jnp.asarray(np.asarray(v))
             if src.dtype != dst._h.array.dtype:
                 src = src.astype(dst._h.array.dtype)
             # keep group2ctx placement

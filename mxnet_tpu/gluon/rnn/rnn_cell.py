@@ -63,8 +63,7 @@ class RecurrentCell(HybridBlock):
             batch_size = seq[0].shape[0]
         else:
             batch_size = inputs.shape[batch_axis]
-            seq = [x.reshape(x.shape[1:]) if False else x
-                   for x in _split_time(inputs, length, axis)]
+            seq = list(_split_time(inputs, length, axis))
         if begin_state is None:
             begin_state = self.begin_state(batch_size)
         states = begin_state

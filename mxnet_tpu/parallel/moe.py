@@ -14,7 +14,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5 ships shard_map under experimental
+    from jax.experimental.shard_map import shard_map
 
 
 def _moe_local(x, gate_w, w1, w2, axis_name, capacity_factor):

@@ -143,11 +143,9 @@ def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
             if len(inputs.list_outputs()) != 1:
                 raise ValueError("unroll doesn't allow grouped symbol as "
                                  "input.")
-            inputs = [sym.squeeze(o, axis=in_axis) for o in sym.SliceChannel(
-                inputs, axis=in_axis, num_outputs=length, squeeze_axis=0)] \
-                if False else list(sym.SliceChannel(
-                    inputs, axis=in_axis, num_outputs=length,
-                    squeeze_axis=True))
+            inputs = list(sym.SliceChannel(inputs, axis=in_axis,
+                                           num_outputs=length,
+                                           squeeze_axis=True))
     else:
         assert length is None or len(inputs) == length
         if merge is True:

@@ -606,6 +606,28 @@ class Symbol:
     def tocsr(self):
         raise MXNetError("not supported")
 
+    # -- verification --------------------------------------------------------
+    def validate(self, shapes=None, dtypes=None, raise_on_error=True,
+                 **shape_kwargs):
+        """Statically verify this graph (nnvm validation-pass analog).
+
+        Structural checks always run: cycles, name collisions, unknown
+        ops.  Passing input shapes (as a dict or `data=(1, 3, 224, 224)`
+        kwargs) additionally checks that shape/dtype inference completes
+        and attaches a PlanMemory-lite memory estimate to the report.
+
+        Returns the `GraphReport`; raises MXNetError on error-severity
+        issues unless ``raise_on_error=False``.
+        """
+        from ..analysis.graph_verify import verify_graph
+        known = dict(shapes or {})
+        known.update({k: tuple(v) for k, v in shape_kwargs.items()
+                      if v is not None})
+        report = verify_graph(self, shapes=known or None, dtypes=dtypes)
+        if raise_on_error and not report.ok:
+            raise MXNetError("invalid symbol graph:\n%s" % report.format())
+        return report
+
 
 def _find_var(order, name):
     for n in order:

@@ -50,8 +50,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
-                 node_attrs={}, hide_weights=True):
+                 node_attrs=None, hide_weights=True):
     """Graphviz plot; returns a graphviz.Digraph if graphviz is available."""
+    node_attrs = dict(node_attrs or {})
     try:
         from graphviz import Digraph
     except ImportError:

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""graftcheck — drive graftlint + the Symbol-graph verifier from the CLI.
+
+Usage (from the repo root, so baseline keys stay relative):
+
+    python tools/graftcheck.py mxnet_tpu                      # lint a tree
+    python tools/graftcheck.py mxnet_tpu --baseline .graftlint-baseline.json
+    python tools/graftcheck.py --update-baseline mxnet_tpu    # ratchet down
+    python tools/graftcheck.py --symbol model-symbol.json \
+        --shape data=1,3,224,224                              # verify graph
+    python tools/graftcheck.py mxnet_tpu --json               # machine output
+
+Exit status: 0 when there are no NEW lint findings (relative to the
+baseline, if given) and every --symbol graph validates; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.analysis import (RULES, lint_paths, load_baseline,
+                                save_baseline, new_findings, verify_json)
+
+
+def parse_shape_args(pairs):
+    shapes = {}
+    for pair in pairs or ():
+        name, _, dims = pair.partition("=")
+        if not dims:
+            raise SystemExit("--shape wants name=d0,d1,...: got %r" % pair)
+        shapes[name] = tuple(int(d) for d in dims.split(","))
+    return shapes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="graftcheck", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--baseline", help="baseline JSON; only findings "
+                    "beyond it fail the run (defaults to "
+                    ".graftlint-baseline.json when present in the cwd; "
+                    "pass --baseline '' to lint with no baseline)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline (default "
+                    ".graftlint-baseline.json) from the current findings")
+    ap.add_argument("--rules", help="comma-separated rule ids to run "
+                    "(default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON document instead of text")
+    ap.add_argument("--symbol", action="append", default=[],
+                    help="saved Symbol JSON file to verify (repeatable)")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="name=d0,d1,... input shape for --symbol "
+                    "inference checks (repeatable)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print("%s %-8s %-28s %s" % (rid, rule.severity, rule.title,
+                                        (rule.__doc__ or "").strip()))
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    for r in rules or ():
+        if r not in RULES:
+            ap.error("unknown rule %r (see --list-rules)" % r)
+    if not args.paths and not args.symbol:
+        ap.error("nothing to do: give paths to lint and/or --symbol")
+
+    if args.baseline is None \
+            and os.path.exists(".graftlint-baseline.json"):
+        args.baseline = ".graftlint-baseline.json"
+
+    findings = lint_paths(args.paths, root=os.getcwd(), rules=rules) \
+        if args.paths else []
+
+    if args.update_baseline:
+        if args.rules:
+            ap.error("--update-baseline with --rules would discard every "
+                     "other rule's baselined findings; run it unfiltered")
+        if args.symbol:
+            ap.error("--update-baseline only rewrites the lint baseline; "
+                     "run --symbol verification as a separate invocation")
+        path = args.baseline or ".graftlint-baseline.json"
+        save_baseline(path, findings)
+        print("baseline written: %s (%d findings)" % (path, len(findings)))
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    fresh = new_findings(findings, baseline) if args.baseline else findings
+    fresh_keys = {id(f) for f in fresh}
+
+    shapes = parse_shape_args(args.shape)
+    reports = []
+    for sym_path in args.symbol:
+        with open(sym_path, encoding="utf-8") as f:
+            reports.append((sym_path,
+                            verify_json(f.read(), shapes=shapes or None)))
+
+    failed = bool(fresh) or any(not rep.ok for _, rep in reports)
+
+    if args.as_json:
+        doc = {"ok": not failed,
+               "findings": [dict(f.to_dict(), new=(id(f) in fresh_keys))
+                            for f in findings],
+               "new_findings": len(fresh),
+               "graphs": {p: rep.to_dict() for p, rep in reports}}
+        print(json.dumps(doc, indent=2))
+        return 1 if failed else 0
+
+    for f in findings:
+        tag = "NEW " if id(f) in fresh_keys else ""
+        print("%s:%d:%d: %s%s %s: %s"
+              % (f.path, f.line, f.col, tag, f.rule, f.severity, f.message))
+        if id(f) in fresh_keys and f.hint:
+            print("    hint: %s" % f.hint)
+    for sym_path, rep in reports:
+        print("%s:" % sym_path)
+        print(rep.format())
+    if args.paths:
+        print("graftlint: %d finding(s), %d new%s"
+              % (len(findings), len(fresh),
+                 " (vs baseline %s)" % args.baseline if args.baseline
+                 else ""))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
