@@ -7,7 +7,7 @@ PY ?= python
 # ~35 serial); empty otherwise so bare environments still run
 XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && printf -- "-n 4")
 
-.PHONY: test fast chip bench wheel sdist native clean lint
+.PHONY: test fast chip bench bench-smoke wheel sdist native clean lint
 
 test: lint       ## full suite (~14 min with 4 xdist workers)
 	$(PY) -m pytest tests/ -q $(XDIST)
@@ -25,6 +25,9 @@ chip:            ## serial accelerator tier (needs the real chip)
 
 bench:           ## throughput numbers of record (run on an IDLE host)
 	$(PY) bench.py
+
+bench-smoke:     ## executor-cache smoke: trace/cache counters, fails on recompile regressions
+	$(PY) bench.py --smoke
 
 roofline:        ## kernel-class decomposition of the train step
 	$(PY) tools/roofline_probe.py

@@ -459,6 +459,84 @@ def main():
     }))
 
 
+def smoke():
+    """Tiny-shape CI mode (`make bench-smoke`): exercises the executor
+    program cache on its three hot client paths — repeated fused
+    train-step dispatch, batch-shape alternation (module rebinds), and
+    an executor bind→reshape→bind cycle — then prints the trace/cache
+    counters.  A recompile regression (a path that stops hitting the
+    cache) shows up as a trace-counter jump and fails the assertions,
+    without needing the chip-scale model of the main bench."""
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache
+
+    # pin the cache knobs to their defaults: the asserts below measure
+    # the CODE, and a leftover MXNET_TPU_EXEC_CACHE=0 in the caller's
+    # environment would read as a recompile regression
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+
+    ctx = mx.cpu()
+    rng = np.random.RandomState(0)
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    def mlp():
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                    name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def batch(bs):
+        from mxnet_tpu.io import DataBatch, DataDesc
+        return DataBatch(
+            data=[mx.nd.array(rng.rand(bs, 8).astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 4, (bs,))
+                               .astype(np.float32))],
+            provide_data=[DataDesc("data", (bs, 8))],
+            provide_label=[DataDesc("softmax_label", (bs,))])
+
+    t0 = time.perf_counter()
+    # 1) general-path training steps: one fused program, dispatched N times
+    mod = mx.mod.Module(mlp(), context=ctx)
+    mod.bind([("data", (8, 8))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    steps = 12
+    for _ in range(steps):
+        mod.forward_backward(batch(8))
+    # 2) batch-shape alternation: every switch rebinds; revisits must hit
+    for bs in (4, 8, 4, 8):
+        mod.forward_backward(batch(bs))
+    # 3) executor bind -> reshape -> bind over the same symbol
+    exe = mlp().simple_bind(ctx, grad_req="write",
+                            data=(8, 8), softmax_label=(8,))
+    exe.forward(is_train=False)
+    exe2 = exe.reshape(partial_shaping=True, data=(4, 8),
+                       softmax_label=(4,))
+    exe2.forward(is_train=False)
+    exe3 = exe2.reshape(partial_shaping=True, allow_up_sizing=True,
+                        data=(8, 8), softmax_label=(8,))
+    exe3.forward(is_train=False)
+    wall = time.perf_counter() - t0
+
+    stats = executor_cache.stats()
+    print(json.dumps({
+        "metric": "bench_smoke",
+        "unit": "cache_counters",
+        "train_steps": steps + 4,
+        "wall_sec": round(wall, 2),
+        "exec_cache": stats,
+    }))
+    # recompile-regression guards: exactly one fused trace per unique
+    # batch shape, one fwd trace per reshape signature, and the
+    # revisited signatures all came from the cache
+    assert stats["traces_fwd_bwd"] == 2, stats
+    assert stats["traces_fwd"] == 2, stats
+    assert stats["hits"] >= 3, stats
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -472,4 +550,8 @@ def _main_with_retry():
 
 
 if __name__ == "__main__":
-    _main_with_retry()
+    import sys
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        _main_with_retry()

@@ -31,6 +31,7 @@ from . import autograd
 from . import random
 from .random import seed  # mx.random.seed is canonical; mx.seed kept too
 from . import executor
+from . import executor_cache
 from .executor import Executor
 
 # submodules populated as the build proceeds

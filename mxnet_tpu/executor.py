@@ -12,7 +12,6 @@ match between forward and backward.
 """
 from __future__ import annotations
 
-import functools
 import os
 
 import jax
@@ -24,6 +23,7 @@ from .context import current_context
 from .ops.registry import get_op
 from .ndarray import NDArray, zeros as nd_zeros
 from .ndarray.ndarray import _Handle
+from . import executor_cache
 from . import random as _random
 
 
@@ -140,57 +140,45 @@ class Executor:
                 raise MXNetError(
                     "MXNET_TPU_VERIFY_GRAPH: refusing to bind an invalid "
                     "graph:\n%s" % report.format())
-        self._prog = _Program(symbol)
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict
         self.aux_dict = aux_dict
+        arg_names = symbol.list_arguments()
         if isinstance(grad_req, str):
-            grad_req = {k: grad_req for k in self._prog.arg_names}
+            grad_req = {k: grad_req for k in arg_names}
         elif isinstance(grad_req, (list, tuple)):
-            grad_req = dict(zip(self._prog.arg_names, grad_req))
-        self._grad_req = {k: grad_req.get(k, "null") for k in self._prog.arg_names}
-        self._grad_names = [k for k in self._prog.arg_names
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = {k: grad_req.get(k, "null") for k in arg_names}
+        self._grad_names = [k for k in arg_names
                             if self._grad_req[k] != "null" and k in grad_dict
                             and grad_dict[k] is not None]
+        self._has_add_req = any(self._grad_req[k] == "add"
+                                for k in self._grad_names)
         self.outputs = []
         self._last_keys = None
+        # backward() consistency state: the aux values the last forward
+        # actually consumed (pre-update), whether a fused dispatch
+        # already produced this step's gradients, and whether donation
+        # destroyed the pre-update aux a re-dispatch would want
+        self._last_aux_in = None
+        self._fused_grads_valid = False
+        self._aux_stash_lost = False
         self._monitor_callback = None
         self._monitor_all = False
 
-        prog = self._prog
-        known = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
-        known.update({n: tuple(a.shape) for n, a in self.aux_dict.items()})
-        prog.finalize_shapes(known)
-        n_keys = len(prog.rng_nodes)
-
-        @functools.partial(jax.jit, static_argnums=(3,))
-        def _fwd(arg_vals, aux_vals, keys, train):
-            arg_map = dict(zip(prog.arg_names, arg_vals))
-            aux_map = dict(zip(prog.aux_names, aux_vals))
-            outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
-            return outs, [new_aux[n] for n in prog.aux_names]
-
-        grad_names = self._grad_names
-
-        @jax.jit
-        def _bwd(arg_vals, aux_vals, keys, head_grads):
-            arg_map = dict(zip(prog.arg_names, arg_vals))
-            aux_map = dict(zip(prog.aux_names, aux_vals))
-
-            def f(gvals):
-                amap = dict(arg_map)
-                amap.update(zip(grad_names, gvals))
-                outs, _ = prog.evaluate(amap, aux_map, keys, True)
-                return outs
-
-            gvals = [arg_map[n] for n in grad_names]
-            _, vjp_fn = jax.vjp(f, gvals)
-            (grads,) = vjp_fn(head_grads)
-            return grads
-
-        self._fwd_jit = _fwd
-        self._bwd_jit = _bwd
-        self._n_keys = n_keys
+        # process-wide program reuse (ref: CachedOp): identical
+        # (graph, shapes, dtypes, grads) signatures share one traced
+        # _Program + jitted fwd / fused fwd-bwd — a rebind, reshape, or
+        # bucket revisit over a seen signature costs zero retracing
+        entry = executor_cache.get_entry(
+            symbol, arg_dict, aux_dict, tuple(self._grad_names),
+            platform=ctx.jax_device().platform)
+        self._prog = entry.prog
+        self._fwd_jit = entry.fwd
+        self._fwd_bwd_jit = entry.fwd_bwd
+        self._fwd_bwd_nd_jit = entry.fwd_bwd_nd
+        self._donates_aux = entry.donates_aux
+        self._n_keys = entry.n_keys
 
     # -- parameter access ----------------------------------------------------
     @property
@@ -236,6 +224,14 @@ class Executor:
                                  for n in self._prog.aux_names])
         keys = tuple(_random.next_key() for _ in range(self._n_keys))
         self._last_keys = keys
+        # stash what this forward actually consumes so a later backward()
+        # differentiates THIS evaluation: under is_train the aux_dict is
+        # about to advance to the post-update values, and grads taken
+        # against those would mismatch the recorded forward (BatchNorm
+        # moving-stat ordering)
+        self._last_aux_in = aux_vals
+        self._fused_grads_valid = False
+        self._aux_stash_lost = False
 
         if self._monitor_callback is not None:
             # monitor mode: run uncompiled so every op output can be tapped
@@ -270,36 +266,128 @@ class Executor:
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
+    def forward_backward(self, is_train=True, out_grads=None):
+        """Forward AND backward as ONE fused jitted dispatch (tentpole
+        dispatch model: a single XLA program per training step instead
+        of a forward plus a recompute-forward vjp).  Outputs land in
+        `self.outputs`, gradients in `grad_dict` (honoring grad_req),
+        and aux states advance exactly as forward(is_train=True) +
+        backward() would.  Falls back to the separate path when a
+        monitor is installed, nothing takes gradients, or
+        is_train=False."""
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        if self._monitor_callback is not None or not self._grad_names \
+                or not is_train \
+                or (out_grads is not None
+                    and any(g is None for g in out_grads)):
+            # None head-grad entries mean ones_like(output) — outputs
+            # only exist after a forward, so that form takes the
+            # separate path
+            self.forward(is_train=is_train)
+            if self._grad_names:
+                self.backward(out_grads=out_grads)
+            return self.outputs
+        arg_vals = self._gather([self.arg_dict[n]._h.array
+                                 for n in self._prog.arg_names])
+        aux_vals = self._gather([self.aux_dict[n]._h.array
+                                 for n in self._prog.aux_names])
+        # aux write-back devices, captured BEFORE dispatch: on TPU the
+        # fused program donates the aux input buffers
+        aux_devs = [next(iter(self.aux_dict[n]._h.array.devices()))
+                    for n in self._prog.aux_names]
+        keys = tuple(_random.next_key() for _ in range(self._n_keys))
+        self._last_keys = keys
+        if out_grads is None:
+            heads = ()  # ones head-grads are built inside the program
+        else:
+            heads = tuple(self._gather([g._h.array for g in out_grads]))
+        from . import profiler as _profiler
+        if _profiler.is_running():
+            with _profiler.record_span(
+                    "executor_fwd_bwd", category="symbolic",
+                    dev=str(self._ctx)):
+                outs, new_aux, grads = self._fwd_bwd_jit(
+                    arg_vals, aux_vals, keys, heads)
+                jax.block_until_ready(outs)
+        else:
+            outs, new_aux, grads = self._fwd_bwd_jit(
+                arg_vals, aux_vals, keys, heads)
+        for n, v, dev in zip(self._prog.aux_names, new_aux, aux_devs):
+            self.aux_dict[n]._h.array = _to_device(v, dev)
+        self.outputs = [NDArray(o) for o in outs]
+        self._store_grads(grads)
+        # a later backward(out_grads) differentiates the aux this
+        # dispatch consumed — unless donation already invalidated them
+        self._last_aux_in = None if self._donates_aux else aux_vals
+        self._aux_stash_lost = self._donates_aux \
+            and bool(self._prog.aux_names)
+        # a later backward() with default (ones) head-grads may reuse
+        # these residuals instead of re-dispatching (grad_req='add'
+        # excluded: an explicit backward() there means one more
+        # accumulation, which the reuse would silently drop)
+        self._fused_grads_valid = out_grads is None \
+            and not self._has_add_req
+        return self.outputs
+
     def backward(self, out_grads=None, is_train=True):
         if not self.outputs:
             raise MXNetError("backward() called before forward()")
+        if not self._grad_names:
+            return
+        if out_grads is None and self._fused_grads_valid:
+            # residual reuse: the preceding fused forward_backward()
+            # already wrote exactly these gradients (ones head-grads)
+            return
+        # this call re-dispatches, so any previously fused gradients are
+        # about to be overwritten — they must not satisfy a later reuse
+        self._fused_grads_valid = False
         if out_grads is None:
-            head_grads = [jnp.ones_like(o._h.array) for o in self.outputs]
+            heads = ()  # ones built inside the fused program
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             head_grads = [g._h.array if g is not None else
                           jnp.ones_like(o._h.array)
                           for g, o in zip(out_grads, self.outputs)]
-        if not self._grad_names:
-            return
+            heads = tuple(self._gather(head_grads))  # user grads may live
+            # on a group device; the jitted program computes on the bind ctx
         arg_vals = self._gather([self.arg_dict[n]._h.array
                                  for n in self._prog.arg_names])
-        aux_vals = self._gather([self.aux_dict[n]._h.array
-                                 for n in self._prog.aux_names])
+        if self._last_aux_in is not None:
+            # differentiate the aux values the recorded forward consumed,
+            # not the post-update ones it produced
+            aux_vals = self._last_aux_in
+        else:
+            if self._aux_stash_lost:
+                import warnings
+                warnings.warn(
+                    "backward() after a fused forward_backward() on a "
+                    "donating backend: the pre-update aux states were "
+                    "donated into the fused program, so these gradients "
+                    "differentiate the POST-update aux values (e.g. "
+                    "advanced BatchNorm moving stats). Run forward("
+                    "is_train=True) before backward() for exact "
+                    "pre-update semantics.", stacklevel=2)
+            aux_vals = self._gather([self.aux_dict[n]._h.array
+                                     for n in self._prog.aux_names])
         keys = self._last_keys or tuple(_random.next_key()
                                         for _ in range(self._n_keys))
-        head_grads = self._gather(head_grads)  # user grads may live on a
-        # group device; the jitted backward computes on the bind ctx
-        grads = self._bwd_jit(arg_vals, aux_vals, keys, head_grads)
+        # the NON-donating twin: these aux buffers stay live (the stash,
+        # or aux_dict itself) and must survive the dispatch
+        _, _, grads = self._fwd_bwd_nd_jit(arg_vals, aux_vals, keys, heads)
+        self._store_grads(grads)
+
+    def _store_grads(self, grads):
         for n, g in zip(self._grad_names, grads):
             buf = self.grad_dict[n]
+            cur = buf._h.array
             # grads stay on their group ctx
-            g = _to_device(g, next(iter(buf._h.array.devices())))
-            if self._grad_req[n] == "add":
-                buf._h.array = buf._h.array + g.astype(buf._h.array.dtype)
-            else:
-                buf._h.array = g.astype(buf._h.array.dtype)
+            g = _to_device(g, next(iter(cur.devices())))
+            if g.dtype != cur.dtype:
+                g = g.astype(cur.dtype)
+            # grad_req='add' accumulates on device — no host round trip
+            buf._h.array = cur + g if self._grad_req[n] == "add" else g
 
     def _gather(self, vals):
         """Cross-device copy to the executor's device (ref: the
@@ -325,17 +413,48 @@ class Executor:
                     raise MXNetError("invalid aux %r" % k)
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Return a new executor with different input shapes (re-jit; XLA
-        caches per shape signature)."""
+        """Return a new executor bound to different input shapes.  The
+        compiled program comes from the process-wide executor cache, so
+        revisiting a previously-bound signature retraces nothing.
+
+        Flag semantics follow the reference (python/mxnet/executor.py):
+        an argument NOT named in kwargs whose inferred shape changes is
+        an error unless ``partial_shaping=True`` (a silently-changed
+        parameter shape means the new executor cannot share weights with
+        this one), and any array growing beyond its bound size requires
+        ``allow_up_sizing=True`` to authorize fresh allocation."""
+
+        def _numel(s):
+            n = 1
+            for d in s:
+                n *= int(d)
+            return n
+
+        def _check(name, old_shape, shape, specified, kind):
+            if not partial_shaping and not specified:
+                raise MXNetError(
+                    "reshape changed the shape of unspecified %s %r "
+                    "(%s -> %s); if intended, pass partial_shaping=True"
+                    % (kind, name, old_shape, shape))
+            if _numel(shape) > _numel(old_shape) and not allow_up_sizing:
+                raise MXNetError(
+                    "new shape of %s %r (%s) is larger than the bound "
+                    "shape %s; pass allow_up_sizing=True to allow "
+                    "allocating new arrays" % (kind, name, shape,
+                                               old_shape))
+
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args, new_grads = {}, {}
         for name, shape in zip(self._prog.arg_names, arg_shapes):
             cur = self.arg_dict[name]
-            if tuple(cur.shape) == tuple(shape):
+            shape = tuple(int(d) for d in shape)
+            if tuple(cur.shape) == shape:
                 new_args[name] = cur
                 if name in self.grad_dict:
                     new_grads[name] = self.grad_dict[name]
             else:
+                _check(name, tuple(cur.shape), shape, name in kwargs,
+                       "argument")
                 # reallocate on the OLD buffer's device so per-arg
                 # group2ctx placement survives the reshape
                 new_args[name] = nd_zeros(shape, cur.context, dtype=cur.dtype)
@@ -344,7 +463,14 @@ class Executor:
                                                dtype=cur.dtype)
         new_aux = {}
         for name, shape in zip(self._prog.aux_names, aux_shapes):
-            new_aux[name] = self.aux_dict[name]
+            cur = self.aux_dict[name]
+            shape = tuple(int(d) for d in shape)
+            if tuple(cur.shape) == shape:
+                new_aux[name] = cur
+            else:
+                _check(name, tuple(cur.shape), shape, False,
+                       "auxiliary state")
+                new_aux[name] = nd_zeros(shape, cur.context, dtype=cur.dtype)
         return Executor(self._symbol, self._ctx, new_args, new_grads, new_aux,
                         self._grad_req)
 

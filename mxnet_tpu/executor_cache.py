@@ -1,0 +1,234 @@
+"""Process-wide compiled-program cache for Executors (ref: CachedOp +
+the shared memory pools of src/executor/graph_executor.cc).
+
+The reference gets its symbolic-mode speed from reusing compiled graphs:
+CachedOp keeps one optimized graph per (graph, shape) signature and
+GraphExecutor shares memory pools across rebinds.  Here the equivalent
+asset is the *traced, jitted XLA program*: tracing a whole-graph
+evaluator is the expensive step (seconds for real models), so every
+`Executor.__init__` used to pay it again even when an identical program
+already existed — each rebind, `Executor.reshape`, `BucketingModule`
+bucket, and `Module._rebind_for_batch` retraced from scratch.
+
+This module keys programs by the full dispatch signature
+
+    (structural graph fingerprint, arg shapes+dtypes, aux shapes+dtypes,
+     gradient-taking arg names)
+
+so Executors constructed over the same signature share ONE entry holding:
+
+- the `_Program` (topo order, rng nodes, shape overrides),
+- `fwd`:     jitted (args, auxs, keys, train) -> (outputs, new_auxs)
+- `fwd_bwd`: jitted (args, auxs, keys, heads) -> (outputs, new_auxs,
+  grads) — forward AND backward as one fused `jax.vjp` program, the
+  north-star "one XLA program per training step" dispatch.  An empty
+  `heads` tuple means ones head-gradients built inside the program (the
+  canonical training form — no per-step ones upload).  On TPU the aux
+  buffers are donated into the program (`donate_argnums`) so BatchNorm
+  moving stats update in place instead of doubling their HBM footprint.
+
+Trace counters increment inside the traced function bodies — a Python
+body only runs when jax actually (re)traces — so `stats()` reports real
+recompiles, not guesses, and a recompile regression shows up as a
+counter jump in `make bench-smoke` / the tests.
+
+Config: `MXNET_TPU_EXEC_CACHE=0` disables sharing (each Executor builds
+a private program); `MXNET_TPU_EXEC_CACHE_SIZE` caps the LRU (default
+128 entries).  Cache events surface as Chrome-trace counter events when
+the profiler is running (`profiler.record_counter`).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import profiler as _profiler
+
+_lock = threading.Lock()
+_entries = OrderedDict()  # key -> ProgramEntry, LRU order
+_stats = {"hits": 0, "misses": 0, "evictions": 0,
+          "traces_fwd": 0, "traces_fwd_bwd": 0, "traces_fused_step": 0}
+
+
+def _enabled():
+    return os.environ.get("MXNET_TPU_EXEC_CACHE", "1") != "0"
+
+
+def _maxsize():
+    return int(os.environ.get("MXNET_TPU_EXEC_CACHE_SIZE", "128"))
+
+
+class ProgramEntry:
+    """One cached compiled form of a graph signature.
+
+    `fwd_bwd` may donate its aux inputs (TPU); `fwd_bwd_nd` never does —
+    the compatibility backward() path feeds it buffers that stay live.
+    When donation is off they are the same jitted callable, so the pair
+    costs no extra trace."""
+
+    __slots__ = ("prog", "fwd", "fwd_bwd", "fwd_bwd_nd", "donates_aux",
+                 "n_keys")
+
+    def __init__(self, prog, fwd, fwd_bwd, fwd_bwd_nd, donates_aux, n_keys):
+        self.prog = prog
+        self.fwd = fwd
+        self.fwd_bwd = fwd_bwd
+        self.fwd_bwd_nd = fwd_bwd_nd
+        self.donates_aux = donates_aux
+        self.n_keys = n_keys
+
+
+def note_trace(kind):
+    """Record one jax trace of kind 'fwd' / 'fwd_bwd' / 'fused_step'.
+
+    Called from INSIDE jitted function bodies: the body only executes
+    when jax traces (first call per signature), so this counts real
+    retraces.  Also used by module/fused_step.py for its step program.
+    """
+    with _lock:
+        _stats["traces_" + kind] += 1
+        value = _stats["traces_" + kind]
+    _profiler.record_counter("exec_cache_traces_" + kind, value)
+
+
+def _note(event):
+    with _lock:
+        _stats[event] += 1
+        value = _stats[event]
+    _profiler.record_counter("exec_cache_" + event, value)
+
+
+def _signature(symbol, arg_dict, aux_dict, grad_names, platform):
+    fp = symbol.structural_hash()
+    arg_sig = tuple(sorted(
+        (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
+        for n, a in arg_dict.items()))
+    aux_sig = tuple(sorted(
+        (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
+        for n, a in aux_dict.items()))
+    return (fp, arg_sig, aux_sig, tuple(grad_names), platform)
+
+
+def _build_entry(symbol, known_shapes, grad_names, platform):
+    # lazy import: executor.py imports this module at its top level
+    from .executor import _Program
+
+    prog = _Program(symbol)
+    prog.finalize_shapes(known_shapes)
+    n_keys = len(prog.rng_nodes)
+    arg_names = prog.arg_names
+    aux_names = prog.aux_names
+    grad_names = list(grad_names)
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def _fwd(arg_vals, aux_vals, keys, train):
+        note_trace("fwd")
+        arg_map = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+        outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
+        return outs, [new_aux[n] for n in aux_names]
+
+    def _fwd_bwd_impl(arg_vals, aux_vals, keys, head_grads):
+        note_trace("fwd_bwd")
+        arg_map = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+
+        def f(gvals):
+            amap = dict(arg_map)
+            amap.update(zip(grad_names, gvals))
+            outs, new_aux = prog.evaluate(amap, aux_map, keys, True)
+            return outs, [new_aux[n] for n in aux_names]
+
+        gvals = [arg_map[n] for n in grad_names]
+        (outs, new_aux), vjp_fn = jax.vjp(f, gvals)
+        heads = list(head_grads) if head_grads \
+            else [jnp.ones_like(o) for o in outs]
+        zeros_aux = [jnp.zeros_like(a) for a in new_aux]
+        (grads,) = vjp_fn((heads, zeros_aux))
+        return outs, new_aux, grads
+
+    # donation halves the aux-state footprint, but jax only implements it
+    # on accelerator backends — donating on cpu would warn on every
+    # compile without freeing anything.  Decided by the BIND context's
+    # platform (part of the cache key), not the process default backend:
+    # a cpu-context executor on a TPU host must not donate.  Only
+    # forward_backward() may use the donating form (it replaces the aux
+    # buffers right after); the compatibility backward() path uses the
+    # non-donating twin because the buffers it feeds stay live in
+    # aux_dict.
+    donate = (1,) if platform == "tpu" else ()
+    _fwd_bwd = jax.jit(_fwd_bwd_impl, donate_argnums=donate)
+    _fwd_bwd_nd = jax.jit(_fwd_bwd_impl) if donate else _fwd_bwd
+
+    return ProgramEntry(prog, _fwd, _fwd_bwd, _fwd_bwd_nd, bool(donate),
+                        n_keys)
+
+
+def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
+    """The shared ProgramEntry for this bind signature (building and
+    inserting it on first sight).  arg_dict/aux_dict map name -> array-
+    like with .shape/.dtype; grad_names is the ordered tuple of
+    arguments whose gradients the backward program must produce;
+    platform is the bind context's device platform (keys the entry and
+    gates aux donation)."""
+    known = {n: tuple(int(d) for d in a.shape) for n, a in arg_dict.items()}
+    known.update((n, tuple(int(d) for d in a.shape))
+                 for n, a in aux_dict.items())
+    if not _enabled():
+        _note("misses")
+        return _build_entry(symbol, known, grad_names, platform)
+    key = _signature(symbol, arg_dict, aux_dict, grad_names, platform)
+    with _lock:
+        entry = _entries.get(key)
+        if entry is not None:
+            _entries.move_to_end(key)
+            _stats["hits"] += 1
+            hits = _stats["hits"]
+        else:
+            hits = None
+    if entry is not None:
+        _profiler.record_counter("exec_cache_hits", hits)
+        return entry
+    _note("misses")
+    entry = _build_entry(symbol, known, grad_names, platform)
+    with _lock:
+        # a concurrent bind may have built the same signature; first
+        # insertion wins so every caller shares one traced program
+        existing = _entries.get(key)
+        if existing is not None:
+            return existing
+        _entries[key] = entry
+        while len(_entries) > _maxsize():
+            _entries.popitem(last=False)
+            _stats["evictions"] += 1
+    return entry
+
+
+def stats():
+    """Counter snapshot: hits/misses/evictions, per-kind trace counts,
+    live entry count, and whether sharing is enabled."""
+    with _lock:
+        out = dict(_stats)
+        out["entries"] = len(_entries)
+    out["enabled"] = _enabled()
+    return out
+
+
+def reset_stats():
+    """Zero the counters (entries stay cached)."""
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def clear():
+    """Drop every cached entry (live Executors keep their references;
+    only future binds rebuild)."""
+    with _lock:
+        _entries.clear()

@@ -137,10 +137,21 @@ class DataParallelExecutorGroup:
         self.data_layouts = self.decide_slices(data_shapes)
         if label_shapes is not None:
             self.label_layouts = self.decide_slices(label_shapes)
+        # a reshape rebind shares the old executors' parameter/aux
+        # buffers (values survive; only data/label reallocate) — the
+        # same sharing path bucketing uses, with the retiring execs as
+        # the sharers (ref: graph_executor's shared memory pools)
+        old_execs = list(self.execs) if reshape and shared_group is None \
+            else []
         self.execs = []
         for i in range(len(self.contexts)):
+            shared_exec = None
+            if shared_group is not None:
+                shared_exec = shared_group.execs[i]
+            elif i < len(old_execs):
+                shared_exec = old_execs[i]
             self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
-                                                  shared_group))
+                                                  shared_exec))
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
         self.data_names = [i.name if isinstance(i, DataDesc) else i[0]
@@ -166,7 +177,7 @@ class DataParallelExecutorGroup:
                                    getattr(desc, "dtype", np.float32)))
         return sliced
 
-    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_exec):
         data_shapes_i = self._sliced_shape(data_shapes, i, self.data_layouts)
         if label_shapes is not None:
             label_shapes_i = self._sliced_shape(label_shapes, i,
@@ -176,23 +187,49 @@ class DataParallelExecutorGroup:
         ctx = self.contexts[i]
         shape_kwargs = {x.name: x.shape for x in data_shapes_i + label_shapes_i}
         type_kwargs = {x.name: x.dtype for x in data_shapes_i + label_shapes_i}
-        if shared_group is not None:
-            shared_exec = shared_group.execs[i]
-            # share parameter arrays with the shared executor (bucketing)
+        if shared_exec is not None:
+            # share parameter arrays with the shared executor (bucketing,
+            # and the same-group reshape rebind)
             arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shape_kwargs)
             arg_dict, grad_dict = {}, {}
             for name, shape in zip(self.arg_names, arg_shapes):
-                if name in self.param_names and name in shared_exec.arg_dict:
-                    arg_dict[name] = shared_exec.arg_dict[name]
-                    if name in shared_exec.grad_dict and \
-                            shared_exec.grad_dict[name] is not None:
-                        grad_dict[name] = shared_exec.grad_dict[name]
+                if name in self.param_names \
+                        and name in shared_exec.arg_dict:
+                    cur = shared_exec.arg_dict[name]
+                    if tuple(cur.shape) == tuple(shape):
+                        arg_dict[name] = cur
+                        if name in shared_exec.grad_dict and \
+                                shared_exec.grad_dict[name] is not None:
+                            grad_dict[name] = shared_exec.grad_dict[name]
+                        continue
+                    # a parameter whose shape changed cannot share its
+                    # buffer; its learned values are discarded — loud,
+                    # because that usually means a mis-specified bucket
+                    self.logger.warning(
+                        "parameter %r changed shape %s -> %s across the "
+                        "shared bind; reallocating it ZEROED (its values "
+                        "cannot carry over)", name, tuple(cur.shape),
+                        tuple(shape))
+                arg_dict[name] = nd_zeros(shape, ctx,
+                                          dtype=type_kwargs.get(name, np.float32))
+                if self.grad_req.get(name, "null") != "null":
+                    grad_dict[name] = nd_zeros(shape, ctx)
+            # aux states share only when the inferred shape still fits
+            # (shape-dependent aux reallocates, mirroring the arg path)
+            aux_dict = {}
+            for name, shape in zip(self.aux_names, aux_shapes):
+                cur = shared_exec.aux_dict.get(name)
+                if cur is not None and tuple(cur.shape) == tuple(shape):
+                    aux_dict[name] = cur
                 else:
-                    arg_dict[name] = nd_zeros(shape, ctx,
-                                              dtype=type_kwargs.get(name, np.float32))
-                    if self.grad_req.get(name, "null") != "null":
-                        grad_dict[name] = nd_zeros(shape, ctx)
-            aux_dict = dict(shared_exec.aux_dict)
+                    if cur is not None:
+                        self.logger.warning(
+                            "auxiliary state %r changed shape %s -> %s "
+                            "across the shared bind; reallocating it "
+                            "ZEROED", name, tuple(cur.shape), tuple(shape))
+                    aux_dict[name] = nd_zeros(
+                        shape, ctx,
+                        dtype=cur.dtype if cur is not None else np.float32)
             return Executor(self.symbol, ctx, arg_dict, grad_dict, aux_dict,
                             self.grad_req)
         return self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
@@ -254,6 +291,18 @@ class DataParallelExecutorGroup:
             _load_label(data_batch, self.label_arrays)
         for e in self.execs:
             e.forward(is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """One fused fwd+bwd XLA dispatch per exec (outputs, gradients
+        and aux updates from a single jitted program) — the general
+        training step of the north-star dispatch model."""
+        assert self.for_training, \
+            "re-bind with for_training=True to run backward"
+        _load_data(data_batch, self.data_arrays)
+        if self.label_arrays is not None and data_batch.label:
+            _load_label(data_batch, self.label_arrays)
+        for e in self.execs:
+            e.forward_backward(is_train=True)
 
     def get_output_shapes(self):
         outputs = self.execs[0].outputs

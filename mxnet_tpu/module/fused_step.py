@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import executor_cache as _exec_cache
 from .. import random as _random
 from ..ndarray import NDArray
 from ..optimizer import _is_low_precision
@@ -189,6 +190,9 @@ class FusedTrainStep:
 
         def _step(masters, other_vals, states, aux_vals, keys, lrs, wds,
                   extras, opt_key):
+            # body runs only when jax (re)traces: counts real recompiles
+            # of the fused step alongside the executor-cache counters
+            _exec_cache.note_trace("fused_step")
             arg_map = dict(zip(other_names, other_vals))
             aux_map = dict(zip(aux_names, aux_vals))
 

@@ -430,7 +430,15 @@ class Module(BaseModule):
                 self._fused_pending = True
                 self._params_dirty = True
                 return
-        super().forward_backward(data_batch)
+        # general path: ONE fused fwd+bwd program per exec per step
+        # (executor_cache fused dispatch) instead of a forward plus a
+        # recompute-forward vjp — half the dispatches, no double forward
+        assert self.binded and self.params_initialized
+        self._rebind_for_batch(data_batch)
+        self._exec_group.forward_backward(data_batch)
+        # aux states advanced on device (BatchNorm moving stats):
+        # get_params() must re-sync the masters
+        self._params_dirty = True
 
     def update(self):
         assert self.binded and self.params_initialized and \

@@ -90,8 +90,12 @@ class Predictor:
         return self._exe.outputs[index]
 
     def reshape(self, input_shapes):
-        """MXPredReshape: re-bind with new shapes (re-jit per signature)."""
-        self._exe = self._exe.reshape(**input_shapes)
+        """MXPredReshape: re-bind with new shapes (program reuse via the
+        executor cache).  The C-predict contract allows any new input
+        size and reshapes dependent arrays (labels, states) implicitly,
+        so the executor-level strictness flags are both waived here."""
+        self._exe = self._exe.reshape(partial_shaping=True,
+                                      allow_up_sizing=True, **input_shapes)
         self._out_shapes = self._infer_out_shapes()
         return self
 

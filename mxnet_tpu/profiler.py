@@ -53,6 +53,19 @@ def record_event(name, start_us, end_us, category="operator", dev="cpu/0",
                         "ts": end_us, "pid": dev, "tid": tid})
 
 
+def record_counter(name, value, category="exec_cache", dev="cpu/0"):
+    """Chrome trace-event counter sample ("ph": "C") — used by the
+    executor program cache to surface hit/miss/trace counts on the same
+    timeline as the execution spans (chrome://tracing renders counters
+    as a stacked track)."""
+    if not _state["running"]:
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "C",
+                        "ts": time.time() * 1e6, "pid": dev, "tid": 0,
+                        "args": {"value": value}})
+
+
 class record_span:
     def __init__(self, name, category="operator", dev="cpu/0"):
         self.name = name

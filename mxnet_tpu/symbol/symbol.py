@@ -14,6 +14,7 @@ JSON layout mirrors nnvm::SaveJSON ({"nodes": [...], "arg_nodes": [...],
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -119,9 +120,16 @@ class _Node:
         return n
 
 
+# bumped on any post-composition attr mutation (Symbol._set_attr) so
+# memoized structural hashes — possibly held by OTHER Symbol views over
+# the same nodes — can never go stale
+_attr_epoch = 0
+
+
 class Symbol:
     def __init__(self, entries):
         self._entries = list(entries)  # [(node, out_idx)]
+        self._shash = None  # (attr epoch, digest) memo
 
     # -- graph walks ---------------------------------------------------------
     def _topo(self):
@@ -213,6 +221,8 @@ class Symbol:
         return out
 
     def _set_attr(self, **kwargs):
+        global _attr_epoch
+        _attr_epoch += 1  # invalidate every memoized structural hash
         for node, _ in self._entries:
             node.attrs.update({k: str(v) for k, v in kwargs.items()})
 
@@ -550,6 +560,38 @@ class Symbol:
                 break
         # default dtype float32 for anything still unknown
         return shapes, dtypes
+
+    def structural_hash(self):
+        """Stable fingerprint of the graph STRUCTURE: ops, node names,
+        attrs, wiring, and output entries — everything that determines
+        the compiled program apart from the bound shapes/dtypes (those
+        are keyed separately by the executor cache).  Two Symbols built
+        independently (e.g. by a BucketingModule's sym_gen for two
+        buckets of the same architecture) hash equal exactly when they
+        lower to the same program, so their Executors can share one
+        traced/jitted XLA computation (ref: the graph-pointer keying of
+        CachedOp).  sha256 over the canonical topo serialization —
+        stable across processes, independent of object identity.
+
+        Memoized per Symbol (rebinds are a hot path); the memo is
+        keyed on the global attr-mutation epoch so a later _set_attr —
+        through this or any other Symbol view of the same nodes —
+        forces a recompute."""
+        if self._shash is not None and self._shash[0] == _attr_epoch:
+            return self._shash[1]
+        order = self._topo()
+        nid = {id(n): i for i, n in enumerate(order)}
+        h = hashlib.sha256()
+        for n in order:
+            h.update(repr((
+                n.op_name, n.name,
+                tuple(sorted((k, str(v)) for k, v in n.attrs.items())),
+                tuple((nid[id(src)], idx) for src, idx in n.inputs),
+            )).encode())
+        h.update(repr([(nid[id(n)], idx)
+                       for n, idx in self._entries]).encode())
+        self._shash = (_attr_epoch, h.hexdigest())
+        return self._shash[1]
 
     # -- serialization -------------------------------------------------------
     def tojson(self):

@@ -1,0 +1,354 @@
+"""Executor program cache + fused fwd-bwd dispatch (executor_cache.py).
+
+Covers the PR-2 acceptance criteria: bind→reshape→bind and bucket
+switching retrace nothing on revisited signatures (asserted via the
+cache's trace counters, which increment inside the traced bodies and so
+count REAL retraces), the general Module path runs one fused XLA
+program per training step, and fused gradients bitwise-match the
+separate forward()+backward() path (including BatchNorm aux-mutation
+ordering).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import DataBatch, DataDesc
+
+rng = np.random.RandomState(7)
+
+
+def _fresh():
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+
+def _mlp(nh=8, classes=4):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=nh,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _bn_net():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=6,
+                                name="fc")
+    net = mx.sym.BatchNorm(net, name="bn")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fill_pair(a, b, seed=3):
+    """Identical random params/inputs into both executors."""
+    r = np.random.RandomState(seed)
+    for n, arr in a.arg_dict.items():
+        v = r.randint(0, 4, arr.shape).astype(np.float32) \
+            if n == "softmax_label" else \
+            r.normal(0, 1, arr.shape).astype(np.float32)
+        arr[:] = v
+        b.arg_dict[n][:] = v
+    for n, arr in a.aux_dict.items():
+        v = np.ones(arr.shape, np.float32) if "var" in n \
+            else np.zeros(arr.shape, np.float32)
+        arr[:] = v
+        b.aux_dict[n][:] = v
+
+
+def test_bind_reshape_bind_cycle_caches():
+    """Revisiting a (graph, shape) signature is a cache hit with zero
+    retracing; each unique signature traces exactly once."""
+    _fresh()
+    sym = _mlp()
+    exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                          data=(8, 6), softmax_label=(8,))
+    exe.forward(is_train=False)
+    s = executor_cache.stats()
+    assert s["misses"] == 1 and s["traces_fwd"] == 1
+    exe2 = exe.reshape(partial_shaping=True, data=(4, 6),
+                       softmax_label=(4,))
+    exe2.forward(is_train=False)
+    exe3 = exe2.reshape(partial_shaping=True, allow_up_sizing=True,
+                        data=(8, 6), softmax_label=(8,))
+    exe3.forward(is_train=False)
+    s = executor_cache.stats()
+    assert s["hits"] > 0
+    # exactly one trace per unique (graph, shape) signature: (8,6), (4,6)
+    assert s["misses"] == 2 and s["traces_fwd"] == 2
+    # and a second bind of the original signature is free too
+    sym.simple_bind(mx.cpu(), grad_req="write",
+                    data=(8, 6), softmax_label=(8,)) \
+       .forward(is_train=False)
+    s2 = executor_cache.stats()
+    assert s2["traces_fwd"] == 2 and s2["hits"] == s["hits"] + 1
+
+
+def test_structural_hash_shared_across_symbol_instances():
+    """Independently-built Symbols of the same architecture share one
+    program entry (the CachedOp-style process-wide reuse)."""
+    _fresh()
+    a = _mlp().simple_bind(mx.cpu(), grad_req="write",
+                           data=(4, 6), softmax_label=(4,))
+    b = _mlp().simple_bind(mx.cpu(), grad_req="write",
+                           data=(4, 6), softmax_label=(4,))
+    a.forward(is_train=False)
+    b.forward(is_train=False)
+    s = executor_cache.stats()
+    assert s["misses"] == 1 and s["hits"] == 1 and s["traces_fwd"] == 1
+    assert a._prog is b._prog
+
+
+def _bucket_batch(key, bs=8):
+    return DataBatch(
+        data=[mx.nd.array(rng.rand(bs, key).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (bs,)).astype(np.float32))],
+        bucket_key=key,
+        provide_data=[DataDesc("data", (bs, key))],
+        provide_label=[DataDesc("softmax_label", (bs,))])
+
+
+def _bucketing_module():
+    def sym_gen(key):
+        # the Activation is deliberately UNNAMED: BucketingModule._spawn
+        # must neutralize the global auto-naming counter so every
+        # sym_gen call fingerprints (and names params) identically
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                    name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=12,
+                                 context=mx.cpu())
+    b = _bucket_batch(12)
+    mod.bind(data_shapes=b.provide_data, label_shapes=b.provide_label)
+    mod.init_params()
+    return mod
+
+
+def test_bucketing_one_trace_per_bucket():
+    """Two passes over three buckets trace exactly once per bucket, and
+    a FRESH BucketingModule over the same buckets retraces nothing."""
+    _fresh()
+    mod = _bucketing_module()
+    for _ in range(2):
+        for key in (12, 8, 4):
+            mod.forward_backward(_bucket_batch(key))
+    s = executor_cache.stats()
+    assert s["traces_fwd_bwd"] == 3, s
+    assert s["misses"] == 3
+    # process-wide reuse: a new module over seen signatures is all hits
+    mod2 = _bucketing_module()
+    for key in (12, 8, 4):
+        mod2.forward_backward(_bucket_batch(key))
+    s2 = executor_cache.stats()
+    assert s2["traces_fwd_bwd"] == 3, s2
+    assert s2["hits"] >= 3
+
+
+def test_module_general_path_one_fused_program_per_step():
+    """Module.forward_backward (no optimizer => general path) runs ONE
+    fused program per step: a single trace, then pure dispatch."""
+    _fresh()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    batch = DataBatch(
+        data=[mx.nd.array(rng.rand(8, 6).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+    for _ in range(4):
+        mod.forward_backward(batch)
+    s = executor_cache.stats()
+    assert s["traces_fwd_bwd"] == 1 and s["traces_fwd"] == 0, s
+    # gradients landed (usable by update())
+    gsum = sum(float(np.abs(g[0].asnumpy()).sum())
+               for g in mod._exec_group.grad_arrays)
+    assert gsum > 0
+
+
+@pytest.mark.parametrize("maker", [_mlp, _bn_net],
+                         ids=["mlp", "batchnorm"])
+def test_fused_grads_bitwise_match_separate_path(maker):
+    """forward_backward() grads == forward()+backward() grads, bitwise.
+    For the BatchNorm net this also pins the aux-mutation ordering:
+    backward differentiates the SAME aux values the forward consumed
+    (pre-update), exactly like the fused program."""
+    _fresh()
+    sym = maker()
+    kw = dict(data=(8, 5), softmax_label=(8,))
+    ea = sym.simple_bind(mx.cpu(), grad_req="write", **kw)
+    eb = sym.simple_bind(mx.cpu(), grad_req="write", **kw)
+    _fill_pair(ea, eb)
+    ea.forward(is_train=True)
+    ea.backward()
+    eb.forward_backward()
+    for n in ea._grad_names:
+        assert np.array_equal(ea.grad_dict[n].asnumpy(),
+                              eb.grad_dict[n].asnumpy()), n
+    for n in ea.aux_dict:
+        # both paths advanced the moving stats identically
+        assert np.allclose(ea.aux_dict[n].asnumpy(),
+                           eb.aux_dict[n].asnumpy()), n
+    assert np.allclose(ea.outputs[0].asnumpy(), eb.outputs[0].asnumpy(),
+                       rtol=1e-6, atol=1e-6)
+
+
+def test_backward_reuses_fused_residuals():
+    """backward() after a fused forward_backward() re-dispatches
+    nothing — the gradients are already in grad_dict."""
+    _fresh()
+    exe = _mlp().simple_bind(mx.cpu(), grad_req="write",
+                             data=(4, 6), softmax_label=(4,))
+    exe.arg_dict["data"][:] = rng.rand(4, 6).astype(np.float32)
+    exe.forward_backward()
+    g = exe.grad_dict["fc1_weight"].asnumpy().copy()
+    before = exe.grad_dict["fc1_weight"]._h.array
+    exe.backward()  # ones head-grads: must be a no-op reuse
+    assert exe.grad_dict["fc1_weight"]._h.array is before
+    assert np.array_equal(exe.grad_dict["fc1_weight"].asnumpy(), g)
+
+
+def test_backward_after_custom_heads_invalidates_reuse():
+    """backward(custom) after a fused forward_backward() must not leave
+    the reuse window open: a following backward() (ones heads) has to
+    re-dispatch, not hand back the custom-head gradients."""
+    _fresh()
+    # a raw (non-loss) head so out_grads actually scale the gradients
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=(4, 6))
+    exe.arg_dict["data"][:] = rng.rand(4, 6).astype(np.float32)
+    exe.arg_dict["fc_weight"][:] = rng.rand(3, 6).astype(np.float32)
+    exe.forward_backward()
+    ones_grad = exe.grad_dict["fc_weight"].asnumpy().copy()
+    heads = [mx.nd.array(3.0 * np.ones(o.shape, np.float32))
+             for o in exe.outputs]
+    exe.backward(out_grads=heads)
+    custom_grad = exe.grad_dict["fc_weight"].asnumpy().copy()
+    np.testing.assert_allclose(custom_grad, 3.0 * ones_grad,
+                               rtol=1e-6, atol=1e-6)
+    exe.backward()  # ones heads again: must re-dispatch
+    np.testing.assert_allclose(exe.grad_dict["fc_weight"].asnumpy(),
+                               ones_grad, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_forward_backward_none_head_entries():
+    """out_grads lists may contain None (= ones_like(output)); the fused
+    entry point must accept that form like backward() does."""
+    _fresh()
+    exe = _mlp().simple_bind(mx.cpu(), grad_req="write",
+                             data=(4, 6), softmax_label=(4,))
+    exe.arg_dict["data"][:] = rng.rand(4, 6).astype(np.float32)
+    exe.forward_backward()
+    g_ones = exe.grad_dict["fc1_weight"].asnumpy().copy()
+    exe.forward_backward(out_grads=[None])
+    np.testing.assert_allclose(exe.grad_dict["fc1_weight"].asnumpy(),
+                               g_ones, rtol=1e-6, atol=1e-7)
+
+
+def test_grad_req_add_fused_then_backward_accumulates():
+    """Under grad_req='add', an explicit backward() after a fused
+    forward_backward() is one MORE accumulation — residual reuse must
+    not swallow it."""
+    _fresh()
+    exe = _mlp().simple_bind(mx.cpu(), grad_req="add",
+                             data=(4, 6), softmax_label=(4,))
+    exe.arg_dict["data"][:] = rng.rand(4, 6).astype(np.float32)
+    exe.forward_backward()
+    g1 = exe.grad_dict["fc1_weight"].asnumpy().copy()
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["fc1_weight"].asnumpy(),
+                               2.0 * g1, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_req_add_accumulates_without_spurious_cast():
+    """grad_req='add' accumulates across backward calls on device; the
+    dtype-matched path must not round-trip through astype."""
+    _fresh()
+    sym = _mlp()
+    kw = dict(data=(4, 6), softmax_label=(4,))
+    e_add = sym.simple_bind(mx.cpu(), grad_req="add", **kw)
+    e_wr = sym.simple_bind(mx.cpu(), grad_req="write", **kw)
+    _fill_pair(e_add, e_wr)
+    for _ in range(2):
+        e_add.forward(is_train=True)
+        e_add.backward()
+    e_wr.forward(is_train=True)
+    e_wr.backward()
+    for n in e_wr._grad_names:
+        np.testing.assert_allclose(e_add.grad_dict[n].asnumpy(),
+                                   2.0 * e_wr.grad_dict[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_reshape_flag_validation():
+    """partial_shaping / allow_up_sizing follow the reference contract
+    instead of being silently ignored."""
+    _fresh()
+    exe = _mlp().simple_bind(mx.cpu(), grad_req="null",
+                             data=(8, 6), softmax_label=(8,))
+    # softmax_label's shape changes but is not specified -> error
+    with pytest.raises(MXNetError, match="partial_shaping"):
+        exe.reshape(data=(4, 6))
+    # growing past the bound size needs explicit authorization
+    with pytest.raises(MXNetError, match="allow_up_sizing"):
+        exe.reshape(data=(16, 6), softmax_label=(16,))
+    big = exe.reshape(allow_up_sizing=True, data=(16, 6),
+                      softmax_label=(16,))
+    assert big.arg_dict["data"].shape == (16, 6)
+    # shrinking with all changed inputs specified is always fine
+    small = exe.reshape(data=(4, 6), softmax_label=(4,))
+    assert small.arg_dict["data"].shape == (4, 6)
+    # parameters are shared, not reallocated, on a pure batch reshape
+    assert small.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+
+
+def test_module_reshape_preserves_params_and_caches():
+    """Module.reshape keeps parameter values (buffer sharing with the
+    retiring executors) and revisited shapes are cache hits."""
+    _fresh()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    w0 = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    assert np.abs(w0).sum() > 0
+
+    def batch(bs):
+        return DataBatch(
+            data=[mx.nd.array(rng.rand(bs, 6).astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 4, (bs,))
+                               .astype(np.float32))],
+            provide_data=[DataDesc("data", (bs, 6))],
+            provide_label=[DataDesc("softmax_label", (bs,))])
+
+    for bs in (8, 4, 8, 4):
+        mod.forward_backward(batch(bs))
+    w1 = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    assert np.array_equal(w0, w1)  # params survived both reshapes
+    s = executor_cache.stats()
+    assert s["traces_fwd_bwd"] == 2, s  # one per unique batch size
+    assert s["hits"] >= 2              # the two revisits
+
+
+def test_cache_disable_env(monkeypatch):
+    """MXNET_TPU_EXEC_CACHE=0: every bind builds a private program."""
+    _fresh()
+    monkeypatch.setenv("MXNET_TPU_EXEC_CACHE", "0")
+    sym = _mlp()
+    a = sym.simple_bind(mx.cpu(), grad_req="null",
+                        data=(2, 6), softmax_label=(2,))
+    b = sym.simple_bind(mx.cpu(), grad_req="null",
+                        data=(2, 6), softmax_label=(2,))
+    s = executor_cache.stats()
+    assert not s["enabled"]
+    assert s["misses"] == 2 and s["hits"] == 0 and s["entries"] == 0
+    assert a._prog is not b._prog
+
+
+def test_stats_shape():
+    """stats() exposes the documented counter keys."""
+    s = executor_cache.stats()
+    for k in ("hits", "misses", "evictions", "traces_fwd",
+              "traces_fwd_bwd", "traces_fused_step", "entries", "enabled"):
+        assert k in s
