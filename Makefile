@@ -26,7 +26,7 @@ chip:            ## serial accelerator tier (needs the real chip)
 bench:           ## throughput numbers of record (run on an IDLE host)
 	$(PY) bench.py
 
-bench-smoke:     ## executor-cache smoke: trace/cache counters, fails on recompile regressions
+bench-smoke:     ## exec-cache + observability smoke: dumps /tmp/mxnet_tpu_smoke_{trace,telemetry}.json, fails on recompile regressions (incl. telemetry on-vs-off)
 	$(PY) bench.py --smoke
 
 roofline:        ## kernel-class decomposition of the train step

@@ -536,6 +536,80 @@ def smoke():
     assert stats["traces_fwd"] == 2, stats
     assert stats["hits"] >= 3, stats
 
+    _smoke_observability(mx, ctx, rng, mlp)
+
+
+def _smoke_observability(mx, ctx, rng, mlp):
+    """Observability smoke: run the SAME 3-step fit twice — telemetry +
+    profiler off, then on — and assert the exec-cache trace counters are
+    identical (instrumentation adds zero recompiles).  The instrumented
+    pass dumps a Chrome trace and a telemetry snapshot to /tmp for
+    `python tools/traceview.py` / eyeballs."""
+    import os
+    from mxnet_tpu import executor_cache, profiler
+    from mxnet_tpu.observability import telemetry
+
+    trace_path = "/tmp/mxnet_tpu_smoke_trace.json"
+    telem_path = "/tmp/mxnet_tpu_smoke_telemetry.json"
+
+    def fit_once():
+        # drop the entries smoke() warmed (not just the stats): each
+        # pass must TRACE afresh, so an instrumentation regression that
+        # perturbs tracing shows up as a counter difference instead of
+        # being masked by cache hits
+        executor_cache.clear()
+        executor_cache.reset_stats()
+        from mxnet_tpu.io import NDArrayIter
+        it = NDArrayIter(rng.rand(24, 8).astype(np.float32),
+                         rng.randint(0, 4, (24,)).astype(np.float32),
+                         batch_size=8)
+        mod = mx.mod.Module(mlp(), context=ctx)
+        mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+        s = executor_cache.stats()
+        return {k: s[k] for k in ("traces_fwd", "traces_fwd_bwd",
+                                  "traces_fused_step")}
+
+    prev_env = os.environ.get("MXNET_TPU_TELEMETRY")
+    os.environ["MXNET_TPU_TELEMETRY"] = "0"
+    off = fit_once()
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    telemetry.reset()
+    profiler.profiler_set_config(mode="symbolic", filename=trace_path)
+    profiler.profiler_set_state("run")
+    on = fit_once()
+    profiler.profiler_set_state("stop")  # dumps the trace
+    with open(telem_path, "w") as f:
+        f.write(telemetry.to_json_lines())
+    if prev_env is None:
+        os.environ.pop("MXNET_TPU_TELEMETRY", None)
+    else:
+        os.environ["MXNET_TPU_TELEMETRY"] = prev_env
+
+    import importlib.util
+    tv_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_traceview", tv_path)
+    traceview = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(traceview)
+    breakdown = traceview.step_breakdown(
+        traceview.load_trace(trace_path).get("traceEvents", []))
+    print(json.dumps({
+        "metric": "bench_smoke_observability",
+        "trace": trace_path,
+        "telemetry": telem_path,
+        "trace_counters_off": off,
+        "trace_counters_on": on,
+        "step_coverage": round(breakdown["coverage"], 4)
+        if breakdown else None,
+        "starvation": round(breakdown["starvation"], 4)
+        if breakdown else None,
+    }))
+    # instrumentation must be invisible to the compiler: identical
+    # retrace counts with telemetry+tracing on vs off
+    assert on == off, (on, off)
+    assert breakdown is not None and breakdown["steps"] >= 3, breakdown
+    assert breakdown["coverage"] >= 0.9, breakdown
+
 
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight

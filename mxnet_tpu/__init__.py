@@ -56,6 +56,7 @@ from . import recordio
 from . import filesystem
 from . import log
 from . import misc
+from . import observability
 from . import profiler
 from . import engine
 from . import test_utils

@@ -165,6 +165,7 @@ class Executor:
         self._aux_stash_lost = False
         self._monitor_callback = None
         self._monitor_all = False
+        self._monitor_fallback_warned = False
 
         # process-wide program reuse (ref: CachedOp): identical
         # (graph, shapes, dtypes, grads) signatures share one traced
@@ -284,6 +285,18 @@ class Executor:
             # None head-grad entries mean ones_like(output) — outputs
             # only exist after a forward, so that form takes the
             # separate path
+            if self._monitor_callback is not None \
+                    and not self._monitor_fallback_warned:
+                # once per executor: the fused one-program dispatch has
+                # no tap points, so the monitor forces the separate
+                # uncompiled path (satisfying the tap, at a perf cost)
+                self._monitor_fallback_warned = True
+                import logging
+                logging.warning(
+                    "monitor callback installed: forward_backward is "
+                    "taking the separate tap-capable path (fused "
+                    "fwd-bwd program skipped while the monitor is "
+                    "active)")
             self.forward(is_train=is_train)
             if self._grad_names:
                 self.backward(out_grads=out_grads)

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import profiler as _profiler
+from .observability import telemetry as _telemetry
 
 _lock = threading.Lock()
 _entries = OrderedDict()  # key -> ProgramEntry, LRU order
@@ -90,17 +91,27 @@ def note_trace(kind):
     Called from INSIDE jitted function bodies: the body only executes
     when jax traces (first call per signature), so this counts real
     retraces.  Also used by module/fused_step.py for its step program.
+    A recompile is the single most important instant on a TPU timeline,
+    so it also lands as an "i" marker in the trace and increments the
+    registry counter (both emits run at trace time, on the host — they
+    cannot themselves change the program being traced).
     """
     with _lock:
         _stats["traces_" + kind] += 1
         value = _stats["traces_" + kind]
+    _telemetry.counter("exec_cache.traces_" + kind,
+                       help="real jax retraces of the %s program"
+                       % kind).inc()
     _profiler.record_counter("exec_cache_traces_" + kind, value)
+    _profiler.record_instant("recompile:" + kind, category="exec_cache",
+                             args={"total": value})
 
 
 def _note(event):
     with _lock:
         _stats[event] += 1
         value = _stats[event]
+    _telemetry.counter("exec_cache." + event).inc()
     _profiler.record_counter("exec_cache_" + event, value)
 
 
@@ -193,6 +204,7 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
         else:
             hits = None
     if entry is not None:
+        _telemetry.counter("exec_cache.hits").inc()
         _profiler.record_counter("exec_cache_hits", hits)
         return entry
     _note("misses")
@@ -204,9 +216,16 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
         if existing is not None:
             return existing
         _entries[key] = entry
+        evicted = 0
         while len(_entries) > _maxsize():
             _entries.popitem(last=False)
             _stats["evictions"] += 1
+            evicted += 1
+    if evicted:
+        _telemetry.counter("exec_cache.evictions").inc(evicted)
+        _profiler.record_instant("exec_cache_eviction",
+                                 category="exec_cache",
+                                 args={"evicted": evicted})
     return entry
 
 

@@ -10,6 +10,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 import queue as _queue
 from collections import namedtuple
 
@@ -18,6 +19,7 @@ import numpy as np
 from .base import MXNetError
 from .ndarray import NDArray, array
 from .context import cpu
+from .observability.instrument import note_io_wait
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -86,7 +88,14 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        # every for-loop/`next()` consumer funnels through here: time the
+        # wait so the telemetry registry can answer "is the step
+        # input-bound?" (io.next_batch_wait_ms histogram + the
+        # starvation ratio tools/traceview.py derives from step spans)
+        t0 = time.perf_counter()
+        batch = self.next()
+        note_io_wait(time.perf_counter() - t0)
+        return batch
 
     def iter_next(self):
         pass

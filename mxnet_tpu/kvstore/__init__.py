@@ -13,12 +13,14 @@ TPU-native rebuild of src/kvstore/ (§2.5 of SURVEY.md).  Backends:
 from __future__ import annotations
 
 import pickle
+import time
 
 import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros as nd_zeros
 from .. import optimizer as opt
+from ..observability.instrument import record_kv
 
 
 import jax
@@ -134,6 +136,7 @@ class KVStore:
                                       owner.jax_device()), ctx=owner)
 
     def push(self, key, value, priority=0):
+        t0 = time.perf_counter()
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             merged = self._reduce(v, key=k)
@@ -149,9 +152,11 @@ class KVStore:
                 self._updater(_updater_key(k), merged, stored)
             else:
                 merged.copyto(stored)
+        record_kv("push", value, time.perf_counter() - t0, self._type)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
+        t0 = time.perf_counter()
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             stored = self._stored[k]
@@ -159,6 +164,7 @@ class KVStore:
                 olist = [olist]
             for o in olist:
                 stored.copyto(o)
+        record_kv("pull", out, time.perf_counter() - t0, self._type)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (ref: kvstore.h row_sparse_pull —

@@ -2,12 +2,13 @@
 
 API parity with the reference module contract (python/mxnet/module/
 base_module.py) with this package's own training-loop construction: the
-epoch loop drives a one-batch *lookahead* generator so the next batch's
-host→device transfer (``prepare``) overlaps the current step — the same
-latency-hiding job the reference's ``next_data_batch`` juggling does, but
-expressed as an iterator adapter rather than inline state flags.
-Subclasses provide bind/forward/backward/update; Module's fused path
-collapses those into one jitted XLA program per step.
+epoch loop fetches the NEXT batch mid-step (one-batch *lookahead*) so
+its host→device transfer (``prepare``) overlaps the current step — the
+same latency-hiding job the reference's ``next_data_batch`` juggling
+does — and decomposes each step into instrumented components
+(observability.instrument.StepTracker).  Subclasses provide
+bind/forward/backward/update; Module's fused path collapses those into
+one jitted XLA program per step.
 """
 from __future__ import annotations
 
@@ -17,6 +18,7 @@ import time
 from .. import metric as metric_mod
 from ..context import cpu
 from ..initializer import Uniform
+from ..observability.instrument import StepTracker
 
 
 class BatchEndParam:
@@ -41,19 +43,6 @@ def _each_callback(callbacks, arg):
 
 def _as_list(obj):
     return obj if isinstance(obj, (list, tuple)) else [obj]
-
-
-def _lookahead(iterable):
-    """Yield (item, next_item-or-None) pairs, one element ahead."""
-    it = iter(iterable)
-    try:
-        current = next(it)
-    except StopIteration:
-        return
-    for upcoming in it:
-        yield current, upcoming
-        current = upcoming
-    yield current, None
 
 
 def _trim_pad(outputs, pad):
@@ -220,23 +209,47 @@ class BaseModule:
 
     def _run_epoch(self, epoch, train_data, eval_metric,
                    batch_end_callback, monitor):
-        """One pass over train_data: step on each batch, prefetch the next."""
+        """One pass over train_data: step on each batch, prefetch the next.
+
+        Each step is decomposed into the telemetry components
+        (data_wait / fwd_bwd_dispatch / update / metric / sync) as
+        nested profiler spans + registry histograms — the per-step
+        breakdown `tools/traceview.py` tabulates.  Same lookahead
+        contract as before: the NEXT batch is fetched mid-step so its
+        host->device transfer (``prepare``) overlaps this step."""
         tic = time.time()
         eval_metric.reset()
-        for nbatch, (batch, upcoming) in enumerate(_lookahead(train_data)):
+        tracker = StepTracker(epoch=epoch)
+        it = iter(train_data)
+        with tracker.component("data_wait"):
+            batch = next(it, None)
+        nbatch = 0
+        while batch is not None:
             if monitor is not None:
-                monitor.tic()
-            self.forward_backward(batch)
-            self.update()
+                with tracker.component("sync"):
+                    monitor.tic()
+            with tracker.component("fwd_bwd_dispatch"):
+                self.forward_backward(batch)
+            with tracker.component("update"):
+                self.update()
+            with tracker.component("data_wait"):
+                upcoming = next(it, None)
             if upcoming is not None:
                 # start the next batch's transfer while the step executes
-                self.prepare(upcoming)
-            self.update_metric(eval_metric, batch.label)
+                with tracker.component("sync"):
+                    self.prepare(upcoming)
+            with tracker.component("metric"):
+                self.update_metric(eval_metric, batch.label)
             if monitor is not None:
-                monitor.toc_print()
-            _each_callback(batch_end_callback, BatchEndParam(
-                epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                locals=locals()))
+                with tracker.component("sync"):
+                    monitor.toc_print()
+            with tracker.component("sync"):
+                _each_callback(batch_end_callback, BatchEndParam(
+                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                    locals=locals()))
+            tracker.step_end(nbatch)
+            batch = upcoming
+            nbatch += 1
         for name, val in eval_metric.get_name_value():
             self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
         self.logger.info("Epoch[%d] Time cost=%.3f",

@@ -298,11 +298,17 @@ class DataParallelExecutorGroup:
         training step of the north-star dispatch model."""
         assert self.for_training, \
             "re-bind with for_training=True to run backward"
-        _load_data(data_batch, self.data_arrays)
-        if self.label_arrays is not None and data_batch.label:
-            _load_label(data_batch, self.label_arrays)
-        for e in self.execs:
-            e.forward_backward(is_train=True)
+        from .. import profiler as _profiler
+        # batch upload + per-exec dispatch under one nested span (the
+        # per-exec executor_fwd_bwd spans become its children); the span
+        # is a no-op flag check while the profiler is stopped
+        with _profiler.record_span("exec_group_fwd_bwd",
+                                   category="symbolic"):
+            _load_data(data_batch, self.data_arrays)
+            if self.label_arrays is not None and data_batch.label:
+                _load_label(data_batch, self.label_arrays)
+            for e in self.execs:
+                e.forward_backward(is_train=True)
 
     def get_output_shapes(self):
         outputs = self.execs[0].outputs

@@ -426,7 +426,13 @@ class Module(BaseModule):
                 self._fused_step = None
                 self._fused_pending = False
             else:
-                self._fused_step.run(data_batch)
+                from .. import profiler as _profiler
+                # host-side span around the one-program dispatch
+                # (outside the jitted body: zero effect on tracing;
+                # no-op flag check while the profiler is stopped)
+                with _profiler.record_span("fused_train_step",
+                                           category="symbolic"):
+                    self._fused_step.run(data_batch)
                 self._fused_pending = True
                 self._params_dirty = True
                 return
@@ -434,6 +440,10 @@ class Module(BaseModule):
         # (executor_cache fused dispatch) instead of a forward plus a
         # recompute-forward vjp — half the dispatches, no double forward
         assert self.binded and self.params_initialized
+        # this dispatch did NOT apply an update: a stale pending flag
+        # (fused step retired between its forward_backward and update(),
+        # e.g. by install_monitor) must not eat the next update()
+        self._fused_pending = False
         self._rebind_for_batch(data_batch)
         self._exec_group.forward_backward(data_batch)
         # aux states advanced on device (BatchNorm moving stats):
@@ -444,10 +454,13 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if getattr(self, "_fused_pending", False):
+            # the matching fused forward_backward already applied this
+            # update — checked before the _fused_step test so the no-op
+            # survives the step being retired in between (install_monitor)
+            self._fused_pending = False
+            return
         if getattr(self, "_fused_step", None) is not None:
-            if self._fused_pending:
-                self._fused_pending = False  # applied in forward_backward
-                return
             # update() without a fused forward_backward: the caller drives
             # forward/backward explicitly — retire the fused path so there
             # is exactly one optimizer-state store (momentum carried over)
@@ -535,6 +548,20 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         self._exec_group.install_monitor(mon)
+        if getattr(self, "_fused_step", None) is not None:
+            # the fused one-program step has no per-op tap points — a
+            # monitor needs the uncompiled evaluate pass, so retire the
+            # fused path (optimizer state carries over to the updater)
+            self.logger.warning(
+                "monitor installed: leaving the fused train-step path for "
+                "the tap-capable separate-dispatch path (per-op stats "
+                "require the uncompiled monitor pass; expect slower steps "
+                "while the monitor is active)")
+            self._fused_step.transfer_to_updater(self._updater)
+            self._fused_step = None
+            # _fused_pending is left alone: a fused forward_backward that
+            # already applied its update must still turn the matching
+            # update() into a no-op (update() checks the flag first)
 
     def prepare(self, data_batch):
         pass

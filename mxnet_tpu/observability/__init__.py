@@ -1,0 +1,34 @@
+"""Unified runtime telemetry (ref: src/engine/profiler.{h,cc} §5.1 +
+the metrics/logging surface of §5.5, grown into a production shape).
+
+Three layers, lowest first:
+
+- ``tracing``   — the structured trace-event sink: nested spans with
+  parent/child links over a thread-local span stack, Chrome "X"
+  complete-events with real thread ids, instant events (recompiles,
+  evictions), counter samples.  ``mxnet_tpu.profiler`` is the
+  reference-compatible facade over this buffer.
+- ``telemetry`` — the process-wide metrics registry: named Counter /
+  Gauge / Histogram (fixed log2 buckets, no numpy in the hot path) with
+  ``snapshot()`` plus Prometheus-text and JSON-lines exporters.
+  ``MXNET_TPU_TELEMETRY=0`` hands out shared no-op instruments instead.
+- ``instrument`` — the hot-path helpers the framework itself uses: the
+  per-step breakdown tracker driving ``BaseModule.fit``
+  (data_wait / fwd_bwd_dispatch / update / metric / sync), the
+  input-starvation accounting behind ``io.DataIter``, kvstore push/pull
+  bytes+latency, and the device-memory gauge.
+
+Every callsite stays OUTSIDE jitted bodies: instrumentation must never
+change a traced program (the exec-cache trace counters prove it adds
+zero recompiles — ``make bench-smoke`` asserts exactly that).
+"""
+from __future__ import annotations
+
+from . import tracing
+from . import telemetry
+from . import instrument
+from .tracing import span, emit_instant
+from .telemetry import counter, gauge, histogram, snapshot
+
+__all__ = ["tracing", "telemetry", "instrument", "span", "emit_instant",
+           "counter", "gauge", "histogram", "snapshot"]

@@ -1,0 +1,288 @@
+"""Hot-path instrumentation used by the framework itself.
+
+Everything here runs on the host, OUTSIDE jitted bodies — adding or
+removing instrumentation must never change a traced program (the
+exec-cache trace counters in ``make bench-smoke`` hold that line).
+
+- ``StepTracker``: the per-step breakdown behind ``BaseModule.fit``.
+  Each training step decomposes into the five components a production
+  stack asks about first — ``data_wait`` (input starvation),
+  ``fwd_bwd_dispatch``, ``update``, ``metric``, ``sync`` — each emitted
+  as a child span of an enclosing ``step`` span and observed into
+  fixed-bucket histograms.  The step span's extent is [first component
+  start, last component end], so the components cover it up to pure
+  python glue.
+- ``note_io_wait``: every ``DataIter.__next__`` reports how long the
+  consumer waited for the batch (the numerator of the input-starvation
+  ratio ``tools/traceview.py`` prints).
+- ``record_kv``: kvstore push/pull bytes + latency.
+- ``sample_device_memory``: the live-bytes gauge, sampled every
+  ``MEM_SAMPLE_INTERVAL`` steps by the tracker (and on demand).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import telemetry
+from . import tracing
+
+# device-memory gauge sampling cadence, in training steps
+MEM_SAMPLE_INTERVAL = 10
+
+# tools/traceview.py carries an import-free pinned copy of this tuple —
+# keep the two in sync when adding a component
+STEP_COMPONENTS = ("data_wait", "fwd_bwd_dispatch", "update", "metric",
+                   "sync")
+
+
+class _NoopComponent:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CM = _NoopComponent()
+
+
+class _Component:
+    """Times one component occurrence; accumulates into the tracker and
+    emits a ``step:<name>`` child span when the profiler is recording."""
+
+    __slots__ = ("_tracker", "_name", "_t0")
+
+    def __init__(self, tracker, name):
+        self._tracker = tracker
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = tracing.now_us()
+        if self._tracker._step_t0 is None:
+            self._tracker._step_t0 = self._t0
+        return self
+
+    def __exit__(self, *exc):
+        t1 = tracing.now_us()
+        tracker = self._tracker
+        tracker._parts[self._name] += t1 - self._t0
+        tracker._last_end = t1
+        if tracing.is_recording():
+            tracing.emit_complete(
+                "step:" + self._name, self._t0, t1 - self._t0,
+                category="step", pid=tracker.pid,
+                args={"parent_id": tracker._step_span_id})
+        return False
+
+
+class StepTracker:
+    """Per-step breakdown over one epoch of a training loop.
+
+    Usage (the shape ``BaseModule._run_epoch`` drives)::
+
+        tracker = StepTracker(epoch=epoch)
+        with tracker.component("data_wait"):
+            batch = next(it)
+        with tracker.component("fwd_bwd_dispatch"):
+            module.forward_backward(batch)
+        ...
+        tracker.step_end(nbatch)
+
+    ``component`` calls may repeat within a step ("sync" does); the
+    durations accumulate.  ``step_end`` emits the enclosing ``step``
+    span (complete event spanning first-component-start to
+    last-component-end, with per-component millisecond args), feeds the
+    histograms, and samples the device-memory gauge every
+    ``MEM_SAMPLE_INTERVAL`` steps.
+    """
+
+    def __init__(self, epoch=0, pid="train"):
+        self.epoch = epoch
+        self.pid = pid
+        self._resolve_handles()
+        self._reset_step()
+
+    def _resolve_handles(self):
+        """(Re)fetch the registry instruments.  Keyed on the registry
+        epoch so a telemetry.reset() mid-epoch (snapshot-then-reset
+        scrape loops) re-registers instead of observing into orphaned
+        instruments — same contract as the io/kv handle caches."""
+        self._handle_key = (telemetry.registry_epoch(),
+                            telemetry.enabled())
+        # disabled telemetry hands back no-op instruments; component()
+        # then short-circuits entirely unless the profiler is recording
+        self._hists = {c: telemetry.histogram(
+            "module.step.%s_ms" % c,
+            help="per-step %s time" % c) for c in STEP_COMPONENTS}
+        self._hist_total = telemetry.histogram(
+            "module.step.total_ms", help="measured step wall time")
+        self._steps = telemetry.counter(
+            "module.steps", help="training steps observed")
+        self._mem_gauge = telemetry.gauge(
+            "device.live_bytes", help="live device memory (sampled)")
+        self._telemetry_on = self._hist_total is not telemetry.NOOP
+
+    def _reset_step(self):
+        self._parts = {c: 0.0 for c in STEP_COMPONENTS}
+        self._step_t0 = None
+        self._last_end = None
+        self._step_span_id = None
+
+    def component(self, name):
+        if not (self._telemetry_on or tracing.is_recording()):
+            # both sinks off: the whole step costs one flag check per
+            # component (the module's zero-cost-when-disabled contract)
+            return _NOOP_CM
+        if self._step_span_id is None:
+            # allocate the step's span id lazily at first component so
+            # children can link to a parent that is emitted after them
+            self._step_span_id = next(tracing._span_ids)
+        return _Component(self, name)
+
+    def step_end(self, nbatch):
+        if self._step_t0 is None:
+            return
+        if self._handle_key != (telemetry.registry_epoch(),
+                                telemetry.enabled()):
+            self._resolve_handles()
+        dur = self._last_end - self._step_t0
+        args = {"span_id": self._step_span_id, "step": nbatch,
+                "epoch": self.epoch}
+        for c in STEP_COMPONENTS:
+            ms = self._parts[c] / 1e3
+            args[c + "_ms"] = round(ms, 4)
+            self._hists[c].observe(ms)
+        self._hist_total.observe(dur / 1e3)
+        self._steps.inc()
+        if tracing.is_recording():
+            tracing.emit_complete("step", self._step_t0, dur,
+                                  category="step", pid=self.pid,
+                                  args=args)
+        if nbatch % MEM_SAMPLE_INTERVAL == 0 \
+                and (self._telemetry_on or tracing.is_recording()):
+            # jax.live_arrays() is O(live arrays) — never pay it when
+            # nobody is listening
+            sample_device_memory(self._mem_gauge)
+        self._reset_step()
+
+
+def sample_device_memory(gauge=None):
+    """Total live device bytes: the backend allocator's view when it
+    has one (``Device.memory_stats`` on TPU), else the sum over jax's
+    live arrays.  Sets the ``device.live_bytes`` gauge, drops a counter
+    sample onto the trace timeline, and returns the byte count."""
+    total = 0
+    try:
+        import jax
+        stats_seen = False
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if stats and "bytes_in_use" in stats:
+                total += int(stats["bytes_in_use"])
+                stats_seen = True
+        if not stats_seen:
+            total = sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+    except Exception:
+        return 0
+    if gauge is None:
+        gauge = telemetry.gauge("device.live_bytes",
+                                help="live device memory (sampled)")
+    gauge.set(total)
+    tracing.emit_counter("device_live_bytes", total, category="memory")
+    return total
+
+
+# per-batch handles, memoized against the registry epoch + enabled flag
+# so the io hot path skips the registry lock (and telemetry.reset() in
+# tests still invalidates the cache)
+_io_cache = (None, None)
+
+
+def note_io_wait(seconds):
+    """One next-batch wait observed by a DataIter consumer (pooled
+    across iterators — the starvation question is per-process)."""
+    global _io_cache
+    key = (telemetry.registry_epoch(), telemetry.enabled())
+    cached_key, handles = _io_cache
+    if cached_key != key:
+        handles = (
+            telemetry.histogram("io.next_batch_wait_ms",
+                                help="time blocked waiting for a batch"),
+            telemetry.counter("io.batches",
+                              help="batches produced by DataIters"),
+            telemetry.counter("io.next_batch_wait_total_ms",
+                              help="cumulative next-batch wait"))
+        _io_cache = (key, handles)
+    hist, n_batches, total = handles
+    ms = seconds * 1e3
+    hist.observe(ms)
+    n_batches.inc()
+    total.inc(ms)
+
+
+# push/pull handles, memoized per op against the registry epoch +
+# enabled flag (kvstore traffic is per key-batch per step — same
+# registry-lock-avoidance as the io cache above)
+_kv_cache = (None, {})
+
+
+def _kv_handles(op):
+    global _kv_cache
+    key = (telemetry.registry_epoch(), telemetry.enabled())
+    cached_key, by_op = _kv_cache
+    if cached_key != key:
+        by_op = {}
+        _kv_cache = (key, by_op)
+    handles = by_op.get(op)
+    if handles is None:
+        handles = (
+            telemetry.counter("kvstore.%s_bytes" % op,
+                              help="payload bytes moved by %s" % op),
+            telemetry.histogram("kvstore.%s_ms" % op,
+                                help="%s wall latency" % op))
+        by_op[op] = handles
+    return handles
+
+
+def record_kv(op, payload, seconds, store_type):
+    """One kvstore push/pull: payload bytes + wall latency.  Takes the
+    raw payload (NDArray / nested lists) and only walks its shapes when
+    a sink is actually listening."""
+    if not (telemetry.enabled() or tracing.is_recording()):
+        return
+    nbytes = payload_nbytes(payload)
+    ms = seconds * 1e3
+    bytes_counter, latency_hist = _kv_handles(op)
+    bytes_counter.inc(nbytes)
+    latency_hist.observe(ms)
+    if tracing.is_recording():
+        t1 = tracing.now_us()
+        tracing.emit_complete("kvstore_" + op, t1 - seconds * 1e6,
+                              seconds * 1e6, category="kvstore",
+                              args={"bytes": nbytes,
+                                    "store": store_type})
+
+
+def payload_nbytes(value):
+    """Total bytes of an NDArray / nested list-of-NDArrays payload
+    (host-side metadata walk; no device sync)."""
+    total = 0
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+            continue
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        dtype = getattr(v, "dtype", None)
+        try:
+            itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        except TypeError:
+            itemsize = 4
+        total += n * itemsize
+    return total

@@ -1,0 +1,332 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram.
+
+The runtime analog of the reference's ad-hoc logging counters, unified
+the way a production stack expects: every subsystem registers named
+instruments here (``exec_cache.hits``, ``module.step.data_wait_ms``,
+``kvstore.push_bytes``, ``device.live_bytes``, ...), and one snapshot
+answers "what has this process been doing" in either Prometheus text or
+JSON-lines form.
+
+Design constraints, enforced here rather than hoped for:
+
+- **No numpy in the hot path.**  Histogram bucketing is pure-python
+  ``math.frexp`` arithmetic over fixed log2 bucket bounds — observing a
+  value is two float ops and a list increment.
+- **Zero-cost when disabled.**  With ``MXNET_TPU_TELEMETRY=0`` the
+  factories hand back one shared no-op instrument whose methods do
+  nothing, so instrumented code keeps a single unconditional call.
+- **Thread-safe.**  One registry lock guards creation; instrument
+  updates touch only their own fields (CPython-atomic appends/adds
+  guarded by the instrument's own lock where a read-modify-write needs
+  it).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+
+_ENV = "MXNET_TPU_TELEMETRY"
+
+# log2 bucket bounds for histograms: 2**k for k in [_K_MIN, _K_MAX],
+# plus a +Inf overflow bucket.  In milliseconds that spans ~1µs to ~17min
+# — every latency this framework measures fits with fixed, comparable
+# bounds (the reference's OprExecStat kept raw pairs; fixed buckets keep
+# the registry O(1) per observation and mergeable across processes).
+_K_MIN = -10
+_K_MAX = 20
+BUCKET_BOUNDS = tuple(2.0 ** k for k in range(_K_MIN, _K_MAX + 1))
+
+_lock = threading.Lock()
+_metrics = {}  # name -> instrument
+_epoch = 0     # bumped by reset(); invalidates cached instrument handles
+
+
+def enabled():
+    """Telemetry is on unless MXNET_TPU_TELEMETRY=0 (read per factory
+    call so tests and tools can flip it without a process restart)."""
+    return os.environ.get(_ENV, "1") != "0"
+
+
+class Counter:
+    """Monotonically increasing named value (float-valued: byte and
+    millisecond totals accumulate here too)."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease" % self.name)
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def _snapshot(self):
+        return {"type": self.kind, "value": self._value}
+
+
+class Gauge:
+    """Last-written value, or a live callback (``set_function``) sampled
+    at snapshot time — the device-memory gauge uses the latter."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_value", "_fn")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._fn = None
+
+    def set(self, value):
+        self._value = float(value)
+
+    def set_function(self, fn):
+        """Snapshot calls ``fn()`` for the live value (errors fall back
+        to the last set() value rather than poisoning the snapshot)."""
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                self._value = float(self._fn())
+            except Exception:
+                pass
+        return self._value
+
+    def _snapshot(self):
+        return {"type": self.kind, "value": self.value}
+
+
+class Histogram:
+    """Fixed log2-bucket histogram: counts per power-of-two upper bound
+    plus sum/count/min/max.  ``observe`` is numpy-free and O(1)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "_lock", "buckets", "sum", "count",
+                 "min", "max")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self.buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # +1 overflow
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    @staticmethod
+    def _bucket_index(value):
+        if value <= BUCKET_BOUNDS[0]:
+            return 0
+        # frexp gives value = m * 2**e with 0.5 <= m < 1, so
+        # ceil(log2(value)) is e unless value is an exact power of two
+        # (m == 0.5), where it is e-1 — no libm log in the hot path.
+        m, e = math.frexp(value)
+        k = e - 1 if m == 0.5 else e
+        if k > _K_MAX:
+            return len(BUCKET_BOUNDS)  # overflow bucket
+        return k - _K_MIN
+
+    def observe(self, value):
+        value = float(value)
+        idx = self._bucket_index(value) if value > 0 else 0
+        with self._lock:
+            self.buckets[idx] += 1
+            self.sum += value
+            self.count += 1
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def _snapshot(self):
+        with self._lock:
+            return {"type": self.kind, "count": self.count,
+                    "sum": self.sum,
+                    "min": self.min if self.count else None,
+                    "max": self.max if self.count else None,
+                    "buckets": list(self.buckets)}
+
+
+class _Noop:
+    """The shared disabled instrument: every method is a no-op, every
+    factory returns this same object, so disabled telemetry costs one
+    attribute call per site and allocates nothing."""
+
+    kind = "noop"
+    name = "<disabled>"
+    value = 0.0
+    count = 0
+    sum = 0.0
+    mean = 0.0
+
+    def inc(self, amount=1):
+        pass
+
+    def set(self, value):
+        pass
+
+    def set_function(self, fn):
+        pass
+
+    def observe(self, value):
+        pass
+
+
+NOOP = _Noop()
+
+
+def _get(name, cls, help):
+    if not enabled():
+        return NOOP
+    with _lock:
+        metric = _metrics.get(name)
+        if metric is None:
+            metric = cls(name, help=help)
+            _metrics[name] = metric
+        elif not isinstance(metric, cls):
+            raise TypeError("metric %r already registered as %s, not %s"
+                            % (name, metric.kind, cls.kind))
+        return metric
+
+
+def counter(name, help=""):
+    """Get-or-create the named Counter (no-op handle when disabled)."""
+    return _get(name, Counter, help)
+
+
+def gauge(name, help=""):
+    """Get-or-create the named Gauge (no-op handle when disabled)."""
+    return _get(name, Gauge, help)
+
+
+def histogram(name, help=""):
+    """Get-or-create the named Histogram (no-op handle when disabled)."""
+    return _get(name, Histogram, help)
+
+
+def reset():
+    """Drop every registered metric (tests / between bench passes).
+    Bumps the registry epoch so cached handles re-resolve."""
+    global _epoch
+    with _lock:
+        _metrics.clear()
+        _epoch += 1
+
+
+def registry_epoch():
+    """Cache-invalidation key for callers that memoize handles: changes
+    whenever reset() drops the registry."""
+    return _epoch
+
+
+def snapshot():
+    """{name: {type, ...}} over every registered instrument, values
+    read at call time (function gauges sample their callback)."""
+    with _lock:
+        items = list(_metrics.items())
+    return {name: m._snapshot() for name, m in sorted(items)}
+
+
+# -- exporters ---------------------------------------------------------------
+
+def _prom_name(name):
+    """Prometheus metric names allow [a-zA-Z0-9_:]; dots become
+    underscores (mxnet_tpu namespace prefixed once)."""
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return "mxnet_tpu_" + safe
+
+
+def to_prometheus():
+    """Prometheus text exposition of the current snapshot."""
+    lines = []
+    for name, snap in snapshot().items():
+        pname = _prom_name(name)
+        if snap["type"] in ("counter", "gauge"):
+            lines.append("# TYPE %s %s" % (pname, snap["type"]))
+            lines.append("%s %s" % (pname, _fmt(snap["value"])))
+            continue
+        lines.append("# TYPE %s histogram" % pname)
+        cumulative = 0
+        for bound, n in zip(BUCKET_BOUNDS, snap["buckets"]):
+            cumulative += n
+            lines.append('%s_bucket{le="%s"} %d'
+                         % (pname, _fmt(bound), cumulative))
+        cumulative += snap["buckets"][-1]
+        lines.append('%s_bucket{le="+Inf"} %d' % (pname, cumulative))
+        lines.append("%s_sum %s" % (pname, _fmt(snap["sum"])))
+        lines.append("%s_count %d" % (pname, snap["count"]))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(x):
+    """Shortest faithful number text (counters are often whole).
+    Non-finite values use the Prometheus exposition literals — one
+    ``observe(nan)`` (a diverged loss, say) must not take the whole
+    scrape down."""
+    f = float(x)
+    if not math.isfinite(f):
+        return "NaN" if math.isnan(f) else ("+Inf" if f > 0 else "-Inf")
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+# strict JSON has no literals for non-finite floats; the exporters use
+# these string tokens in the numeric snapshot fields instead (and
+# parse_json_lines restores the floats)
+_JSON_NUMERIC_KEYS = ("value", "sum", "min", "max")
+_NONFINITE_TOKENS = {"NaN": float("nan"), "Infinity": float("inf"),
+                     "-Infinity": float("-inf")}
+
+
+def _json_safe(snap):
+    out = dict(snap)
+    for k in _JSON_NUMERIC_KEYS:
+        v = out.get(k)
+        if isinstance(v, float) and not math.isfinite(v):
+            out[k] = ("NaN" if math.isnan(v)
+                      else "Infinity" if v > 0 else "-Infinity")
+    return out
+
+
+def to_json_lines():
+    """One JSON object per metric per line: {"name", "type", ...} —
+    the structured-log form of the same snapshot.  Strict JSON output:
+    non-finite floats become string tokens (see ``_NONFINITE_TOKENS``)."""
+    return "\n".join(
+        json.dumps(dict(_json_safe(snap), name=name), sort_keys=True,
+                   allow_nan=False)
+        for name, snap in snapshot().items()) + "\n"
+
+
+def parse_json_lines(text):
+    """Inverse of ``to_json_lines``: {name: {type, ...}} — exists so the
+    export round-trips losslessly (asserted in tests)."""
+    out = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        obj = json.loads(line)
+        for k in _JSON_NUMERIC_KEYS:
+            v = obj.get(k)
+            if isinstance(v, str) and v in _NONFINITE_TOKENS:
+                obj[k] = _NONFINITE_TOKENS[v]
+        out[obj.pop("name")] = obj
+    return out

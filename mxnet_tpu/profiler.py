@@ -1,21 +1,33 @@
 """Profiler (ref: src/engine/profiler.{h,cc} + python/mxnet/profiler.py).
 
-Two layers, like the reference:
-- op-span layer: our own events (imperative invokes, executor forwards)
-  dumped as Chrome trace-event JSON (chrome://tracing), format-compatible
-  with the reference's DumpProfile (profiler.cc:147).
+Reference-compatible facade over ``mxnet_tpu.observability.tracing``:
+
+- op-span layer: framework events (imperative invokes, executor
+  dispatches, engine pipeline stages, per-step breakdown) recorded as
+  nested Chrome "X" complete-events with real thread ids and
+  parent/child span links, dumped as Chrome trace-event JSON
+  (chrome://tracing / Perfetto), format-compatible with the reference's
+  DumpProfile (profiler.cc:147).  The old B/E pair encoding collided on
+  nested or concurrent same-name spans (one ``open_ts`` slot per name —
+  re-entry silently overwrote it); complete events carry their own
+  ``dur`` so ``aggregate_stats`` cannot be corrupted.
 - device layer: jax.profiler XPlane traces for kernel-level detail
   (start_jax_trace/stop_jax_trace).
+
+``MXNET_TPU_PROFILER_AUTOSTART=1`` starts recording at import and dumps
+at interpreter exit (parity: MXNET_PROFILER_AUTOSTART, profiler.cc's
+autostart), so a run can be traced without touching its code.
 """
 from __future__ import annotations
 
+import atexit
 import json
+import os
 import threading
-import time
 
-_state = {"mode": "symbolic", "filename": "profile.json", "running": False}
-_events = []
-_lock = threading.Lock()
+from .observability import tracing as _tracing
+
+_state = {"mode": "symbolic", "filename": "profile.json"}
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -25,14 +37,14 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 def profiler_set_state(state="stop"):
     if state == "run":
-        _state["running"] = True
+        _tracing.set_recording(True)
     else:
-        _state["running"] = False
+        _tracing.set_recording(False)
         dump_profile()
 
 
 def is_running():
-    return _state["running"]
+    return _tracing.is_recording()
 
 
 def op_spans_enabled():
@@ -43,14 +55,15 @@ def op_spans_enabled():
 
 
 def record_event(name, start_us, end_us, category="operator", dev="cpu/0",
-                 tid=0):
-    if not _state["running"]:
-        return
-    with _lock:
-        _events.append({"name": name, "cat": category, "ph": "B",
-                        "ts": start_us, "pid": dev, "tid": tid})
-        _events.append({"name": name, "cat": category, "ph": "E",
-                        "ts": end_us, "pid": dev, "tid": tid})
+                 tid=None):
+    """One completed span with known endpoints — a Chrome "X" event.
+    ``tid`` defaults to the REAL calling thread id (the old hardcoded 0
+    merged every thread onto one track and collided concurrent
+    same-name spans in ``aggregate_stats``)."""
+    if tid in (None, 0):
+        tid = threading.get_ident()
+    _tracing.emit_complete(name, start_us, end_us - start_us,
+                           category=category, pid=dev, tid=tid)
 
 
 def record_counter(name, value, category="exec_cache", dev="cpu/0"):
@@ -58,61 +71,69 @@ def record_counter(name, value, category="exec_cache", dev="cpu/0"):
     executor program cache to surface hit/miss/trace counts on the same
     timeline as the execution spans (chrome://tracing renders counters
     as a stacked track)."""
-    if not _state["running"]:
-        return
-    with _lock:
-        _events.append({"name": name, "cat": category, "ph": "C",
-                        "ts": time.time() * 1e6, "pid": dev, "tid": 0,
-                        "args": {"value": value}})
+    _tracing.emit_counter(name, value, category=category, pid=dev)
 
 
-class record_span:
-    def __init__(self, name, category="operator", dev="cpu/0"):
-        self.name = name
-        self.category = category
-        self.dev = dev
+def record_instant(name, category="runtime", dev="cpu/0", args=None):
+    """A point-in-time marker ("ph": "i") — recompiles, cache evictions,
+    and other events with no duration."""
+    _tracing.emit_instant(name, category=category, pid=dev, args=args)
 
-    def __enter__(self):
-        self.t0 = time.time() * 1e6
-        return self
 
-    def __exit__(self, *args):
-        record_event(self.name, self.t0, time.time() * 1e6, self.category,
-                     self.dev)
+class record_span(_tracing.span):
+    """Nested-span context manager (legacy signature).  Spans started on
+    the same thread nest via the thread-local span stack and link to
+    their parent; the emitted event is a complete ("X") event."""
+
+    def __init__(self, name, category="operator", dev="cpu/0", args=None):
+        super().__init__(name, category=category, pid=dev, args=args)
 
 
 def dump_profile():
     """Write Chrome trace-event JSON (ref: DumpProfile profiler.cc:147)."""
-    with _lock:
-        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
-        with open(_state["filename"], "w") as f:
-            json.dump(payload, f)
+    payload = {"traceEvents": _tracing.snapshot_events(),
+               "displayTimeUnit": "ms"}
+    dropped = _tracing.dropped_events()
+    if dropped:
+        # the buffer cap fired: say so in the artifact itself
+        payload["otherData"] = {"dropped_events": dropped}
+    with open(_state["filename"], "w") as f:
+        json.dump(payload, f)
 
 
 def aggregate_stats(_events_snapshot=None):
     """Per-name aggregate statistics over the recorded spans:
     name -> dict(count, total_ms, min_ms, max_ms, avg_ms), per category
-    (ref: AggregateStats — MXAggregateProfileStatsPrint's table)."""
-    if _events_snapshot is not None:
-        events = _events_snapshot
-    else:
-        with _lock:
-            events = list(_events)
-    open_ts = {}
+    (ref: AggregateStats — MXAggregateProfileStatsPrint's table).
+
+    Understands both encodings: "X" complete-events (the native form)
+    and legacy "B"/"E" pairs, which pair LIFO per (cat, name, tid, pid)
+    so nested same-name spans aggregate correctly instead of
+    overwriting each other's open timestamp."""
+    events = _events_snapshot if _events_snapshot is not None \
+        else _tracing.snapshot_events()
+    open_ts = {}  # key -> [ts, ...] stack (legacy B/E pairing)
     stats = {}
+
+    def add(cat, name, dur_ms):
+        s = stats.setdefault((cat, name), {
+            "count": 0, "total_ms": 0.0, "min_ms": float("inf"),
+            "max_ms": 0.0})
+        s["count"] += 1
+        s["total_ms"] += dur_ms
+        s["min_ms"] = min(s["min_ms"], dur_ms)
+        s["max_ms"] = max(s["max_ms"], dur_ms)
+
     for e in events:
-        key = (e["cat"], e["name"], e["tid"], e["pid"])
-        if e["ph"] == "B":
-            open_ts[key] = e["ts"]
-        elif e["ph"] == "E" and key in open_ts:
-            dur_ms = (e["ts"] - open_ts.pop(key)) / 1e3
-            s = stats.setdefault((e["cat"], e["name"]), {
-                "count": 0, "total_ms": 0.0, "min_ms": float("inf"),
-                "max_ms": 0.0})
-            s["count"] += 1
-            s["total_ms"] += dur_ms
-            s["min_ms"] = min(s["min_ms"], dur_ms)
-            s["max_ms"] = max(s["max_ms"], dur_ms)
+        ph = e.get("ph")
+        if ph == "X":
+            add(e["cat"], e["name"], e.get("dur", 0.0) / 1e3)
+            continue
+        key = (e["cat"], e["name"], e.get("tid"), e.get("pid"))
+        if ph == "B":
+            open_ts.setdefault(key, []).append(e["ts"])
+        elif ph == "E" and open_ts.get(key):
+            add(e["cat"], e["name"], (e["ts"] - open_ts[key].pop()) / 1e3)
     out = {}
     for (cat, name), s in stats.items():
         out.setdefault(cat, {})[name] = dict(
@@ -126,10 +147,7 @@ def dumps(reset=False, sort_by="total_ms"):
     event buffer out, so spans recorded concurrently land in the NEXT
     window instead of being silently dropped."""
     if reset:
-        with _lock:
-            snapshot = list(_events)
-            _events.clear()
-        agg = aggregate_stats(snapshot)
+        agg = aggregate_stats(_tracing.swap_events())
     else:
         agg = aggregate_stats()
     lines = []
@@ -157,3 +175,17 @@ def start_jax_trace(logdir="/tmp/mxnet_tpu_trace"):
 def stop_jax_trace():
     import jax
     jax.profiler.stop_trace()
+
+
+def _autostart_dump():
+    """atexit hook: a run autostarted by env gets its dump even if it
+    never calls profiler_set_state('stop') itself."""
+    if is_running():
+        profiler_set_state("stop")
+
+
+if os.environ.get("MXNET_TPU_PROFILER_AUTOSTART") == "1":
+    # parity: MXNET_PROFILER_AUTOSTART starts the profiler before any
+    # user code runs and dumps at process exit (profiler.cc autostart)
+    profiler_set_state("run")
+    atexit.register(_autostart_dump)

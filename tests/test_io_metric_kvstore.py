@@ -245,7 +245,11 @@ def test_profiler_records_op_and_symbolic_spans(tmp_path):
     names = {e["name"] for e in trace["traceEvents"]}
     assert any("mul" in n or "plus" in n or "_mul_scalar" in n for n in names), names
     assert "executor_forward" in names
-    # begin/end pairs per event
+    # spans are complete ("X") events carrying their own duration (and
+    # any legacy B/E pairs must balance)
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert spans and all("ts" in e and e.get("dur", -1) >= 0
+                         for e in spans)
     phases = [e["ph"] for e in trace["traceEvents"]]
     assert phases.count("B") == phases.count("E")
 

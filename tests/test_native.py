@@ -641,7 +641,9 @@ def test_engine_ops_appear_in_profiler_trace(tmp_path):
 
     with open(fname) as f:
         trace = json.load(f)
-    events = [e for e in trace["traceEvents"] if e.get("ph") == "B"]
+    # spans are "X" complete-events (nested-span encoding); legacy "B"
+    # begin-events also accepted for old dumps
+    events = [e for e in trace["traceEvents"] if e.get("ph") in ("B", "X")]
     names = {e["name"] for e in events}
     cats = {e.get("cat") for e in events}
     assert "engine_decode_augment" in names, names

@@ -1,0 +1,443 @@
+"""Unified runtime telemetry: metrics registry, structured tracing,
+per-step breakdown, and the instrumented hot paths (io / kvstore /
+exec-cache / Speedometer / Monitor fallback)."""
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.observability import telemetry, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Each test gets a fresh registry and a stopped, empty tracer."""
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+    yield
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="obs_fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="obs_relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="obs_fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _iter(n=24, bs=8, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return mx.io.NDArrayIter(rng.rand(n, dim).astype(np.float32),
+                             rng.randint(0, 4, (n,)).astype(np.float32),
+                             batch_size=bs)
+
+
+# -- metrics registry --------------------------------------------------------
+
+def test_counter_gauge_histogram_snapshot():
+    c = telemetry.counter("t.hits")
+    c.inc()
+    c.inc(4)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = telemetry.gauge("t.depth")
+    g.set(3.5)
+    h = telemetry.histogram("t.lat_ms")
+    for v in (0.25, 1.0, 1.5, 900.0):
+        h.observe(v)
+    snap = telemetry.snapshot()
+    assert snap["t.hits"] == {"type": "counter", "value": 5.0}
+    assert snap["t.depth"]["value"] == 3.5
+    hs = snap["t.lat_ms"]
+    assert hs["count"] == 4 and hs["min"] == 0.25 and hs["max"] == 900.0
+    assert sum(hs["buckets"]) == 4
+    # same name returns the same instrument; a kind clash raises
+    assert telemetry.counter("t.hits") is c
+    with pytest.raises(TypeError):
+        telemetry.gauge("t.hits")
+
+
+def test_histogram_log2_bucket_edges():
+    h = telemetry.histogram("t.edges")
+    # 2.0 is an exact power of two: it must land in the le=2 bucket,
+    # 2.0001 in the le=4 bucket (the frexp edge case)
+    h.observe(2.0)
+    h.observe(2.0001)
+    snap = telemetry.snapshot()["t.edges"]
+    idx2 = telemetry.BUCKET_BOUNDS.index(2.0)
+    assert snap["buckets"][idx2] == 1
+    assert snap["buckets"][idx2 + 1] == 1
+
+
+def test_gauge_callback_sampled_at_snapshot():
+    g = telemetry.gauge("t.live")
+    g.set_function(lambda: 42)
+    assert telemetry.snapshot()["t.live"]["value"] == 42.0
+
+
+def test_prometheus_and_json_exports_round_trip():
+    telemetry.counter("exec.hits").inc(3)
+    telemetry.gauge("mem.bytes").set(1024)
+    h = telemetry.histogram("step.ms")
+    h.observe(1.5)
+    h.observe(3.0)
+    prom = telemetry.to_prometheus()
+    assert "# TYPE mxnet_tpu_exec_hits counter" in prom
+    assert "mxnet_tpu_exec_hits 3" in prom
+    assert "mxnet_tpu_mem_bytes 1024" in prom
+    assert 'mxnet_tpu_step_ms_bucket{le="+Inf"} 2' in prom
+    assert "mxnet_tpu_step_ms_count 2" in prom
+    # cumulative bucket counts never decrease
+    counts = [int(line.rsplit(" ", 1)[1]) for line in prom.splitlines()
+              if line.startswith("mxnet_tpu_step_ms_bucket")]
+    assert counts == sorted(counts)
+    # JSON-lines round-trips losslessly
+    assert telemetry.parse_json_lines(telemetry.to_json_lines()) == \
+        telemetry.snapshot()
+
+
+def test_exporters_survive_non_finite_values():
+    # one observe(nan) (a diverged loss) must not take the scrape down
+    telemetry.gauge("t.inf").set(float("inf"))
+    telemetry.gauge("t.neg").set(float("-inf"))
+    telemetry.histogram("t.poisoned").observe(float("nan"))
+    prom = telemetry.to_prometheus()
+    assert "mxnet_tpu_t_inf +Inf" in prom
+    assert "mxnet_tpu_t_neg -Inf" in prom
+    assert "mxnet_tpu_t_poisoned_sum NaN" in prom
+    # strict JSON: every line parses with a non-finite-rejecting parser
+    jl = telemetry.to_json_lines()
+    for line in jl.splitlines():
+        json.loads(line, parse_constant=lambda s: pytest.fail(
+            "non-standard JSON token %r in export" % s))
+    rt = telemetry.parse_json_lines(jl)
+    assert rt["t.inf"]["value"] == float("inf")
+    assert rt["t.neg"]["value"] == float("-inf")
+    assert math.isnan(rt["t.poisoned"]["sum"])
+
+
+def test_disabled_telemetry_hands_out_noop(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TELEMETRY", "0")
+    c = telemetry.counter("t.off")
+    g = telemetry.gauge("t.off.g")
+    h = telemetry.histogram("t.off.h")
+    # one shared no-op instrument, nothing registered, writes vanish
+    assert c is g is h is telemetry.NOOP
+    c.inc()
+    g.set(5)
+    h.observe(1.0)
+    assert telemetry.snapshot() == {}
+
+
+# -- structured tracing ------------------------------------------------------
+
+def test_trace_dump_valid_chrome_json_nested_spans(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="symbolic", filename=fname)
+    profiler.profiler_set_state("run")
+    with tracing.span("outer", category="t"):
+        with tracing.span("inner", category="t"):
+            pass
+        with tracing.span("inner", category="t"):  # same-name sibling
+            pass
+    profiler.profiler_set_state("stop")
+    with open(fname) as f:
+        doc = json.load(f)
+    evs = [e for e in doc["traceEvents"] if e.get("cat") == "t"]
+    assert all(e["ph"] == "X" for e in evs)
+    assert all(e["tid"] == threading.get_ident() for e in evs)
+    outer = next(e for e in evs if e["name"] == "outer")
+    inners = [e for e in evs if e["name"] == "inner"]
+    assert len(inners) == 2
+    for e in inners:
+        # strict nesting: child interval within parent, linked by id
+        assert e["args"]["parent_id"] == outer["args"]["span_id"]
+        assert e["ts"] >= outer["ts"]
+        assert e["ts"] + e["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_aggregate_stats_survives_reentrant_same_name_spans():
+    """The old B/E encoding kept ONE open timestamp per name — nested
+    re-entry overwrote it and corrupted the aggregate.  Both encodings
+    must now count every span exactly once."""
+    tracing.set_recording(True)
+    with profiler.record_span("op"):
+        with profiler.record_span("op"):
+            pass
+    # legacy B/E pairs, nested same-name (LIFO pairing)
+    for ph, ts in (("B", 0.0), ("B", 100.0), ("E", 300.0), ("E", 1000.0)):
+        tracing.emit({"name": "legacy", "cat": "operator", "ph": ph,
+                      "ts": ts, "pid": "cpu/0", "tid": 1})
+    tracing.set_recording(False)
+    agg = profiler.aggregate_stats()["operator"]
+    assert agg["op"]["count"] == 2
+    assert agg["legacy"]["count"] == 2
+    assert agg["legacy"]["total_ms"] == pytest.approx(1.2)  # 0.2 + 1.0
+    assert agg["legacy"]["max_ms"] == pytest.approx(1.0)
+
+
+def test_record_event_uses_real_tid_and_complete_events():
+    tracing.set_recording(True)
+    profiler.record_event("evt", 10.0, 250.0, category="c")
+    tracing.set_recording(False)
+    (e,) = [e for e in tracing.snapshot_events() if e["name"] == "evt"]
+    assert e["ph"] == "X" and e["dur"] == pytest.approx(240.0)
+    assert e["tid"] == threading.get_ident()
+
+
+def test_instant_and_counter_events():
+    tracing.set_recording(True)
+    profiler.record_instant("recompile:test", category="exec_cache")
+    profiler.record_counter("c", 7)
+    tracing.set_recording(False)
+    evs = tracing.snapshot_events()
+    assert any(e["ph"] == "i" and e["name"] == "recompile:test"
+               for e in evs)
+    assert any(e["ph"] == "C" and e["args"]["value"] == 7 for e in evs)
+
+
+def test_profiler_autostart_env(monkeypatch, tmp_path):
+    """MXNET_TPU_PROFILER_AUTOSTART=1 starts recording at import time
+    (module re-exec stands in for a fresh process)."""
+    monkeypatch.setenv("MXNET_TPU_PROFILER_AUTOSTART", "1")
+    importlib.reload(profiler)
+    try:
+        assert profiler.is_running()
+        profiler.profiler_set_config(filename=str(tmp_path / "auto.json"))
+        profiler.profiler_set_state("stop")
+        assert (tmp_path / "auto.json").exists()
+    finally:
+        monkeypatch.delenv("MXNET_TPU_PROFILER_AUTOSTART")
+        importlib.reload(profiler)
+        tracing.set_recording(False)
+
+
+# -- per-step breakdown ------------------------------------------------------
+
+def _fit_traced(tmp_path, monitor=None, **fit_kwargs):
+    fname = str(tmp_path / "fit_trace.json")
+    profiler.profiler_set_config(mode="symbolic", filename=fname)
+    profiler.profiler_set_state("run")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=1, monitor=monitor,
+            optimizer_params={"learning_rate": 0.1}, **fit_kwargs)
+    profiler.profiler_set_state("stop")
+    with open(fname) as f:
+        return mod, json.load(f)
+
+
+def test_step_breakdown_covers_step_time(tmp_path):
+    _, doc = _fit_traced(tmp_path)
+    evs = doc["traceEvents"]
+    steps = [e for e in evs if e["ph"] == "X" and e["name"] == "step"]
+    assert len(steps) == 3  # 24 samples / batch 8
+    for step in steps:
+        kids = [e for e in evs if e["ph"] == "X"
+                and e["name"].startswith("step:")
+                and e.get("args", {}).get("parent_id")
+                == step["args"]["span_id"]]
+        names = {e["name"] for e in kids}
+        assert {"step:data_wait", "step:fwd_bwd_dispatch", "step:update",
+                "step:metric", "step:sync"} <= names
+        covered = sum(e["dur"] for e in kids)
+        # components are contiguous measured intervals inside the step
+        # span — only python glue between them is uncovered
+        assert covered <= step["dur"] * 1.001
+        assert covered >= step["dur"] * 0.8, (covered, step["dur"])
+    # histograms observed the same steps
+    snap = telemetry.snapshot()
+    assert snap["module.step.total_ms"]["count"] == 3
+    assert snap["module.steps"]["value"] == 3.0
+    assert snap["module.step.fwd_bwd_dispatch_ms"]["count"] == 3
+    # device-memory gauge sampled at least once (step 0)
+    assert snap["device.live_bytes"]["value"] > 0
+
+
+def test_traceview_summarizes_fit_trace(tmp_path, capsys):
+    _fit_traced(tmp_path)
+    import importlib.util
+    import os
+    tv_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_tv_test", tv_path)
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    assert tv.main([str(tmp_path / "fit_trace.json")]) == 0
+    out = capsys.readouterr().out
+    assert "per-step breakdown" in out
+    assert "fwd_bwd_dispatch" in out
+    assert "input starvation" in out
+    bd = tv.step_breakdown(tv.load_trace(
+        str(tmp_path / "fit_trace.json"))["traceEvents"])
+    assert bd["steps"] == 3
+    assert bd["coverage"] >= 0.8
+    assert 0.0 <= bd["starvation"] <= 1.0
+
+
+# -- instrumented hot paths --------------------------------------------------
+
+def test_io_iterator_reports_next_batch_wait():
+    it = _iter()
+    for _ in it:
+        pass
+    snap = telemetry.snapshot()
+    assert snap["io.batches"]["value"] == 3.0
+    assert snap["io.next_batch_wait_ms"]["count"] == 3
+    assert snap["io.next_batch_wait_total_ms"]["value"] >= 0.0
+
+
+def test_kvstore_push_pull_record_bytes_and_latency():
+    kv = mx.kv.create("local")
+    a = mx.nd.ones((4, 4))
+    kv.init("w", a)
+    kv.push("w", mx.nd.ones((4, 4)))
+    out = mx.nd.zeros((4, 4))
+    kv.pull("w", out=out)
+    snap = telemetry.snapshot()
+    assert snap["kvstore.push_bytes"]["value"] == 64.0  # 16 f32
+    assert snap["kvstore.pull_bytes"]["value"] == 64.0
+    assert snap["kvstore.push_ms"]["count"] == 1
+    assert snap["kvstore.pull_ms"]["count"] == 1
+    np.testing.assert_allclose(out.asnumpy(), np.ones((4, 4)))
+
+
+def test_exec_cache_counters_mirrored_into_registry():
+    sym = _mlp()
+    sym.simple_bind(mx.cpu(), grad_req="write", data=(4, 8),
+                    softmax_label=(4,))
+    # same signature again: the warm bind must mirror a HIT
+    sym.simple_bind(mx.cpu(), grad_req="write", data=(4, 8),
+                    softmax_label=(4,))
+    snap = telemetry.snapshot()
+    assert snap.get("exec_cache.hits", {}).get("value", 0) >= 1, snap
+    # the first bind was either a fresh miss or a process-warm hit
+    assert snap["exec_cache.hits"]["value"] \
+        + snap.get("exec_cache.misses", {}).get("value", 0) >= 2
+
+
+def test_recompile_emits_instant_event(tmp_path):
+    tracing.set_recording(True)
+    sym = _mlp()
+    # an unseen batch shape forces a fresh trace of the fwd program
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(7, 8),
+                          softmax_label=(7,))
+    exe.forward(is_train=False)
+    tracing.set_recording(False)
+    evs = tracing.snapshot_events()
+    assert any(e["ph"] == "i" and e["name"].startswith("recompile:")
+               for e in evs), [e["name"] for e in evs if e["ph"] == "i"]
+
+
+# -- Speedometer -------------------------------------------------------------
+
+def _drive_speedometer(sm, batches=4):
+    from mxnet_tpu.module.base_module import BatchEndParam
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([0, 1])],
+                  [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    for nbatch in range(1, batches + 1):
+        sm(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=metric))
+
+
+def test_speedometer_telemetry_flag_keeps_log_format(caplog):
+    with caplog.at_level(logging.INFO):
+        _drive_speedometer(mx.callback.Speedometer(8, frequent=2,
+                                                   auto_reset=False))
+    plain = [r.getMessage() for r in caplog.records]
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        _drive_speedometer(mx.callback.Speedometer(8, frequent=2,
+                                                   auto_reset=False,
+                                                   telemetry=True))
+    mirrored = [r.getMessage() for r in caplog.records]
+    # byte-identical log shape: same line count, same format skeleton
+    # (tools/parse_log.py scrapes these lines)
+    assert len(plain) == len(mirrored) == 2
+    strip = lambda msgs: [__import__("re").sub(r"\d+\.\d+", "#", m)
+                          for m in msgs]
+    assert strip(plain) == strip(mirrored)
+    for m in mirrored:
+        assert "\tSpeed: " in m and " samples/sec" in m
+    # and the registry saw the throughput
+    snap = telemetry.snapshot()
+    assert snap["speedometer.samples_per_sec"]["value"] > 0
+    assert snap["speedometer.samples_per_sec_hist"]["count"] == 2
+
+
+# -- Monitor fused-path fallback ---------------------------------------------
+
+def test_install_monitor_retires_fused_step_with_warning(caplog):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (8, 8))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer()
+    assert getattr(mod, "_fused_step", None) is not None
+    mon = mx.mon.Monitor(1, pattern=".*output.*") \
+        if hasattr(mx, "mon") else mx.monitor.Monitor(1)
+    with caplog.at_level(logging.WARNING):
+        mod.install_monitor(mon)
+    assert mod._fused_step is None
+    assert any("tap-capable" in r.getMessage() for r in caplog.records)
+
+
+def test_install_monitor_between_fused_fb_and_update_no_double_step():
+    """A fused forward_backward has ALREADY applied its update; retiring
+    the fused step via install_monitor before the matching update() must
+    not let update() apply a second (stale-gradient) parameter update."""
+    rng = np.random.RandomState(3)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(8, 8).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (8, 8))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    assert mod._fused_step is not None
+    mod.forward_backward(batch)      # fused: update already applied
+    assert mod._fused_pending
+    mod.install_monitor(mx.monitor.Monitor(1))
+    after_fb = {k: v.asnumpy().copy()
+                for k, v in mod.get_params()[0].items()}
+    mod.update()                     # must be the fused step's no-op
+    after_update = mod.get_params()[0]
+    for k, v in after_fb.items():
+        np.testing.assert_array_equal(v, after_update[k].asnumpy())
+    # the NEXT general-path step must still update normally
+    mod.forward_backward(batch)
+    mod.update()
+    changed = any(not np.array_equal(v, mod.get_params()[0][k].asnumpy())
+                  for k, v in after_fb.items())
+    assert changed, "general path stopped updating after monitor install"
+
+
+def test_monitor_taps_fire_through_fit(caplog):
+    seen = []
+    mon = mx.monitor.Monitor(1, stat_func=lambda arr: arr.norm(),
+                             pattern=".*obs_fc2.*")
+    orig_toc = mon.toc
+
+    def spy_toc():
+        res = orig_toc()
+        seen.extend(res)
+        return res
+
+    mon.toc = spy_toc
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with caplog.at_level(logging.WARNING):
+        mod.fit(_iter(), num_epoch=1, monitor=mon,
+                optimizer_params={"learning_rate": 0.1})
+    assert seen, "monitor taps never fired"
+    assert any("obs_fc2" in name for _, name, _ in seen)

@@ -1,0 +1,181 @@
+"""Summarize a Chrome trace-event dump from mxnet_tpu.profiler.
+
+    python tools/traceview.py /tmp/mxnet_tpu_smoke_trace.json [--top N]
+
+Three views over one trace:
+
+- **Top spans**: per-(category, name) call counts and total/avg wall
+  time — the first place a perf regression shows up.
+- **Step breakdown**: the per-step components `BaseModule.fit` emits
+  (data_wait / fwd_bwd_dispatch / update / metric / sync) as a table
+  with each component's share of measured step time, plus the coverage
+  fraction (how much of the step the components explain) and the
+  input-starvation ratio (data_wait / step — the "is the step
+  input-bound?" answer).
+- **Instants**: recompiles and cache evictions, counted by name.
+
+Understands both the native "X" complete-event encoding and legacy
+"B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# pinned copy of mxnet_tpu/observability/instrument.py:STEP_COMPONENTS —
+# this CLI stays import-free so it can summarize a trace anywhere; a
+# component added there must be added here or coverage under-reports
+STEP_COMPONENTS = ("data_wait", "fwd_bwd_dispatch", "update", "metric",
+                   "sync")
+
+
+def load_trace(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array form is also legal
+        return {"traceEvents": doc}
+    return doc
+
+
+def span_durations(events):
+    """[(cat, name, dur_ms)] over every completed span in the trace.
+
+    The legacy B/E pairing mirrors profiler.aggregate_stats (LIFO per
+    (cat, name, tid, pid)) — keep the two decoders matched."""
+    out = []
+    open_ts = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            out.append((e.get("cat", ""), e["name"],
+                        e.get("dur", 0.0) / 1e3))
+        elif ph == "B":
+            key = (e.get("cat"), e["name"], e.get("tid"), e.get("pid"))
+            open_ts.setdefault(key, []).append(e["ts"])
+        elif ph == "E":
+            key = (e.get("cat"), e["name"], e.get("tid"), e.get("pid"))
+            if open_ts.get(key):
+                out.append((e.get("cat", ""), e["name"],
+                            (e["ts"] - open_ts[key].pop()) / 1e3))
+    return out
+
+
+def aggregate(durations):
+    """{(cat, name): {count, total_ms, avg_ms, max_ms}}"""
+    agg = {}
+    for cat, name, ms in durations:
+        s = agg.setdefault((cat, name), {"count": 0, "total_ms": 0.0,
+                                         "max_ms": 0.0})
+        s["count"] += 1
+        s["total_ms"] += ms
+        s["max_ms"] = max(s["max_ms"], ms)
+    for s in agg.values():
+        s["avg_ms"] = s["total_ms"] / s["count"]
+    return agg
+
+
+def step_breakdown(events):
+    """Per-component totals over the `step` spans fit() emits.
+
+    Returns None when the trace holds no step spans; otherwise a dict
+    with per-component stats, total measured step time, coverage
+    (sum(components)/sum(steps)) and starvation (data_wait share)."""
+    durations = span_durations(events)
+    steps = [ms for cat, name, ms in durations
+             if cat == "step" and name == "step"]
+    if not steps:
+        return None
+    comp = {c: {"count": 0, "total_ms": 0.0} for c in STEP_COMPONENTS}
+    for cat, name, ms in durations:
+        if cat == "step" and name.startswith("step:"):
+            c = name[len("step:"):]
+            if c in comp:
+                comp[c]["count"] += 1
+                comp[c]["total_ms"] += ms
+    step_total = sum(steps)
+    covered = sum(s["total_ms"] for s in comp.values())
+    return {
+        "steps": len(steps),
+        "step_total_ms": step_total,
+        "step_avg_ms": step_total / len(steps),
+        "components": comp,
+        "coverage": covered / step_total if step_total else 0.0,
+        "starvation": (comp["data_wait"]["total_ms"] / step_total
+                       if step_total else 0.0),
+    }
+
+
+def instants(events):
+    """{name: count} over instant ("i") markers — recompiles, evictions."""
+    out = {}
+    for e in events:
+        if e.get("ph") == "i":
+            out[e["name"]] = out.get(e["name"], 0) + 1
+    return out
+
+
+def summarize(trace, top=15):
+    """The full text report for one loaded trace document."""
+    events = trace.get("traceEvents", [])
+    lines = []
+    agg = aggregate(span_durations(events))
+
+    lines.append("== top spans by total time ==")
+    lines.append("%-34s %-12s %7s %12s %12s"
+                 % ("Name", "Category", "Calls", "Total(ms)", "Avg(ms)"))
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])[:top]
+    for (cat, name), s in rows:
+        lines.append("%-34s %-12s %7d %12.3f %12.3f"
+                     % (name[:34], cat[:12], s["count"], s["total_ms"],
+                        s["avg_ms"]))
+    if not rows:
+        lines.append("(no spans recorded)")
+
+    bd = step_breakdown(events)
+    lines.append("")
+    lines.append("== per-step breakdown ==")
+    if bd is None:
+        lines.append("(no step spans — trace a Module.fit / BaseModule "
+                     "training loop to get the breakdown)")
+    else:
+        lines.append("steps: %d   measured step time: %.3f ms total, "
+                     "%.3f ms avg" % (bd["steps"], bd["step_total_ms"],
+                                      bd["step_avg_ms"]))
+        lines.append("%-18s %7s %12s %12s %8s"
+                     % ("Component", "Calls", "Total(ms)", "Avg/step(ms)",
+                        "Step%"))
+        for c in STEP_COMPONENTS:
+            s = bd["components"][c]
+            share = (s["total_ms"] / bd["step_total_ms"] * 100.0
+                     if bd["step_total_ms"] else 0.0)
+            lines.append("%-18s %7d %12.3f %12.3f %7.1f%%"
+                         % (c, s["count"], s["total_ms"],
+                            s["total_ms"] / bd["steps"], share))
+        lines.append("component coverage of step time: %.1f%%"
+                     % (bd["coverage"] * 100.0))
+        lines.append("input starvation (data_wait / step): %.1f%%"
+                     % (bd["starvation"] * 100.0))
+
+    inst = instants(events)
+    if inst:
+        lines.append("")
+        lines.append("== instant events ==")
+        for name in sorted(inst):
+            lines.append("%-34s %7d" % (name[:34], inst[name]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Summarize an mxnet_tpu Chrome trace dump")
+    parser.add_argument("trace", help="trace JSON written by "
+                        "profiler.dump_profile()")
+    parser.add_argument("--top", type=int, default=15,
+                        help="rows in the top-spans table")
+    args = parser.parse_args(argv)
+    print(summarize(load_trace(args.trace), top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
