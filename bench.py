@@ -611,6 +611,167 @@ def _smoke_observability(mx, ctx, rng, mlp):
     assert breakdown["coverage"] >= 0.9, breakdown
 
 
+def serve_smoke():
+    """Serving-path CI mode (`make bench-smoke` step 2, `bench.py
+    --serve-smoke`): stands up the dynamic-batching service on a tiny
+    2-layer MLP and proves the three serving contracts on real
+    concurrent traffic:
+
+    1. **zero recompiles after warmup** — `Server.warmup()` pre-traces
+       every batch bucket (>= 3 buckets here); the concurrent request
+       storm afterwards must leave the executor-cache retrace counters
+       FLAT (`executor_cache.watch_traces`);
+    2. **batching is invisible** — every batched response is
+       bitwise-equal to the same request run through a plain serverless
+       `predict.Predictor` at the dispatched bucket shape (padding rows
+       and co-batched neighbours cannot bleed into real rows), and equal
+       up to float reassociation to a batch-1 predict;
+    3. **rejections are typed and contained** — deadline and overload
+       rejections fire only when the queue is intentionally starved/
+       overfilled, each is the right exception class, each lands in
+       `serving.rejected_total.<reason>`, and the dispatch thread
+       survives all of it.
+    """
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, serving
+    from mxnet_tpu.observability import telemetry
+    from mxnet_tpu.predict import Predictor
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    # the smoke's deadline/overload phases construct their rejections
+    # deliberately; an ambient default deadline would expire the storm's
+    # ordinary requests and read as a contract failure
+    os.environ.pop("MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS", None)
+    os.environ.pop("MXNET_TPU_SERVING_QUEUE_DEPTH", None)
+
+    rng = np.random.RandomState(0)
+    telemetry.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 8))
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+
+    server = serving.Server(max_batch_size=8, batch_window_ms=3.0,
+                            queue_depth=64)
+    server.add_model("mlp", sym, arg_params, input_shapes={"data": (8,)})
+    report = server.warmup()  # raises if the verify sweep retraces
+    buckets = report["mlp"]["buckets"]
+    assert len(buckets) >= 3, report
+
+    # 1+2) concurrent storm, counters flat, responses bitwise-unbatched
+    n_requests = 48
+    payloads = [rng.rand(1 + i % 3, 8).astype(np.float32)
+                for i in range(n_requests)]
+    with executor_cache.watch_traces() as watch:
+        futs = [server.submit_async("mlp", {"data": p}) for p in payloads]
+        results = [f.result(timeout=60) for f in futs]
+    assert watch.total() == 0, (
+        "recompiles after warmup: %s" % watch.delta())
+
+    # Bitwise oracle: a plain (serverless) Predictor run one request at
+    # a time.  XLA specializes each program per batch SHAPE, so bitwise
+    # reproduction pads the request to the bucket the service dispatched
+    # it in (fut.request.dispatch_bucket); within one shape, results are
+    # row- and offset-invariant, so zero-padding stands in for whatever
+    # co-batched neighbours the request actually shipped with.  Any
+    # routing/padding bug — rows swapped between requests, padding
+    # bleeding into real rows, wrong slice offsets — breaks equality.
+    params_blob = {"arg:%s" % k: v for k, v in arg_params.items()}
+    oracles = {}
+    mismatches = 0
+    dispatch_buckets = set()
+    for payload, fut, outs in zip(payloads, futs, results):
+        b = fut.request.dispatch_bucket
+        dispatch_buckets.add(b)
+        oracle = oracles.get(b)
+        if oracle is None:
+            oracle = oracles[b] = Predictor(sym.tojson(), params_blob,
+                                            {"data": (b, 8)})
+        solo = np.zeros((b, 8), np.float32)
+        solo[:payload.shape[0]] = payload
+        oracle.forward(data=solo)
+        want = oracle.get_output(0).asnumpy()[:payload.shape[0]]
+        if not np.array_equal(outs[0], want):
+            mismatches += 1
+    assert mismatches == 0, (
+        "%d responses differ from unbatched predict" % mismatches)
+    assert len(dispatch_buckets) >= 2, dispatch_buckets
+    # and semantically (up to float reassociation across shapes) every
+    # row matches a batch-1 predict
+    one = Predictor(sym.tojson(), params_blob, {"data": (1, 8)})
+    for payload, outs in zip(payloads, results):
+        for row in range(payload.shape[0]):
+            one.forward(data=payload[row:row + 1])
+            want = one.get_output(0).asnumpy()[0]
+            assert np.allclose(outs[0][row], want, rtol=1e-5, atol=1e-7)
+
+    # 3) typed rejections only under intentional starvation/overfill
+    snap = telemetry.snapshot()
+    storm_rejects = {k: v for k, v in snap.items()
+                     if k.startswith("serving.rejected_total.")}
+    assert not storm_rejects, storm_rejects
+
+    stalled = serving.Server(registry=server.registry,  # warmed model
+                             max_batch_size=4, queue_depth=4,
+                             auto_start=False)
+    n_overload = n_deadline = 0
+    doomed = stalled.submit_async("mlp", {"data": payloads[0]},
+                                  deadline_ms=20)
+    queued = [stalled.submit_async("mlp", {"data": p})
+              for p in payloads[1:4]]
+    try:
+        stalled.submit_async("mlp", {"data": payloads[4]})
+    except serving.Overloaded:
+        n_overload += 1
+    time.sleep(0.05)  # the doomed request's deadline expires while queued
+    stalled.start()
+    try:
+        doomed.result(timeout=30)
+    except serving.DeadlineExceeded:
+        n_deadline += 1
+    drained = [f.result(timeout=30) for f in queued]
+    stalled.close(drain=True, timeout=30)
+    assert n_overload == 1 and n_deadline == 1, (n_overload, n_deadline)
+    assert len(drained) == 3 and not stalled.batcher.alive
+    server.close(drain=True, timeout=30)
+
+    snap = telemetry.snapshot()
+    rejected = {k.rsplit(".", 1)[1]: snap[k]["value"] for k in snap
+                if k.startswith("serving.rejected_total.")}
+    assert rejected.get("overloaded") == 1, rejected
+    assert rejected.get("deadline_exceeded") == 1, rejected
+
+    telem_path = "/tmp/mxnet_tpu_serve_smoke_telemetry.json"
+    with open(telem_path, "w") as f:
+        f.write(telemetry.to_json_lines())
+    lat = snap.get("serving.request_latency_ms", {})
+    print(json.dumps({
+        "metric": "bench_serve_smoke",
+        "buckets": buckets,
+        "requests": n_requests,
+        "rows_bitwise_checked": int(sum(p.shape[0] for p in payloads)),
+        "recompiles_after_warmup": 0,
+        "warmup_traces": report["mlp"]["traces_first_pass"],
+        "request_latency_ms_avg": round(
+            lat.get("sum", 0.0) / lat["count"], 3) if lat.get("count")
+        else None,
+        "rejections": rejected,
+        "telemetry": telem_path,
+    }))
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -625,7 +786,9 @@ def _main_with_retry():
 
 if __name__ == "__main__":
     import sys
-    if "--smoke" in sys.argv:
+    if "--serve-smoke" in sys.argv:
+        serve_smoke()
+    elif "--smoke" in sys.argv:
         smoke()
     else:
         _main_with_retry()

@@ -82,3 +82,4 @@ from . import parallel
 from . import predict
 from . import io_native
 from . import checkpoint
+from . import serving

@@ -229,6 +229,44 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
     return entry
 
 
+def trace_counts():
+    """Snapshot of the real-retrace counters only ({'traces_fwd': ...,
+    'traces_fwd_bwd': ..., 'traces_fused_step': ...}).  These increment
+    INSIDE traced bodies, so a delta of zero between two points proves
+    no program was (re)compiled in between — the serving warmup
+    verification contract (mxnet_tpu/serving/, docs/serving.md)."""
+    with _lock:
+        return {k: _stats[k] for k in _stats if k.startswith("traces_")}
+
+
+class watch_traces:
+    """Context manager over ``trace_counts``: ``delta()``/``total()``
+    report the retraces that happened since ``__enter__``.  Usable after
+    exit (the end snapshot freezes at ``__exit__``) so callers can
+    assert zero-recompile windows::
+
+        with executor_cache.watch_traces() as w:
+            serve_requests()
+        assert w.total() == 0, w.delta()
+    """
+
+    def __enter__(self):
+        self._t0 = trace_counts()
+        self._t1 = None
+        return self
+
+    def __exit__(self, *exc):
+        self._t1 = trace_counts()
+        return False
+
+    def delta(self):
+        end = self._t1 if self._t1 is not None else trace_counts()
+        return {k: end[k] - self._t0.get(k, 0) for k in end}
+
+    def total(self):
+        return sum(self.delta().values())
+
+
 def stats():
     """Counter snapshot: hits/misses/evictions, per-kind trace counts,
     live entry count, and whether sharing is enabled."""

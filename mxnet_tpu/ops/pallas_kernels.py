@@ -18,7 +18,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # jax>=0.5 exports the x64-override context manager at top level
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # jax<=0.4.x ships it under experimental
+    from jax.experimental import enable_x64 as _enable_x64
+
 _NEG_INF = -1e30
+
+
+def _compiler_params_cls(pltpu):
+    """jax>=0.5 names the pallas-TPU params class ``CompilerParams``;
+    jax<=0.4.x called it ``TPUCompilerParams``.  Fail loudly on a third
+    rename instead of surfacing ``None(...)`` at pallas_call time."""
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams; this jax version (%s) is not supported by "
+        "mxnet_tpu's pallas kernels — use the XLA fallback "
+        "(use_pallas=False)" % jax.__version__)
 
 
 def _reference_attention(q, k, v, causal, scale):
@@ -63,15 +83,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         q = q_ref[0]                  # [block_q, d]
         k = k_ref[0]                  # [block_k, d]
         v = v_ref[0]
+        # scalar constants must be CONCRETE f32 here: the kernel jaxpr is
+        # re-staged at lowering time OUTSIDE the `_enable_x64(False)`
+        # window below, where a weak python float becomes f64 and Mosaic/
+        # the interpret-mode verifier rejects the mixed-width call
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
 
         if causal:
             rows = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kv_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(_NEG_INF))
 
         # m/l scratch is lane-tiled [block_q, 128] (TPU min tile); the
         # running stats live broadcast across lanes and are read back via
@@ -93,7 +118,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     @pl.when(kv_idx == n_kv_blocks - 1)
     def _finalize():
         l = l_ref[:][:, :1]
-        l = jnp.where(l == 0, 1.0, l)
+        l = jnp.where(l == 0, jnp.float32(1.0), l)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
         if emit_lse:
             # per-row log-sum-exp residual for the custom backward.
@@ -240,7 +265,7 @@ def _flash_jitted(b, h, sq, sk, d, dtype, causal, scale, block_q, block_k,
     def run(qf, kf, vf):
         # the framework enables jax x64 globally (float64 NDArray API
         # parity); Mosaic rejects 64-bit types, so trace under 32-bit rules
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             return _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q,
                                n_kv, block_q, block_k,
                                jnp.dtype(dtype), interpret, with_lse)
@@ -276,7 +301,7 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         **({"interpret": interpret} if interpret is not None else {}),
     )(qf, kf, vf)

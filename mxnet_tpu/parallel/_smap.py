@@ -1,0 +1,34 @@
+"""shard_map import + kwarg compatibility shim for the parallel layer.
+
+Two env skews hit every jax upgrade cycle:
+
+- the symbol moved: jax>=0.5 exports ``jax.shard_map``; older releases
+  ship it under ``jax.experimental.shard_map``;
+- the replication-check kwarg was renamed: ``check_rep`` (<=0.4.x) ->
+  ``check_vma`` (newer).  The parallel kernels disable the check (their
+  collectives are manually verified and the checker rejects some legal
+  permute patterns), so they need whichever spelling this jax accepts.
+
+Callers import ``shard_map`` and splat ``**UNCHECKED`` instead of
+naming the kwarg.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5 ships shard_map under experimental
+    from jax.experimental.shard_map import shard_map
+
+try:
+    _params = inspect.signature(shard_map).parameters
+except (TypeError, ValueError):  # unsignaturable wrapper: assume modern
+    _params = {"check_vma": None}
+
+if "check_vma" in _params:
+    UNCHECKED = {"check_vma": False}
+elif "check_rep" in _params:
+    UNCHECKED = {"check_rep": False}
+else:
+    UNCHECKED = {}

@@ -14,10 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # jax<0.5 ships shard_map under experimental
-    from jax.experimental.shard_map import shard_map
+from ._smap import shard_map, UNCHECKED
 
 
 def _moe_local(x, gate_w, w1, w2, axis_name, capacity_factor):
@@ -87,7 +84,7 @@ def moe_ffn(x, gate_w, w1, w2, mesh=None, axis_name="ep",
                           capacity_factor=capacity_factor),
         mesh=mesh,
         in_specs=(P(batch_axis), P(), P(axis_name), P(axis_name)),
-        out_specs=P(batch_axis), check_vma=False)
+        out_specs=P(batch_axis), **UNCHECKED)
     out = fn(x, gate_w, w1, w2)
     return out.reshape(orig_shape)
 

@@ -14,10 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # jax<0.5 ships shard_map under experimental
-    from jax.experimental.shard_map import shard_map
+from ._smap import shard_map, UNCHECKED
 
 
 def _pipeline_local(stage_params, x_micro, stage_fn, axis_name):
@@ -105,6 +102,6 @@ def pipeline_stages(stage_params, x, stage_fn, n_micro, mesh=None,
     fn = shard_map(local, mesh=mesh,
                    in_specs=(params_spec, x_spec),
                    out_specs=x_spec,
-                   check_vma=False)
+                   **UNCHECKED)
     y_micro = fn(stage_params, x_micro)
     return y_micro.reshape((b,) + y_micro.shape[2:])

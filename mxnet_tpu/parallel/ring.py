@@ -21,10 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # jax<0.5 ships shard_map under experimental
-    from jax.experimental.shard_map import shard_map
+from ._smap import shard_map, UNCHECKED
 
 
 def _block_attn(q, k, v, bias, scale):
@@ -102,7 +99,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
         functools.partial(_ring_attn_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        **UNCHECKED)
     return fn(q, k, v)
 
 

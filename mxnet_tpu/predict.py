@@ -89,6 +89,17 @@ class Predictor:
         """MXPredGetOutput."""
         return self._exe.outputs[index]
 
+    @property
+    def output_names(self):
+        """Positional output names — the ordering contract behind
+        ``get_output(index)`` (MXPredGetOutput indexes the same list).
+        The serving layer keys its per-request result lists on this."""
+        return list(self._symbol.list_outputs())
+
+    @property
+    def num_outputs(self):
+        return len(self._symbol.list_outputs())
+
     def reshape(self, input_shapes):
         """MXPredReshape: re-bind with new shapes (program reuse via the
         executor cache).  The C-predict contract allows any new input

@@ -1,0 +1,46 @@
+"""mxnet_tpu.serving — in-process dynamic-batching inference service.
+
+The online half of the framework (ROADMAP north star: "serves heavy
+traffic from millions of users"), built on two substrates this repo
+already has: the process-wide executor program cache (one compiled
+program per graph x batch-bucket, so dynamic batching amortizes
+compilation exactly the way BucketingModule does for training) and the
+runtime telemetry registry (latency histograms, rejection counters,
+queue gauges — scrape ``/metrics`` or snapshot in-process).
+
+Pieces (each its own module, composable without :class:`Server`):
+
+- :class:`ModelRegistry` / :class:`ServedModel` — checkpoints loaded
+  into bound predict executors, one per batch-size bucket
+  (``registry.py``);
+- :class:`AdmissionController` — bounded queue, per-request deadlines,
+  typed backpressure (``admission.py``);
+- :class:`DynamicBatcher` — pad/concat to power-of-two buckets, split
+  results per request, crash-proof dispatch thread (``batcher.py``);
+- :class:`Server` — futures API (``submit``/``submit_async``),
+  ``warmup()`` with zero-recompile verification, optional stdlib HTTP
+  endpoint, graceful drain (``server.py``);
+- typed rejections (``errors.py``), instrument names (``metrics.py``).
+
+See docs/serving.md for the architecture and the bucket/warmup/
+rejection contracts; ``bench.py --serve-smoke`` is the executable
+spec.
+"""
+from __future__ import annotations
+
+from .admission import (AdmissionController, Request, default_deadline_ms,
+                        default_queue_depth)
+from .batcher import DynamicBatcher
+from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,
+                     Overloaded, RequestTooLarge, ServerClosed,
+                     ServingError)
+from .registry import ModelRegistry, ServedModel, bucket_for, bucket_sizes
+from .server import Server
+
+__all__ = [
+    "AdmissionController", "BadRequest", "DeadlineExceeded",
+    "DynamicBatcher", "ModelNotFound", "ModelRegistry", "Overloaded",
+    "Request", "RequestTooLarge", "ServedModel", "Server", "ServerClosed",
+    "ServingError", "bucket_for", "bucket_sizes", "default_deadline_ms",
+    "default_queue_depth",
+]

@@ -1,0 +1,204 @@
+"""Dynamic batcher: single requests in, bucket-padded batches out.
+
+The TPU economics this implements: one compiled program per (graph,
+shape) signature is expensive to create and free to reuse (PR 2's
+executor cache), so online traffic must be funneled through a FIXED set
+of batch shapes.  The batcher queues single requests, concatenates them
+up to ``max_batch_size`` rows, pads the concat to the smallest
+power-of-two bucket, dispatches ONE forward for the whole batch, and
+splits the outputs back per request — BucketingModule's amortization
+argument applied to inference.  After ``Server.warmup`` every bucket's
+program is cached, so steady state serves arbitrary request mixes with
+zero recompiles.
+
+The dispatch thread is the service's heart and must never die: every
+per-batch failure (a model raising, a shape mismatch that slipped
+through validation) is caught and distributed to that batch's futures
+as the error result, then the loop continues.  Padding rows are zeros;
+the graph evaluates row-wise (no cross-row ops in inference graphs this
+serves), so real rows are bitwise-identical to any run of the SAME
+bucket shape — XLA specializes row blocking per program shape, so
+across shapes equality holds only up to float reassociation.  The
+serve-smoke asserts exactly that (each request replayed at its
+``dispatch_bucket`` through a plain Predictor, compared bitwise).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import InvalidStateError
+
+import numpy as np
+
+from ..observability import tracing
+from . import metrics
+from .registry import bucket_for
+
+_log = logging.getLogger(__name__)
+
+
+def _fail_future(future, exc):
+    """Deliver ``exc`` to ``future`` if it is still pending.  Returns
+    True when THIS call resolved it.  A pending concurrent Future can be
+    cancel()ed by its client at any instant, so a ``done()`` pre-check
+    is inherently racy — the InvalidStateError from losing that race
+    must not escape into the dispatch thread."""
+    try:
+        future.set_exception(exc)
+        return True
+    except InvalidStateError:
+        return False
+
+
+def _resolve_future(future, result):
+    """set_result with the same cancel-race protection."""
+    try:
+        future.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
+
+
+class DynamicBatcher:
+    """Consumes an :class:`AdmissionController`, dispatches through a
+    :class:`ModelRegistry`."""
+
+    def __init__(self, registry, admission, max_batch_size=8,
+                 batch_window_ms=2.0):
+        self.registry = registry
+        self.admission = admission
+        self.max_batch_size = int(max_batch_size)
+        self.batch_window_ms = float(batch_window_ms)
+        self._thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxnet_tpu-serving-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def started(self):
+        return self._thread is not None
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def join(self, timeout=None):
+        """Wait for the dispatch thread to drain and exit (the admission
+        controller must be closed first)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the dispatch loop ---------------------------------------------------
+
+    def _loop(self):
+        while True:
+            try:
+                batch = self.admission.take_batch(
+                    self.max_batch_size, self.batch_window_ms, self.reject)
+                if batch is None:
+                    return  # closed and drained
+                self._dispatch(batch)
+            except Exception:  # the dispatch thread must never die
+                _log.exception("serving dispatch loop survived an "
+                               "unexpected error; continuing")
+                # bound the spin if the failure is persistent (e.g. the
+                # admission controller itself is broken)
+                time.sleep(0.05)
+
+    def reject(self, request, exc):
+        """Fail one request with a typed error (deadline sweeps route
+        through here).  Counts the rejection only when this call
+        delivered it — a client that already cancel()ed its future was
+        never rejected, and double-counting would break
+        admitted-vs-rejected reconciliation."""
+        if _fail_future(request.future, exc):
+            metrics.record_rejection(getattr(exc, "reason", "serving_error"),
+                                     model=request.model)
+
+    def _dispatch(self, batch):
+        """Run one assembled batch, split into sub-batches when the
+        model's own ``max_batch_size`` is tighter than the assembly cap
+        (a registry can hold models bucketed below the server's max).
+        Any failure lands on the batch's futures, never on the thread."""
+        name = batch[0].model
+        try:
+            model = self.registry.get(name)
+        except Exception as exc:
+            self._fail_batch(batch, exc, name)
+            return
+        group, group_rows = [], 0
+        for r in batch:
+            if group and group_rows + r.n_rows > model.max_batch_size:
+                self._run_group(model, group, group_rows)
+                group, group_rows = [], 0
+            group.append(r)
+            group_rows += r.n_rows
+        if group:
+            self._run_group(model, group, group_rows)
+
+    def _run_group(self, model, batch, rows):
+        name = model.name
+        try:
+            bucket = bucket_for(rows, model.buckets)
+            padded = self._assemble(model, batch, bucket, rows)
+            with tracing.span("serving:batch", category="serving",
+                              pid="serving",
+                              args={"model": name, "bucket": bucket,
+                                    "rows": rows,
+                                    "requests": len(batch)}):
+                t0 = time.monotonic()
+                with tracing.span("serving:dispatch", category="serving",
+                                  pid="serving"):
+                    outs = model.run_batch(bucket, padded)
+                metrics.record_dispatch_ms((time.monotonic() - t0) * 1e3)
+            metrics.record_batch(name, bucket, rows)
+            self._split(batch, outs, bucket)
+        except Exception as exc:  # the dispatch thread must survive
+            self._fail_batch(batch, exc, name)
+
+    @staticmethod
+    def _fail_batch(batch, exc, model_name):
+        """Deliver ``exc`` to every request of a failed batch, counting
+        one rejection PER REQUEST actually failed (the reconciliation
+        contract: requests_total minus rejected_total equals responses,
+        so a 4-request batch failure must count 4, not 1)."""
+        reason = getattr(exc, "reason", "dispatch_error")
+        for r in batch:
+            if _fail_future(r.future, exc):
+                metrics.record_rejection(reason, model=model_name)
+
+    @staticmethod
+    def _assemble(model, batch, bucket, rows):
+        """Concat the requests' input arrays and zero-pad to ``bucket``
+        rows.  One allocation per input: rows copy in-place."""
+        padded = {}
+        for input_name, feature in model.input_shapes.items():
+            buf = np.zeros((bucket,) + feature, dtype=np.float32)
+            off = 0
+            for r in batch:
+                buf[off:off + r.n_rows] = r.inputs[input_name]
+                off += r.n_rows
+            padded[input_name] = buf
+        return padded
+
+    @staticmethod
+    def _split(batch, outs, bucket):
+        """Slice each request's rows back out of the batched outputs and
+        resolve its future (list of per-output host arrays)."""
+        off = 0
+        for r in batch:
+            # copy, not view: a retained response must not pin the whole
+            # bucket-sized output (nor expose co-batched rows via .base)
+            result = [o[off:off + r.n_rows].copy() for o in outs]
+            off += r.n_rows
+            r.dispatch_bucket = bucket
+            _resolve_future(r.future, result)
+            metrics.record_request_done(r, time.monotonic())

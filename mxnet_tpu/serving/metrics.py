@@ -1,0 +1,144 @@
+"""Serving telemetry: one place that names every serving instrument.
+
+All instrumentation runs on the host, outside jitted bodies (the
+bench-smoke invariant: telemetry on vs off changes zero retrace
+counters).  Instruments resolve through the PR 3 registry factories at
+the call site — they return the shared no-op handle when
+``MXNET_TPU_TELEMETRY=0``, and re-resolve automatically across
+``telemetry.reset()`` because nothing is cached here.
+
+Naming contract (docs/serving.md; ``tools/traceview.py --serving``
+parses these):
+
+- ``serving.request_latency_ms``  histogram, submit -> completion
+- ``serving.queue_ms``            histogram, submit -> batch dispatch
+- ``serving.dispatch_ms``         histogram, executor run per batch
+- ``serving.batch_size``          histogram, real (unpadded) rows
+- ``serving.padded_rows_total``   counter, padding rows added
+- ``serving.batches``             counter, dispatched batches
+- ``serving.requests_total``      counter, admitted requests
+- ``serving.rejected_total.<reason>``  counter per typed rejection
+- ``serving.queue_depth``         gauge (live callback)
+
+Trace events (category ``serving``): per-request ``serving:request``
+spans with a nested ``serving:queue`` phase, per-batch ``serving:batch``
+spans with a nested ``serving:dispatch`` phase, and
+``serving_reject:<reason>`` instants.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+from ..observability import telemetry, tracing
+
+
+def record_rejection(reason, model=None):
+    """Count one typed rejection and drop a trace instant — the single
+    choke point every rejection path (submit-time raise, queued-deadline
+    expiry, HTTP mapping) goes through."""
+    telemetry.counter("serving.rejected_total." + reason,
+                      help="requests rejected with %s" % reason).inc()
+    if tracing.is_recording():
+        args = {"model": model} if model else None
+        tracing.emit_instant("serving_reject:" + reason,
+                             category="serving", args=args)
+
+
+def record_admitted():
+    telemetry.counter("serving.requests_total",
+                      help="requests admitted to the queue").inc()
+    # re-arm the function gauge: set_function state does NOT survive
+    # telemetry.reset() the way the counter/histogram factories above do
+    # (they re-create per call site; the gauge callback was installed
+    # once at Server construction).  Every admission is a cheap, natural
+    # point to restore it for all live servers.
+    _ensure_queue_gauge()
+
+
+def record_batch(model, bucket, rows):
+    """Per-dispatched-batch facts: real rows (the batch-size
+    distribution) and padding overhead."""
+    telemetry.histogram("serving.batch_size",
+                        help="real rows per dispatched batch").observe(rows)
+    telemetry.counter("serving.padded_rows_total",
+                      help="padding rows dispatched").inc(bucket - rows)
+    telemetry.counter("serving.batches",
+                      help="batches dispatched").inc()
+
+
+def record_dispatch_ms(ms):
+    telemetry.histogram("serving.dispatch_ms",
+                        help="executor wall time per batch").observe(ms)
+
+
+def record_request_done(request, t_done):
+    """Request completed: latency histograms + the request/queue spans.
+    Spans are emitted from the dispatch thread with explicit timestamps
+    (the queue phase crosses threads, so context-manager nesting cannot
+    express it); ids link queue under request the way StepTracker links
+    components under a step."""
+    queue_s = (request.t_dispatch or t_done) - request.t_submit
+    total_s = t_done - request.t_submit
+    telemetry.histogram("serving.request_latency_ms",
+                        help="submit->completion wall time"
+                        ).observe(total_s * 1e3)
+    telemetry.histogram("serving.queue_ms",
+                        help="submit->dispatch queue wait"
+                        ).observe(queue_s * 1e3)
+    if tracing.is_recording():
+        now_us = tracing.now_us()
+        t0_us = now_us - total_s * 1e6
+        span_id = next(tracing._span_ids)
+        tracing.emit_complete(
+            "serving:request", t0_us, total_s * 1e6, category="serving",
+            pid="serving", args={"span_id": span_id,
+                                 "model": request.model,
+                                 "rows": request.n_rows})
+        tracing.emit_complete(
+            "serving:queue", t0_us, queue_s * 1e6, category="serving",
+            pid="serving", args={"parent_id": span_id})
+
+
+# weakrefs: the gauge must not keep a closed Server's admission
+# controller (and its queue) alive, and a second Server must add to the
+# reading, not silently replace the first's.  The lock keeps a snapshot
+# taken on one thread from discarding a registration racing in on
+# another (the rebuild in _total_queued would lose the append).
+_queue_sources = []
+_queue_sources_lock = threading.Lock()
+
+
+def _total_queued():
+    total = 0
+    with _queue_sources_lock:
+        live = []
+        for ref in _queue_sources:
+            admission = ref()
+            if admission is not None:
+                live.append(ref)
+        _queue_sources[:] = live
+    for ref in live:
+        admission = ref()
+        if admission is not None:
+            total += admission.pending()
+    return total
+
+
+def _ensure_queue_gauge():
+    """(Re-)install the queue-depth callback on whatever gauge instance
+    the registry currently holds — idempotent, and the recovery path
+    after ``telemetry.reset()`` discards the instance that was armed at
+    registration time."""
+    telemetry.gauge("serving.queue_depth",
+                    help="requests waiting for a batch slot, all servers"
+                    ).set_function(_total_queued)
+
+
+def register_queue_gauge(admission):
+    """Live queue-depth gauge (function gauge: sampled at snapshot
+    time, free otherwise).  Process-wide: reports the TOTAL requests
+    queued across every live Server's admission controller."""
+    with _queue_sources_lock:
+        _queue_sources.append(weakref.ref(admission))
+    _ensure_queue_gauge()

@@ -1,0 +1,166 @@
+"""Model registry: named checkpoints loaded into bound predict executors.
+
+A :class:`ServedModel` is the serving-side view of one checkpoint: the
+symbol + params bound through :class:`mxnet_tpu.predict.Predictor` (the
+C-predict contract — loss heads run their inference forward, outputs are
+positionally ordered, ``get_output_shape`` valid before the first
+forward), with ONE predictor per batch-size bucket.  Bucket predictors
+share the base predictor's weights (``Predictor.reshaped``), and every
+bucket binds the same structural graph at a distinct batch shape — so
+after :meth:`ServedModel.warmup` each bucket's forward program sits in
+the process-wide executor cache and steady-state dispatches never
+retrace (verified via ``executor_cache.watch_traces``).
+
+The registry is the lookup half of admission: an unknown model name is a
+typed ``ModelNotFound`` at submit time, not a KeyError in the dispatch
+thread.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import executor_cache
+from ..predict import Predictor
+from .errors import ModelNotFound, RequestTooLarge
+
+
+def bucket_sizes(max_batch_size):
+    """The fixed batch-size buckets for ``max_batch_size``: powers of two
+    up to it, plus the max itself when it is not a power of two.  Every
+    dispatch pads to one of these, so the executor cache holds exactly
+    ``len(bucket_sizes(m))`` forward programs per model after warmup
+    (BucketingModule's amortization argument, applied to inference)."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1, got %r"
+                         % (max_batch_size,))
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return out
+
+
+def bucket_for(n_rows, buckets):
+    """Smallest bucket holding ``n_rows`` (buckets ascending)."""
+    for b in buckets:
+        if n_rows <= b:
+            return b
+    raise RequestTooLarge(
+        "batch of %d rows exceeds max_batch_size %d"
+        % (n_rows, buckets[-1]))
+
+
+class ServedModel:
+    """One model's serving state: per-bucket predictors over shared
+    weights, plus the metadata the batcher and HTTP front-end need."""
+
+    def __init__(self, name, symbol, arg_params, aux_params, input_shapes,
+                 max_batch_size=8, ctx=None):
+        self.name = name
+        self.symbol = symbol
+        self.buckets = bucket_sizes(max_batch_size)
+        self.max_batch_size = max_batch_size
+        # feature shapes EXCLUDE the batch dim: {"data": (8,)} serves
+        # requests shaped (rows, 8)
+        self.input_shapes = {k: tuple(int(d) for d in v)
+                             for k, v in input_shapes.items()}
+        params = {"arg:%s" % k: v for k, v in arg_params.items()}
+        params.update({"aux:%s" % k: v for k, v in (aux_params or {}).items()})
+        base_shapes = self._bind_shapes(self.buckets[0])
+        self._base = Predictor(symbol.tojson(), params, base_shapes,
+                               ctx=ctx)
+        self.output_names = self._base.output_names
+        self._by_bucket = {self.buckets[0]: self._base}
+        self._lock = threading.Lock()
+        # serializes run_batch: predictors are forward()+get_output()
+        # pairs, not atomic — warmup from the caller thread must not
+        # interleave with the dispatch thread on the same bucket
+        self._run_lock = threading.Lock()
+
+    def _bind_shapes(self, bucket):
+        return {k: (bucket,) + v for k, v in self.input_shapes.items()}
+
+    def predictor_for(self, bucket):
+        """The bucket's bound predictor, creating it on first use
+        (weights shared with the base — ``Predictor.reshaped``)."""
+        with self._lock:
+            p = self._by_bucket.get(bucket)
+            if p is None:
+                p = self._base.reshaped(self._bind_shapes(bucket))
+                self._by_bucket[bucket] = p
+            return p
+
+    def run_batch(self, bucket, inputs):
+        """Run one padded batch: ``inputs`` maps input name -> np array
+        with leading dim == ``bucket``.  Returns the outputs as a list
+        of host arrays (positional, matching ``output_names``)."""
+        p = self.predictor_for(bucket)
+        with self._run_lock:
+            p.forward(**inputs)
+            return [p.get_output(i).asnumpy()
+                    for i in range(len(self.output_names))]
+
+    def warmup(self):
+        """Pre-trace every bucket's forward program so steady-state
+        serving recompiles nothing.  Returns {bucket: traces_added} from
+        the executor-cache retrace counters — the verification pass in
+        ``Server.warmup`` asserts a second sweep adds zero."""
+        traced = {}
+        for b in self.buckets:
+            with executor_cache.watch_traces() as w:
+                zeros = {k: np.zeros((b,) + v, dtype=np.float32)
+                         for k, v in self.input_shapes.items()}
+                self.run_batch(b, zeros)
+            traced[b] = w.total()
+        return traced
+
+
+class ModelRegistry:
+    """Name -> :class:`ServedModel` map shared by a :class:`Server`."""
+
+    def __init__(self):
+        self._models = {}
+        self._lock = threading.Lock()
+
+    def register(self, name, symbol, arg_params, aux_params, input_shapes,
+                 max_batch_size=8, ctx=None):
+        """Register a live symbol + params under ``name`` (replacing any
+        previous registration) and return its :class:`ServedModel`."""
+        model = ServedModel(name, symbol, arg_params, aux_params,
+                            input_shapes, max_batch_size=max_batch_size,
+                            ctx=ctx)
+        with self._lock:
+            self._models[name] = model
+        return model
+
+    def load(self, name, prefix, epoch, input_shapes, max_batch_size=8,
+             ctx=None):
+        """Register from ``save_checkpoint`` artifacts (prefix-symbol.json
+        + prefix-%04d.params — the two-artifact reference format)."""
+        from ..model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return self.register(name, symbol, arg_params, aux_params,
+                             input_shapes, max_batch_size=max_batch_size,
+                             ctx=ctx)
+
+    def get(self, name):
+        with self._lock:
+            model = self._models.get(name)
+            have = sorted(self._models) if model is None else None
+        if model is None:
+            raise ModelNotFound(
+                "no model registered as %r (have: %s)"
+                % (name, have or "none"))
+        return model
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._models

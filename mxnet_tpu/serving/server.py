@@ -1,0 +1,337 @@
+"""The serving front-end: futures API + optional stdlib HTTP endpoint.
+
+``Server`` wires the pieces into one in-process service::
+
+    server = serving.Server(max_batch_size=8)
+    server.add_model("mlp", symbol, arg_params, input_shapes={"data": (8,)})
+    server.warmup()                     # pre-trace every bucket
+    out = server.submit("mlp", {"data": x})          # blocking
+    fut = server.submit_async("mlp", {"data": x})    # concurrent.futures
+    server.close()                      # graceful drain
+
+Lifecycle contract:
+
+- ``warmup()`` runs every registered model through every batch bucket,
+  then sweeps again and asserts the second pass added ZERO executor
+  retraces — steady-state traffic after a clean warmup never compiles
+  (the PR 2 cache makes this checkable, not hoped-for).
+- ``submit*`` raises typed rejections synchronously (``ModelNotFound``,
+  ``RequestTooLarge``, ``Overloaded``, ``ServerClosed``, ``BadRequest``)
+  and delivers queued-stage rejections (``DeadlineExceeded``) through
+  the future.  Every rejection increments
+  ``serving.rejected_total.<reason>``.
+- ``close(drain=True)`` stops admission, lets the dispatch thread finish
+  every already-queued request, and joins it — in-flight work completes,
+  new work is refused with ``ServerClosed``.
+
+The HTTP endpoint is deliberately minimal (stdlib ``http.server``, JSON
+in/out, gated behind ``serve_http=True``): POST
+``/v1/models/<name>:predict``, GET ``/healthz`` and ``/metrics``
+(Prometheus text from the PR 3 registry).  Production fronting belongs
+to a real RPC stack; this one exists so the service is curl-able and the
+rejection->status mapping is pinned by tests.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..base import MXNetError
+from ..observability import telemetry
+from . import metrics
+from .admission import AdmissionController, Request
+from .batcher import DynamicBatcher
+from .errors import (BadRequest, RequestTooLarge, ServerClosed,
+                     ServingError)
+from .registry import ModelRegistry
+
+
+class Server:
+    """In-process dynamic-batching inference service."""
+
+    def __init__(self, registry=None, max_batch_size=8, batch_window_ms=2.0,
+                 queue_depth=None, serve_http=False, http_host="127.0.0.1",
+                 http_port=0, auto_start=True):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.max_batch_size = int(max_batch_size)
+        self.admission = AdmissionController(queue_depth)
+        self.batcher = DynamicBatcher(self.registry, self.admission,
+                                      max_batch_size=max_batch_size,
+                                      batch_window_ms=batch_window_ms)
+        metrics.register_queue_gauge(self.admission)
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._httpd = None
+        self._http_thread = None
+        if auto_start:
+            self.start()
+        if serve_http:
+            self._start_http(http_host, http_port)
+
+    # -- model management ----------------------------------------------------
+
+    def add_model(self, name, symbol, arg_params, aux_params=None,
+                  input_shapes=None, ctx=None):
+        """Register a live symbol + params; buckets sized to this
+        server's ``max_batch_size``.  ``input_shapes`` maps input name
+        -> per-row feature shape (no batch dim): ``{"data": (8,)}``.
+        The graph must be row-wise — no op may mix information across
+        the batch axis at inference (docs/serving.md, Determinism
+        contract) — or padding/co-batching silently corrupts results."""
+        if not input_shapes:
+            raise BadRequest("input_shapes is required: {input_name: "
+                             "per-row feature shape}, e.g. {'data': (8,)}")
+        return self.registry.register(
+            name, symbol, arg_params, aux_params, input_shapes,
+            max_batch_size=self.max_batch_size, ctx=ctx)
+
+    def load_model(self, name, prefix, epoch, input_shapes, ctx=None):
+        """Register from checkpoint artifacts (``save_checkpoint``'s
+        prefix-symbol.json + prefix-%04d.params)."""
+        return self.registry.load(
+            name, prefix, epoch, input_shapes,
+            max_batch_size=self.max_batch_size, ctx=ctx)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.batcher.start()
+
+    def warmup(self, verify=True):
+        """Pre-trace every bucket of every registered model.  With
+        ``verify=True`` (default) a second sweep must add zero executor
+        retraces, or MXNetError — a failing verify means some dispatch
+        path escapes the program cache and steady-state serving would
+        recompile under load.  Returns the per-model report."""
+        report = {}
+        names = self.registry.names()
+        # two phases: warm EVERY model, then verify every model — the
+        # trace counters are process-global, so verifying model A while
+        # model B still has untraced buckets (or live traffic is tracing
+        # them) would blame A for B's compilations
+        for name in names:
+            model = self.registry.get(name)
+            first = model.warmup()
+            report[name] = {"buckets": list(model.buckets),
+                            "traces_first_pass": sum(first.values())}
+            telemetry.counter(
+                "serving.warmup_traces",
+                help="programs traced during warmup").inc(
+                report[name]["traces_first_pass"])
+        if verify:
+            for name in names:
+                second = self.registry.get(name).warmup()
+                report[name]["traces_verify_pass"] = sum(second.values())
+                if report[name]["traces_verify_pass"]:
+                    raise MXNetError(
+                        "serving warmup verification failed for model %r: "
+                        "%d retraces on the second sweep (per bucket: %s) "
+                        "— steady-state serving would recompile"
+                        % (name, report[name]["traces_verify_pass"],
+                           second))
+        return report
+
+    def close(self, drain=True, timeout=None):
+        """Graceful shutdown: stop the HTTP listener, refuse new
+        admissions (``ServerClosed``), and — with ``drain=True`` — wait
+        for the dispatch thread to complete every queued request."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._http_thread.join(timeout=5)
+            self._httpd.server_close()
+        self.admission.close()
+        if self.batcher.started and drain:
+            self.batcher.join(timeout)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- request path --------------------------------------------------------
+
+    def submit_async(self, model, inputs, deadline_ms=None):
+        """Queue one request; returns a ``concurrent.futures.Future``
+        resolving to the per-output list of host arrays (each sliced to
+        this request's rows).  Raises typed rejections synchronously
+        when the request can never be served; queued-stage failures
+        (deadline expiry, dispatch errors) arrive through the future."""
+        try:
+            if self._closed:
+                raise ServerClosed("server is closed")
+            served = self.registry.get(model)
+            arrays, n_rows = self._validate(served, inputs,
+                                            self.max_batch_size)
+            request = Request(model, arrays, n_rows, Future(),
+                              deadline_ms=deadline_ms)
+            self.admission.offer(request)
+        except ServingError as exc:
+            metrics.record_rejection(exc.reason, model=model)
+            raise
+        metrics.record_admitted()
+        # debug/verification handle: the queued Request (rows, deadline,
+        # and — once dispatched — dispatch_bucket, the program shape the
+        # response came from; the serve-smoke bitwise oracle needs it)
+        request.future.request = request
+        return request.future
+
+    def submit(self, model, inputs, deadline_ms=None, timeout=None):
+        """Blocking ``submit_async``: returns the output list or raises
+        the typed rejection."""
+        return self.submit_async(model, inputs,
+                                 deadline_ms=deadline_ms).result(timeout)
+
+    @staticmethod
+    def _validate(served, inputs, server_max):
+        """Coerce ``inputs`` to {name: f32 array of (rows,)+feature} and
+        return (arrays, rows).  A bare array is accepted for
+        single-input models; a per-row array (feature shape exactly)
+        gains a rows=1 leading dim.  Rows are capped by BOTH the model's
+        bucket table and this server's assembly cap (a shared registry
+        can pair a wide model with a narrower server)."""
+        names = sorted(served.input_shapes)
+        if not isinstance(inputs, dict):
+            if len(names) != 1:
+                raise BadRequest(
+                    "model %r has inputs %s; pass a {name: array} dict"
+                    % (served.name, names))
+            inputs = {names[0]: inputs}
+        unknown = sorted(set(inputs) - set(names))
+        missing = sorted(set(names) - set(inputs))
+        if unknown or missing:
+            raise BadRequest(
+                "model %r inputs mismatch: missing %s, unknown %s"
+                % (served.name, missing or "none", unknown or "none"))
+        arrays, rows = {}, None
+        for name in names:
+            feature = served.input_shapes[name]
+            try:
+                arr = np.asarray(inputs[name], dtype=np.float32)
+            except (TypeError, ValueError) as exc:
+                raise BadRequest("input %r is not numeric: %s"
+                                 % (name, exc)) from exc
+            if arr.shape == feature:
+                arr = arr[None]  # one row, batch dim added
+            if arr.shape[1:] != feature or arr.ndim != len(feature) + 1 \
+                    or arr.shape[0] == 0:
+                raise BadRequest(
+                    "input %r expects shape (rows,)+%s, got %s"
+                    % (name, feature, arr.shape))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise BadRequest(
+                    "inputs disagree on rows: %r has %d, %r has %d"
+                    % (names[0], rows, name, arr.shape[0]))
+            arrays[name] = arr
+        limit = min(served.max_batch_size, server_max)
+        if rows > limit:
+            raise RequestTooLarge(
+                "request of %d rows exceeds max_batch_size %d for model "
+                "%r; split it client-side"
+                % (rows, limit, served.name))
+        return arrays, rows
+
+    # -- HTTP front-end ------------------------------------------------------
+
+    def _start_http(self, host, port):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mxnet_tpu-serving-http", daemon=True)
+        self._http_thread.start()
+
+    @property
+    def http_address(self):
+        """(host, port) of the live HTTP listener, or None."""
+        if self._httpd is None:
+            return None
+        return self._httpd.server_address[:2]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Minimal JSON-over-HTTP mapping of the futures API.
+
+    POST /v1/models/<name>:predict   {"inputs": {...}, "deadline_ms": n}
+    GET  /healthz                    liveness + registered models
+    GET  /metrics                    Prometheus text exposition
+    """
+
+    server_version = "mxnet-tpu-serving"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format, *args):
+        """Silence per-request stderr lines (telemetry is the log)."""
+
+    def _send(self, status, body, content_type="application/json"):
+        data = body.encode() if isinstance(body, str) \
+            else json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, {"status": "ok",
+                             "models": self.server.owner.registry.names()})
+        elif self.path == "/metrics":
+            self._send(200, telemetry.to_prometheus(),
+                       content_type="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": "not_found", "path": self.path})
+
+    def do_POST(self):
+        name = self._model_name()
+        if name is None:
+            self._send(404, {"error": "not_found", "path": self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError as exc:
+                raise BadRequest("unparsable JSON body: %s" % exc) from exc
+            if not isinstance(payload, dict):
+                raise BadRequest("body must be a JSON object")
+            inputs = payload.get("inputs", payload.get("data"))
+            if inputs is None:
+                raise BadRequest('body needs "inputs" (dict or array)')
+            outs = self.server.owner.submit(
+                name, inputs, deadline_ms=payload.get("deadline_ms"))
+            self._send(200, {"model": name,
+                             "outputs": [o.tolist() for o in outs]})
+        except ServingError as exc:
+            self._send(exc.http_status,
+                       {"error": type(exc).__name__, "reason": exc.reason,
+                        "message": str(exc)})
+        except Exception as exc:  # handler thread must answer, not die
+            self._send(500, {"error": type(exc).__name__,
+                             "message": str(exc)})
+
+    def _model_name(self):
+        """Model name from ``/v1/models/<name>:predict`` (TF-serving
+        spelling) or ``/predict/<name>``."""
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/v1/models/") and path.endswith(":predict"):
+            return path[len("/v1/models/"):-len(":predict")] or None
+        if path.startswith("/predict/"):
+            return path[len("/predict/"):] or None
+        return None

@@ -32,7 +32,7 @@ _FAST_MODULES = {
     "test_fused_extra", "test_fused_optimizers", "test_gluon_data",
     "test_io_metric_kvstore", "test_kvstore_ici", "test_module",
     "test_ndarray", "test_namespaces", "test_optimizer", "test_symbol",
-    "test_elastic",
+    "test_elastic", "test_serving",
 }
 
 

@@ -15,6 +15,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 POISON_RUNNER = r"""
+import inspect
 import sys
 import jax
 from jax._src import xla_bridge
@@ -25,9 +26,21 @@ def _poisoned(platform=None):
     # Simulate the driver environment: the default platform enumerates but
     # any attempt to use it blows up (broken libtpu).
     if platform is None:
+        # jax >= 0.4.3x calls xb.process_count() — multi-host bookkeeping,
+        # pure device ENUMERATION — on every jit lowering, even when the
+        # computation carries an explicit device assignment.  The gate
+        # forbids computing/allocating on the default backend, which a
+        # broken libtpu also cannot enumerate-then-execute; but failing
+        # jax's own unconditional bookkeeping would fail every jit on
+        # newer jax, so exactly that caller is let through.
+        caller = inspect.currentframe().f_back
+        outer = caller.f_back if caller is not None else None
+        if outer is not None and outer.f_code.co_name == "process_count":
+            return _real_get_backend("cpu")
         raise RuntimeError("poisoned default backend (simulated broken libtpu)")
     return _real_get_backend(platform)
 
+_poisoned.cache_clear = getattr(_real_get_backend, "cache_clear", lambda: None)
 xla_bridge.get_backend = _poisoned
 # Sanity: the poison must actually fire for default-backend resolution,
 # otherwise this test passes vacuously after a jax upgrade.

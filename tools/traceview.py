@@ -1,6 +1,7 @@
 """Summarize a Chrome trace-event dump from mxnet_tpu.profiler.
 
     python tools/traceview.py /tmp/mxnet_tpu_smoke_trace.json [--top N]
+    python tools/traceview.py --serving /tmp/trace_or_telemetry.json
 
 Three views over one trace:
 
@@ -13,6 +14,14 @@ Three views over one trace:
   input-starvation ratio (data_wait / step — the "is the step
   input-bound?" answer).
 - **Instants**: recompiles and cache evictions, counted by name.
+
+`--serving` switches to the inference-service view (p50/p95/p99 request
+latency, queue/dispatch phase breakdown, batch-size distribution,
+rejection counts by reason).  It accepts EITHER a Chrome trace holding
+`serving:*` spans (exact percentiles over the recorded requests) OR a
+telemetry JSON-lines dump from `observability.telemetry.to_json_lines`
+(percentiles estimated from the fixed log2 histogram buckets — each
+quantile reports its bucket's upper bound).
 
 Understands both the native "X" complete-event encoding and legacy
 "B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
@@ -28,6 +37,27 @@ import sys
 STEP_COMPONENTS = ("data_wait", "fwd_bwd_dispatch", "update", "metric",
                    "sync")
 
+# pinned copy of observability/telemetry.py:BUCKET_BOUNDS (2**k for k in
+# [-10, 20] plus +Inf overflow) — needed to turn a JSON-lines histogram
+# snapshot back into quantile estimates without importing the framework
+_HIST_K_MIN, _HIST_K_MAX = -10, 20
+HIST_BUCKET_BOUNDS = tuple(2.0 ** k
+                           for k in range(_HIST_K_MIN, _HIST_K_MAX + 1))
+
+# pinned copies of telemetry.py's strict-JSON export contract: numeric
+# fields whose non-finite values ship as string tokens
+_JSON_NUMERIC_KEYS = ("value", "sum", "min", "max")
+_NONFINITE_TOKENS = {"NaN": float("nan"), "Infinity": float("inf"),
+                     "-Infinity": float("-inf")}
+
+
+def _restore_nonfinite(obj):
+    for k in _JSON_NUMERIC_KEYS:
+        v = obj.get(k)
+        if isinstance(v, str) and v in _NONFINITE_TOKENS:
+            obj[k] = _NONFINITE_TOKENS[v]
+    return obj
+
 
 def load_trace(path):
     with open(path) as f:
@@ -35,6 +65,32 @@ def load_trace(path):
     if isinstance(doc, list):  # bare event-array form is also legal
         return {"traceEvents": doc}
     return doc
+
+
+def load_any(path):
+    """Load either a Chrome trace document or a telemetry JSON-lines
+    dump.  Returns ("trace", doc) or ("telemetry", {name: snap})."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, list):
+        return "trace", {"traceEvents": doc}
+    if isinstance(doc, dict):
+        if "traceEvents" in doc:
+            return "trace", doc
+        if "name" in doc and "type" in doc:  # one-metric JSON-lines dump
+            return "telemetry", {doc["name"]: _restore_nonfinite(doc)}
+        return "trace", doc
+    metrics = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        obj = _restore_nonfinite(json.loads(line))  # malformed fails loudly
+        metrics[obj.pop("name")] = obj
+    return "telemetry", metrics
 
 
 def span_durations(events):
@@ -114,6 +170,144 @@ def instants(events):
     return out
 
 
+# -- serving view ------------------------------------------------------------
+
+def _percentile(sorted_vals, q):
+    """Exact nearest-rank percentile over a sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _hist_quantile(snap, q):
+    """Quantile estimate from a fixed log2-bucket histogram snapshot:
+    the UPPER BOUND of the bucket holding the q-th observation (the
+    honest answer a bucketed histogram can give)."""
+    buckets = snap.get("buckets") or []
+    count = snap.get("count", 0)
+    if not count or not buckets:
+        return 0.0
+    target = q * count
+    cumulative = 0
+    for i, n in enumerate(buckets):
+        cumulative += n
+        if cumulative >= target:
+            if i < len(HIST_BUCKET_BOUNDS):
+                return HIST_BUCKET_BOUNDS[i]
+            return float("inf")  # overflow bucket
+    return float("inf")
+
+
+def serving_from_trace(events):
+    """Serving stats from recorded `serving:*` spans (exact)."""
+    requests, queue, dispatch = [], [], []
+    batch_rows = {}
+    rejects = {}
+    for e in events:
+        ph, name = e.get("ph"), e.get("name", "")
+        if ph == "X" and e.get("cat") == "serving":
+            ms = e.get("dur", 0.0) / 1e3
+            if name == "serving:request":
+                requests.append(ms)
+            elif name == "serving:queue":
+                queue.append(ms)
+            elif name == "serving:dispatch":
+                dispatch.append(ms)
+            elif name == "serving:batch":
+                rows = (e.get("args") or {}).get("rows")
+                if rows is not None:
+                    batch_rows[rows] = batch_rows.get(rows, 0) + 1
+        elif ph == "i" and name.startswith("serving_reject:"):
+            reason = name[len("serving_reject:"):]
+            rejects[reason] = rejects.get(reason, 0) + 1
+    requests.sort()
+    return {
+        "source": "trace (exact)",
+        "requests": len(requests),
+        "p50": _percentile(requests, 0.50),
+        "p95": _percentile(requests, 0.95),
+        "p99": _percentile(requests, 0.99),
+        "queue_avg": sum(queue) / len(queue) if queue else 0.0,
+        "dispatch_avg": sum(dispatch) / len(dispatch) if dispatch else 0.0,
+        "batches": sum(batch_rows.values()),
+        "batch_rows": batch_rows,
+        "rejects": rejects,
+    }
+
+
+def serving_from_telemetry(metrics):
+    """Serving stats from a telemetry JSON-lines dump (histogram-bucket
+    estimates; each quantile is its bucket's upper bound)."""
+    lat = metrics.get("serving.request_latency_ms", {})
+    queue = metrics.get("serving.queue_ms", {})
+    dispatch = metrics.get("serving.dispatch_ms", {})
+    batch = metrics.get("serving.batch_size", {})
+    batch_rows = {}
+    for i, n in enumerate(batch.get("buckets") or []):
+        if not n:
+            continue
+        bound = (HIST_BUCKET_BOUNDS[i] if i < len(HIST_BUCKET_BOUNDS)
+                 else float("inf"))
+        batch_rows["<=%g" % bound] = n
+    prefix = "serving.rejected_total."
+    rejects = {name[len(prefix):]: snap.get("value", 0)
+               for name, snap in metrics.items()
+               if name.startswith(prefix)}
+    def avg(snap):
+        return snap.get("sum", 0.0) / snap["count"] if snap.get("count") \
+            else 0.0
+    return {
+        "source": "telemetry (bucket upper-bound estimates)",
+        "requests": lat.get("count", 0),
+        "p50": _hist_quantile(lat, 0.50),
+        "p95": _hist_quantile(lat, 0.95),
+        "p99": _hist_quantile(lat, 0.99),
+        "queue_avg": avg(queue),
+        "dispatch_avg": avg(dispatch),
+        "batches": batch.get("count", 0),
+        "batch_rows": batch_rows,
+        "rejects": rejects,
+    }
+
+
+def summarize_serving(kind, payload):
+    """The text report for `--serving` over either input form."""
+    stats = serving_from_trace(payload.get("traceEvents", [])) \
+        if kind == "trace" else serving_from_telemetry(payload)
+    lines = []
+    lines.append("== serving: request latency (%s) ==" % stats["source"])
+    if not stats["requests"]:
+        lines.append("(no serving requests recorded — run traffic with "
+                     "the profiler on, or pass a telemetry dump)")
+    else:
+        lines.append("requests: %d" % stats["requests"])
+        lines.append("p50: %.3f ms   p95: %.3f ms   p99: %.3f ms"
+                     % (stats["p50"], stats["p95"], stats["p99"]))
+        lines.append("phase avg: queue %.3f ms   dispatch %.3f ms"
+                     % (stats["queue_avg"], stats["dispatch_avg"]))
+    lines.append("")
+    lines.append("== serving: batch-size distribution ==")
+    if not stats["batch_rows"]:
+        lines.append("(no batches recorded)")
+    else:
+        lines.append("%-12s %7s" % ("Rows", "Batches"))
+        # keys are ints (trace form) or "<=bound" strings (telemetry form)
+        for rows in sorted(stats["batch_rows"],
+                           key=lambda r: float(str(r).lstrip("<="))):
+            lines.append("%-12s %7d" % (rows, stats["batch_rows"][rows]))
+        lines.append("total batches: %d" % stats["batches"])
+    lines.append("")
+    lines.append("== serving: rejections ==")
+    if not stats["rejects"]:
+        lines.append("(none)")
+    else:
+        for reason in sorted(stats["rejects"]):
+            lines.append("%-24s %7d" % (reason, stats["rejects"][reason]))
+    return "\n".join(lines)
+
+
 def summarize(trace, top=15):
     """The full text report for one loaded trace document."""
     events = trace.get("traceEvents", [])
@@ -169,10 +363,19 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Summarize an mxnet_tpu Chrome trace dump")
     parser.add_argument("trace", help="trace JSON written by "
-                        "profiler.dump_profile()")
+                        "profiler.dump_profile() (or, with --serving, a "
+                        "telemetry JSON-lines dump)")
     parser.add_argument("--top", type=int, default=15,
                         help="rows in the top-spans table")
+    parser.add_argument("--serving", action="store_true",
+                        help="inference-service view: request-latency "
+                        "percentiles, batch-size distribution, rejection "
+                        "counts")
     args = parser.parse_args(argv)
+    if args.serving:
+        kind, payload = load_any(args.trace)
+        print(summarize_serving(kind, payload))
+        return 0
     print(summarize(load_trace(args.trace), top=args.top))
     return 0
 
