@@ -772,6 +772,129 @@ def serve_smoke():
     }))
 
 
+def health_smoke():
+    """Health-sentinel CI mode (`make bench-smoke` step 3, `bench.py
+    --health-smoke`): proves the sentinel's three contracts on a real
+    3-step fit:
+
+    1. **health off is free and bit-identical** — two fresh fits with
+       ``MXNET_TPU_HEALTH=0`` produce identical exec-cache trace
+       counters and bitwise-identical trained parameters, and register
+       zero ``health.*`` telemetry series (the off path IS this PR's
+       parent path);
+    2. **enabling costs at most one retrace per program** — the same
+       fit with ``MXNET_TPU_HEALTH=1`` adds <=1 to the total retrace
+       count (the health program is a distinct cache entry);
+    3. **a forced-NaN run leaves evidence** — NaN data at batch 1
+       stops the fit with ``TrainingDivergedError`` naming step 1 and
+       writes a flight dump that ``tools/traceview.py --flight``
+       resolves to the same step with exit code 1.
+    """
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache
+    from mxnet_tpu.observability import flight_recorder, health, telemetry
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    os.environ.pop("MXNET_TPU_HEALTH_RULES", None)
+    os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
+
+    ctx = mx.cpu()
+
+    def mlp():
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                    name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def fit_once(nan_batch=None):
+        """One fresh 3-step fit; returns (trace counts, params)."""
+        executor_cache.clear()
+        executor_cache.reset_stats()
+        telemetry.reset()
+        flight_recorder.reset()
+        mx.random.seed(0)  # identical init across runs (bitwise oracle)
+        rng = np.random.RandomState(0)
+        x = rng.rand(24, 8).astype(np.float32)
+        y = rng.randint(0, 4, (24,)).astype(np.float32)
+        if nan_batch is not None:
+            x[nan_batch * 8:(nan_batch + 1) * 8] = np.nan
+        from mxnet_tpu.io import NDArrayIter
+        mod = mx.mod.Module(mlp(), context=ctx)
+        mod.fit(NDArrayIter(x, y, batch_size=8), num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+        params = {k: v.asnumpy().copy()
+                  for k, v in mod.get_params()[0].items()}
+        return executor_cache.trace_counts(), params
+
+    # 1) off path: identical counters, bitwise-identical params, zero
+    #    health.* series — the sentinel off is indistinguishable from
+    #    the parent
+    os.environ["MXNET_TPU_HEALTH"] = "0"
+    counts_off, params_a = fit_once()
+    counts_off2, params_b = fit_once()
+    assert counts_off == counts_off2, (counts_off, counts_off2)
+    assert set(params_a) == set(params_b)
+    assert all(np.array_equal(params_a[k], params_b[k]) for k in params_a)
+    snap = telemetry.snapshot()
+    leaked = sorted(k for k in snap if k.startswith("health."))
+    assert not leaked, leaked
+
+    # 2) on path: <=1 added retrace, health series + flight steps live
+    os.environ["MXNET_TPU_HEALTH"] = "1"
+    counts_on, _ = fit_once()
+    delta = sum(counts_on.values()) - sum(counts_off.values())
+    assert 0 <= delta <= 1, (counts_on, counts_off)
+    snap = telemetry.snapshot()
+    assert any(k.startswith("health.") for k in snap), sorted(snap)
+    steps_recorded = flight_recorder.get_recorder().steps_recorded()
+    assert steps_recorded == 3, steps_recorded
+
+    # 3) forced NaN at batch 1: diverge at step 1 + parseable dump
+    dump_path = "/tmp/mxnet_tpu_health_smoke_flight.json"
+    os.environ["MXNET_TPU_FLIGHT_PATH"] = dump_path
+    try:
+        diverged = None
+        try:
+            fit_once(nan_batch=1)
+        except health.TrainingDivergedError as exc:
+            diverged = exc
+        assert diverged is not None, "forced-NaN fit did not diverge"
+        assert diverged.step == 1, diverged.step
+        assert diverged.rule == "nonfinite", diverged.rule
+        assert diverged.dump_path == dump_path and os.path.exists(dump_path)
+    finally:
+        os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
+        os.environ["MXNET_TPU_HEALTH"] = "0"
+
+    import importlib.util
+    tv_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_traceview_h", tv_path)
+    traceview = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(traceview)
+    rc = traceview.main(["--flight", dump_path])
+    assert rc == 1, "traceview --flight must exit 1 on an anomalous dump"
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["first_anomaly_step"] == diverged.step, doc[
+        "first_anomaly_step"]
+
+    print(json.dumps({
+        "metric": "bench_health_smoke",
+        "trace_counters_off": counts_off,
+        "trace_counters_on": counts_on,
+        "retrace_delta_on": delta,
+        "flight_steps_recorded": steps_recorded,
+        "nan_diverged_step": diverged.step,
+        "flight_dump": dump_path,
+        "traceview_exit": rc,
+    }))
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -788,6 +911,8 @@ if __name__ == "__main__":
     import sys
     if "--serve-smoke" in sys.argv:
         serve_smoke()
+    elif "--health-smoke" in sys.argv:
+        health_smoke()
     elif "--smoke" in sys.argv:
         smoke()
     else:

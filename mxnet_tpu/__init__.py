@@ -57,6 +57,7 @@ from . import filesystem
 from . import log
 from . import misc
 from . import observability
+from .observability.health import TrainingDivergedError
 from . import profiler
 from . import engine
 from . import test_utils

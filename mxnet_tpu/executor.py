@@ -20,6 +20,7 @@ import numpy as np
 
 from .base import MXNetError, np_dtype
 from .context import current_context
+from .log import module_logger as _module_logger
 from .ops.registry import get_op
 from .ndarray import NDArray, zeros as nd_zeros
 from .ndarray.ndarray import _Handle
@@ -180,6 +181,12 @@ class Executor:
         self._fwd_bwd_nd_jit = entry.fwd_bwd_nd
         self._donates_aux = entry.donates_aux
         self._n_keys = entry.n_keys
+        # health sentinel (MXNET_TPU_HEALTH=1, resolved at bind via the
+        # cache key): fwd_bwd returns an extra packed numerics vector,
+        # stashed on-device here until the training loop consumes it
+        self._health_on = entry.health
+        self.health_layout = entry.health_layout
+        self._last_health = None
 
     # -- parameter access ----------------------------------------------------
     @property
@@ -291,8 +298,7 @@ class Executor:
                 # no tap points, so the monitor forces the separate
                 # uncompiled path (satisfying the tap, at a perf cost)
                 self._monitor_fallback_warned = True
-                import logging
-                logging.warning(
+                _module_logger(__name__).warning(
                     "monitor callback installed: forward_backward is "
                     "taking the separate tap-capable path (fused "
                     "fwd-bwd program skipped while the monitor is "
@@ -320,12 +326,15 @@ class Executor:
             with _profiler.record_span(
                     "executor_fwd_bwd", category="symbolic",
                     dev=str(self._ctx)):
-                outs, new_aux, grads = self._fwd_bwd_jit(
-                    arg_vals, aux_vals, keys, heads)
-                jax.block_until_ready(outs)
+                res = self._fwd_bwd_jit(arg_vals, aux_vals, keys, heads)
+                jax.block_until_ready(res[0])
         else:
-            outs, new_aux, grads = self._fwd_bwd_jit(
-                arg_vals, aux_vals, keys, heads)
+            res = self._fwd_bwd_jit(arg_vals, aux_vals, keys, heads)
+        if self._health_on:
+            outs, new_aux, grads, health_vec = res
+            self._last_health = health_vec  # stays on device until read
+        else:
+            outs, new_aux, grads = res
         for n, v, dev in zip(self._prog.aux_names, new_aux, aux_devs):
             self.aux_dict[n]._h.array = _to_device(v, dev)
         self.outputs = [NDArray(o) for o in outs]
@@ -388,8 +397,10 @@ class Executor:
                                         for _ in range(self._n_keys))
         # the NON-donating twin: these aux buffers stay live (the stash,
         # or aux_dict itself) and must survive the dispatch
-        _, _, grads = self._fwd_bwd_nd_jit(arg_vals, aux_vals, keys, heads)
-        self._store_grads(grads)
+        res = self._fwd_bwd_nd_jit(arg_vals, aux_vals, keys, heads)
+        if self._health_on:
+            self._last_health = res[3]
+        self._store_grads(res[2])
 
     def _store_grads(self, grads):
         for n, g in zip(self._grad_names, grads):

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import profiler as _profiler
+from .observability import health as _health
 from .observability import telemetry as _telemetry
 
 _lock = threading.Lock()
@@ -71,18 +72,27 @@ class ProgramEntry:
     `fwd_bwd` may donate its aux inputs (TPU); `fwd_bwd_nd` never does —
     the compatibility backward() path feeds it buffers that stay live.
     When donation is off they are the same jitted callable, so the pair
-    costs no extra trace."""
+    costs no extra trace.
+
+    `health` marks entries whose `fwd_bwd` appends the in-program
+    numerics summary (observability/health.py) and returns a 4-tuple
+    `(outputs, new_aux, grads, health_vec)`; the flag is part of the
+    cache key, so enabling the sentinel costs exactly one retrace per
+    program and disabling it costs zero."""
 
     __slots__ = ("prog", "fwd", "fwd_bwd", "fwd_bwd_nd", "donates_aux",
-                 "n_keys")
+                 "n_keys", "health", "health_layout")
 
-    def __init__(self, prog, fwd, fwd_bwd, fwd_bwd_nd, donates_aux, n_keys):
+    def __init__(self, prog, fwd, fwd_bwd, fwd_bwd_nd, donates_aux, n_keys,
+                 health=False, health_layout=None):
         self.prog = prog
         self.fwd = fwd
         self.fwd_bwd = fwd_bwd
         self.fwd_bwd_nd = fwd_bwd_nd
         self.donates_aux = donates_aux
         self.n_keys = n_keys
+        self.health = health
+        self.health_layout = health_layout
 
 
 def note_trace(kind):
@@ -115,7 +125,7 @@ def _note(event):
     _profiler.record_counter("exec_cache_" + event, value)
 
 
-def _signature(symbol, arg_dict, aux_dict, grad_names, platform):
+def _signature(symbol, arg_dict, aux_dict, grad_names, platform, health):
     fp = symbol.structural_hash()
     arg_sig = tuple(sorted(
         (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
@@ -123,10 +133,11 @@ def _signature(symbol, arg_dict, aux_dict, grad_names, platform):
     aux_sig = tuple(sorted(
         (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
         for n, a in aux_dict.items()))
-    return (fp, arg_sig, aux_sig, tuple(grad_names), platform)
+    return (fp, arg_sig, aux_sig, tuple(grad_names), platform,
+            bool(health))
 
 
-def _build_entry(symbol, known_shapes, grad_names, platform):
+def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     # lazy import: executor.py imports this module at its top level
     from .executor import _Program
 
@@ -145,6 +156,11 @@ def _build_entry(symbol, known_shapes, grad_names, platform):
         outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
         return outs, [new_aux[n] for n in aux_names]
 
+    # the sentinel layout is derived from the program's static structure
+    # (output count, grad-name order), never from traced values
+    health_layout = _health.HealthLayout(len(prog.entries), grad_names) \
+        if health else None
+
     def _fwd_bwd_impl(arg_vals, aux_vals, keys, head_grads):
         note_trace("fwd_bwd")
         arg_map = dict(zip(arg_names, arg_vals))
@@ -162,6 +178,13 @@ def _build_entry(symbol, known_shapes, grad_names, platform):
             else [jnp.ones_like(o) for o in outs]
         zeros_aux = [jnp.zeros_like(a) for a in new_aux]
         (grads,) = vjp_fn((heads, zeros_aux))
+        if health:
+            # in-program numerics summary: a few extra reductions over
+            # values this program already holds; the fused dispatch
+            # returns one small vector alongside its usual results
+            hvec = _health.pack_summary(health_layout, outs, gvals,
+                                        list(grads))
+            return outs, new_aux, grads, hvec
         return outs, new_aux, grads
 
     # donation halves the aux-state footprint, but jax only implements it
@@ -178,23 +201,33 @@ def _build_entry(symbol, known_shapes, grad_names, platform):
     _fwd_bwd_nd = jax.jit(_fwd_bwd_impl) if donate else _fwd_bwd
 
     return ProgramEntry(prog, _fwd, _fwd_bwd, _fwd_bwd_nd, bool(donate),
-                        n_keys)
+                        n_keys, health=bool(health),
+                        health_layout=health_layout)
 
 
-def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
+def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
+              health=None):
     """The shared ProgramEntry for this bind signature (building and
     inserting it on first sight).  arg_dict/aux_dict map name -> array-
     like with .shape/.dtype; grad_names is the ordered tuple of
     arguments whose gradients the backward program must produce;
     platform is the bind context's device platform (keys the entry and
-    gates aux donation)."""
+    gates aux donation); health (default: the MXNET_TPU_HEALTH env)
+    appends the in-program numerics summary to fwd_bwd and keys the
+    entry — gradient-free signatures never split on it, since only
+    fwd_bwd carries the sentinel."""
+    if health is None:
+        health = _health.enabled()
+    health = bool(health) and bool(grad_names)
     known = {n: tuple(int(d) for d in a.shape) for n, a in arg_dict.items()}
     known.update((n, tuple(int(d) for d in a.shape))
                  for n, a in aux_dict.items())
     if not _enabled():
         _note("misses")
-        return _build_entry(symbol, known, grad_names, platform)
-    key = _signature(symbol, arg_dict, aux_dict, grad_names, platform)
+        return _build_entry(symbol, known, grad_names, platform,
+                            health=health)
+    key = _signature(symbol, arg_dict, aux_dict, grad_names, platform,
+                     health)
     with _lock:
         entry = _entries.get(key)
         if entry is not None:
@@ -208,7 +241,8 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu"):
         _profiler.record_counter("exec_cache_hits", hits)
         return entry
     _note("misses")
-    entry = _build_entry(symbol, known, grad_names, platform)
+    entry = _build_entry(symbol, known, grad_names, platform,
+                         health=health)
     with _lock:
         # a concurrent bind may have built the same signature; first
         # insertion wins so every caller shares one traced program

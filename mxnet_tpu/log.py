@@ -16,6 +16,34 @@ INFO = logging.INFO
 DEBUG = logging.DEBUG
 NOTSET = logging.NOTSET
 
+# every framework logger lives under this root, so ONE handler (e.g.
+# the flight recorder's ring capture) sees the whole package's records
+PACKAGE_LOGGER = "mxnet_tpu"
+
+
+def package_logger():
+    """The single package root logger (``mxnet_tpu``).  The flight
+    recorder attaches its capture handler here; every module logger
+    below propagates into it."""
+    return logging.getLogger(PACKAGE_LOGGER)
+
+
+def module_logger(name):
+    """A per-module logger GUARANTEED to propagate to the package root.
+
+    Historically framework code logged through the bare ``logging``
+    module (the root logger) or ad-hoc names, which made one-point
+    capture impossible; pass ``__name__`` (or any suffix) and the
+    returned logger is namespaced under ``mxnet_tpu`` with propagation
+    on, so the flight recorder's single handler sees it."""
+    name = str(name)
+    if name != PACKAGE_LOGGER \
+            and not name.startswith(PACKAGE_LOGGER + "."):
+        name = PACKAGE_LOGGER + "." + name
+    logger = logging.getLogger(name)
+    logger.propagate = True
+    return logger
+
 _COLORS = {DEBUG: "\x1b[34m", INFO: "\x1b[32m"}  # default (>=WARNING): red
 _LABELS = {CRITICAL: "C", ERROR: "E", WARNING: "W", INFO: "I", DEBUG: "D"}
 

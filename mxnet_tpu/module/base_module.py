@@ -13,11 +13,15 @@ one jitted XLA program per step.
 from __future__ import annotations
 
 import logging
+import math
 import time
 
 from .. import metric as metric_mod
 from ..context import cpu
 from ..initializer import Uniform
+from ..log import module_logger as _module_logger
+from ..observability import flight_recorder as _flight
+from ..observability import health as _health
 from ..observability.instrument import StepTracker
 
 
@@ -68,7 +72,7 @@ def _check_input_names(symbol, names, typename, throw):
                % (typename, list(names), name, ", ".join(likely_inputs)))
         if throw:
             raise ValueError(msg)
-        logging.warning(msg)
+        _module_logger(__name__).warning(msg)
 
 
 class BaseModule:
@@ -80,7 +84,11 @@ class BaseModule:
     """
 
     def __init__(self, logger=logging):
-        self.logger = logger
+        # the historical default was the bare `logging` MODULE (the root
+        # logger) — route it under the package root instead so one
+        # handler (the flight recorder's) captures every module record
+        self.logger = _module_logger("module") if logger is logging \
+            else logger
         self.binded = False
         self.for_training = False
         self.inputs_need_grad = False
@@ -185,27 +193,38 @@ class BaseModule:
             else eval_metric)
         eval_metric = metric_mod.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            self._run_epoch(epoch, train_data, eval_metric,
-                            batch_end_callback, monitor)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                self._run_epoch(epoch, train_data, eval_metric,
+                                batch_end_callback, monitor)
 
-            # sync the trained values back into the module's param dicts so
-            # callbacks and the next epoch observe the same tensors
-            arg_now, aux_now = self.get_params()
-            self.set_params(arg_now, aux_now)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_now, aux_now)
+                # sync the trained values back into the module's param
+                # dicts so callbacks and the next epoch observe the same
+                # tensors
+                arg_now, aux_now = self.get_params()
+                self.set_params(arg_now, aux_now)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_now, aux_now)
 
-            if eval_data:
-                for name, val in self.score(
-                        eval_data, validation_metric,
-                        score_end_callback=eval_end_callback,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch):
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-            train_data.reset()
+                if eval_data:
+                    for name, val in self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        except _health.TrainingDivergedError:
+            raise  # the raise action already wrote the flight dump
+        except Exception as exc:
+            # black-box hook: an unattended run dying mid-fit leaves its
+            # last-N-steps record behind (opt-in with the sentinel)
+            if _health.enabled():
+                _flight.note_exception(exc)
+                _flight.dump_once(reason="fit_exception")
+            raise
 
     def _run_epoch(self, epoch, train_data, eval_metric,
                    batch_end_callback, monitor):
@@ -220,6 +239,11 @@ class BaseModule:
         tic = time.time()
         eval_metric.reset()
         tracker = StepTracker(epoch=epoch)
+        # health sentinel (MXNET_TPU_HEALTH=1): consume the per-step
+        # packed vector the in-program summary produced — one tiny
+        # device->host fetch per step, evaluated by the rolling rules
+        health_mon = self._ensure_health_monitor() \
+            if _health.enabled() else None
         it = iter(train_data)
         with tracker.component("data_wait"):
             batch = next(it, None)
@@ -238,6 +262,16 @@ class BaseModule:
                 # start the next batch's transfer while the step executes
                 with tracker.component("sync"):
                     self.prepare(upcoming)
+            pending_health = None
+            if health_mon is not None:
+                # AFTER the next batch's fetch/prepare: this blocks on
+                # the in-flight step, so capturing it earlier would
+                # serialize data loading behind device compute.  prepare
+                # never changes the active program for the in-flight
+                # step (BucketingModule switches back), so the stashed
+                # vector is still this step's.
+                with tracker.component("sync"):
+                    pending_health = self._capture_health()
             with tracker.component("metric"):
                 self.update_metric(eval_metric, batch.label)
             if monitor is not None:
@@ -247,13 +281,79 @@ class BaseModule:
                 _each_callback(batch_end_callback, BatchEndParam(
                     epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
                     locals=locals()))
-            tracker.step_end(nbatch)
+            timings = tracker.step_end(nbatch)
+            if pending_health is not None:
+                # record first, judge second: a raising rule's flight
+                # dump must already contain the offending step
+                step, summary = pending_health
+                _flight.record_step(step, epoch=epoch, batch=nbatch,
+                                    health=summary, timings=timings)
+                health_mon.observe(step, summary)
             batch = upcoming
             nbatch += 1
         for name, val in eval_metric.get_name_value():
             self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
         self.logger.info("Epoch[%d] Time cost=%.3f",
                          epoch, time.time() - tic)
+
+    # -- health sentinel plumbing --------------------------------------------
+    def _take_health_vector(self):
+        """Subclasses with a bound exec group override this to hand the
+        sentinel its per-step packed vector as ``(np_vector, layout)``;
+        the base implementation opts out."""
+        return None
+
+    def _ensure_health_monitor(self):
+        """One rolling-rule monitor per module, shared across epochs so
+        EMAs and windows span the whole run."""
+        mon = getattr(self, "_health_mon", None)
+        if mon is None:
+            mon = self._health_mon = _health.HealthMonitor(
+                logger=self.logger)
+        return mon
+
+    def _capture_health(self):
+        """Fetch + unpack this step's health vector.  Returns
+        ``(global_step, summary_dict)`` or None; also stashes the
+        summary for a ``Monitor(stats='health')`` to render and fills
+        the update/param ratio estimate on the general path (the fused
+        step computes the exact ratio in-program)."""
+        step = getattr(self, "_health_step", 0)
+        self._health_step = step + 1
+        taken = self._take_health_vector()
+        if taken is None:
+            return None
+        vec, layout = taken
+        summary = layout.unpack(vec)
+        opt = getattr(self, "_optimizer", None)
+        if summary.get("update_ratio", -1.0) < 0 and opt is not None:
+            gn = summary.get("grad_norm", float("nan"))
+            pn = summary.get("param_norm", 0.0)
+            if pn > 0 and math.isfinite(gn):
+                summary["update_ratio"] = \
+                    opt.health_update_scale() * gn / pn
+        self._last_health_summary = (step, summary)
+        return step, summary
+
+    def _install_health_monitor(self, mon):
+        """Bind a ``Monitor(stats='health')``: readings come from the
+        in-program sentinel summaries the fit loop stashes on THIS
+        module, so nothing is tapped and the fused one-program step
+        stays active — no separate-path fallback, no retrace
+        (regression-tested against the exec-cache trace counters)."""
+        mon.install_module(self)
+        if not getattr(self, "_health_mon_announced", False):
+            self._health_mon_announced = True
+            if _health.enabled():
+                self.logger.info(
+                    "monitor(stats='health') installed: per-step "
+                    "stats come from the in-program health sentinel;"
+                    " the fused train step stays active")
+            else:
+                self.logger.warning(
+                    "monitor(stats='health') installed but "
+                    "MXNET_TPU_HEALTH is not 1: the sentinel is off "
+                    "and the monitor will report nothing")
 
     # -- parameter persistence -----------------------------------------------
     def save_params(self, fname):

@@ -34,6 +34,7 @@ import numpy as np
 from .. import executor_cache as _exec_cache
 from .. import random as _random
 from ..ndarray import NDArray
+from ..observability import health as _health
 from ..optimizer import _is_low_precision
 
 
@@ -171,6 +172,17 @@ class FusedTrainStep:
         self._n_extra = int(getattr(self.opt, "fused_n_scalars", 0))
         self._needs_rng = bool(getattr(self.opt, "fused_needs_rng", False))
 
+        # health sentinel (MXNET_TPU_HEALTH=1): the step program appends
+        # the packed numerics vector — here the update/param ratio is
+        # EXACT, since the program holds both the old and new masters.
+        # Resolved at construction; the step function is rebuilt (and so
+        # retraced once) whenever the mode changes.
+        self._health_on = _health.enabled()
+        self.health_layout = _health.HealthLayout(
+            len(prog.entries), self.param_names) if self._health_on \
+            else None
+        self.last_health = None
+
         prog_ref = prog
         param_names = self.param_names
         other_names = self.other_names
@@ -181,6 +193,8 @@ class FusedTrainStep:
         n_params = len(param_names)
         n_extra = self._n_extra
         needs_rng = self._needs_rng
+        health_on = self._health_on
+        health_layout = self.health_layout
 
         # Buffer donation halves peak parameter memory, but on remote-
         # attached chips (tunneled runtimes) it forces per-step buffer
@@ -223,6 +237,21 @@ class FusedTrainStep:
                 new_states.append(nst)
                 new_exec.append(nw.astype(param_dtypes[j]) if mixed[j]
                                 else nw)
+            if health_on:
+                # exact update/param ratio: the program holds old AND
+                # new masters, so |Δw|/|w| needs no host-side estimate
+                upd_sq = sum(jnp.sum(jnp.square(
+                    nw.astype(jnp.float32) - w.astype(jnp.float32)))
+                    for w, nw in zip(masters, new_masters))
+                par_sq = sum(jnp.sum(jnp.square(w.astype(jnp.float32)))
+                             for w in masters)
+                ratio = jnp.sqrt(upd_sq) / jnp.maximum(
+                    jnp.sqrt(par_sq), jnp.float32(1e-12))
+                hvec = _health.pack_summary(health_layout, outs, masters,
+                                            list(grads),
+                                            update_ratio=ratio)
+                return (outs, new_masters, new_states, new_aux, new_exec,
+                        hvec)
             return outs, new_masters, new_states, new_aux, new_exec
 
         if self.n_dev == 1:
@@ -255,11 +284,22 @@ class FusedTrainStep:
         f32v = sds((n_params,), np.float32)
         exv = sds((n_params, max(n_extra, 1)), np.float32)
         kv = sds((2,), np.uint32)
-        outs_sd, _, _, _, _ = jax.eval_shape(
-            _step, mvals, others, svals, avals, keys, f32v, f32v, exv, kv)
+        outs_sd = jax.eval_shape(
+            _step, mvals, others, svals, avals, keys, f32v, f32v, exv,
+            kv)[0]
         # XLA derives the gradient all-reduce from these shardings — the
         # kvstore collective collapsed into the step program
         state_sh = [_map_state(lambda a: repl, st) for st in self.states]
+        out_sh = (
+            [dp if (len(o.shape) >= 1 and o.shape[0] == full_batch)
+             else repl for o in outs_sd],
+            [repl] * n_params,
+            state_sh,
+            [repl] * len(aux_names),
+            [repl] * n_params)
+        if health_on:
+            # the packed health vector is a global reduction: replicated
+            out_sh = out_sh + (repl,)
         self._step = jax.jit(
             _step,
             in_shardings=(
@@ -269,13 +309,7 @@ class FusedTrainStep:
                 [repl] * len(aux_names),
                 (repl,) * exe._n_keys,
                 repl, repl, repl, repl),
-            out_shardings=(
-                [dp if (len(o.shape) >= 1 and o.shape[0] == full_batch)
-                 else repl for o in outs_sd],
-                [repl] * n_params,
-                state_sh,
-                [repl] * len(aux_names),
-                [repl] * n_params),
+            out_shardings=out_sh,
             donate_argnums=(0, 2) if donate else ())
         self._scattered = {}
 
@@ -353,9 +387,11 @@ class FusedTrainStep:
         aux_vals = list(self._gaux)
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        outs, new_masters, new_states, new_aux, new_exec = self._step(
+        res = self._step(
             self._masters, other_vals, self.states, aux_vals, keys, lrs,
             wds, extras, opt_key)
+        outs, new_masters, new_states, new_aux, new_exec = res[:5]
+        self.last_health = res[5] if self._health_on else None
 
         self._masters = list(new_masters)
         self.states = list(new_states)
@@ -425,9 +461,11 @@ class FusedTrainStep:
         lrs, wds, extras, opt_key = self._per_step_scalars()
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        outs, new_masters, new_states, new_aux, new_exec = self._step(
+        res = self._step(
             self._masters, other_vals, self.states, self._gaux, keys, lrs,
             wds, extras, opt_key)
+        outs, new_masters, new_states, new_aux, new_exec = res[:5]
+        self.last_health = res[5] if self._health_on else None
 
         self._masters = list(new_masters)
         self.states = list(new_states)

@@ -15,7 +15,10 @@ import logging
 import pickle
 import warnings
 
+import numpy as np
+
 from ..context import cpu
+from ..observability import health as _health
 from ..initializer import Uniform, InitDesc
 from ..io import DataDesc
 from ..ndarray import zeros as nd_zeros
@@ -115,11 +118,11 @@ class Module(BaseModule):
         self._symbol.save("%s-symbol.json" % prefix)
         param_file = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_file)
-        logging.info('Saved checkpoint to "%s"', param_file)
+        self.logger.info('Saved checkpoint to "%s"', param_file)
         if save_optimizer_states:
             state_file = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_file)
-            logging.info('Saved optimizer state to "%s"', state_file)
+            self.logger.info('Saved optimizer state to "%s"', state_file)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -547,6 +550,11 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        if getattr(mon, "stats", "tensors") == "health":
+            self._install_health_monitor(mon)
+            return
+        # legacy tensor-tap mode: per-op stats need the uncompiled
+        # evaluate pass — the separate-path warning belongs HERE only
         self._exec_group.install_monitor(mon)
         if getattr(self, "_fused_step", None) is not None:
             # the fused one-program step has no per-op tap points — a
@@ -562,6 +570,32 @@ class Module(BaseModule):
             # _fused_pending is left alone: a fused forward_backward that
             # already applied its update must still turn the matching
             # update() into a no-op (update() checks the flag first)
+
+    def _take_health_vector(self):
+        """Consume this step's packed health vector: ``(np_vector,
+        layout)`` or None when the sentinel is off / nothing was
+        dispatched.  ONE tiny device->host transfer per step — the
+        whole point of the in-program sentinel (contrast the legacy
+        monitor's per-tensor taps)."""
+        fs = getattr(self, "_fused_step", None)
+        if fs is not None and getattr(fs, "last_health", None) is not None:
+            vec = fs.last_health
+            fs.last_health = None
+            return np.asarray(vec), fs.health_layout
+        group = self._exec_group
+        if group is None or not group.execs:
+            return None
+        vecs, layout = [], None
+        for exe in group.execs:
+            vec = getattr(exe, "_last_health", None)
+            if vec is None:
+                return None  # health off, or no fused dispatch yet
+            vecs.append(np.asarray(vec))
+            layout = exe.health_layout
+            exe._last_health = None
+        if len(vecs) == 1:
+            return vecs[0], layout
+        return _health.combine(vecs, layout), layout
 
     def prepare(self, data_batch):
         pass

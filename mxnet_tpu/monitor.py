@@ -1,9 +1,20 @@
 """Monitor: tensor-level introspection.
 
 API parity: python/mxnet/monitor.py:33 (C-level hook SetMonitorCallback,
-graph_executor.cc:121).  Our Executor runs an uncompiled tap pass when a
-monitor is installed, feeding every op output whose name matches the
-pattern through `stat_func` between tic() and toc().
+graph_executor.cc:121).  Two stat modes:
+
+- ``stats="tensors"`` (legacy): the Executor runs an uncompiled tap
+  pass when the monitor is installed, feeding every op output whose
+  name matches the pattern through `stat_func` between tic() and
+  toc().  This forces the separate (non-fused) dispatch path — the
+  per-op taps need the uncompiled evaluate — and Module warns once
+  about the fallback.
+- ``stats="health"``: readings come from the in-program health
+  sentinel summaries (``MXNET_TPU_HEALTH=1``,
+  observability/health.py) — grad/param norms, per-group max|g|,
+  update ratio, finiteness — so the monitor RIDES THE FUSED PATH with
+  zero extra dispatches and zero retraces.  Rows render as
+  ``health/<slot>`` names, filtered by the same ``pattern``.
 """
 from __future__ import annotations
 
@@ -33,15 +44,22 @@ def _render(value):
 
 
 class Monitor:
-    """Collect per-tensor statistics every `interval` batches."""
+    """Collect per-tensor (or sentinel-health) statistics every
+    `interval` batches."""
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 stats="tensors"):
+        if stats not in ("tensors", "health"):
+            raise ValueError("stats must be 'tensors' or 'health', got %r"
+                             % (stats,))
+        self.stats = stats
         self.stat_func = stat_func or _default_stat
         self.interval = interval
         self.activated = False
         self.queue = []
         self.step = 0
         self.exes = []
+        self._module = None
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
@@ -52,9 +70,18 @@ class Monitor:
         self.stat_helper = stat_helper
 
     def install(self, exe):
-        """Hook this monitor into an executor's output tap."""
+        """Hook this monitor into an executor's output tap (legacy
+        tensor mode; a health-stat monitor taps nothing — the fused
+        program already computes its summaries)."""
+        if self.stats == "health":
+            return
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
+
+    def install_module(self, module):
+        """Health mode: read the module's per-step sentinel summary
+        (set by the fit loop) instead of executor taps."""
+        self._module = module
 
     def _sync_args(self):
         for exe in self.exes:
@@ -74,6 +101,19 @@ class Monitor:
         [(step, name, rendered_value)]."""
         if not self.activated:
             return []
+        if self.stats == "health":
+            self.activated = False
+            payload = getattr(self._module, "_last_health_summary", None) \
+                if self._module is not None else None
+            if payload is None:
+                return []
+            step, summary = payload
+            results = [(step, "health/" + key, "%g" % value)
+                       for key, value in summary.items()
+                       if self.re_prog.match("health/" + key)]
+            if self.sort:
+                results.sort(key=lambda item: item[1])
+            return results
         self._sync_args()
         for exe in self.exes:
             for name, arr in exe.arg_dict.items():

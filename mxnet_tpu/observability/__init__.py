@@ -17,6 +17,12 @@ Three layers, lowest first:
   (data_wait / fwd_bwd_dispatch / update / metric / sync), the
   input-starvation accounting behind ``io.DataIter``, kvstore push/pull
   bytes+latency, and the device-memory gauge.
+- ``flight_recorder`` — the bounded black box: last-N step records,
+  recent ``mxnet_tpu.*`` log lines, anomalies and events, dumped as one
+  JSON file on anomaly / unhandled exception / demand.
+- ``health`` — the training health sentinel: the in-program numerics
+  summary (``MXNET_TPU_HEALTH=1``) and the host-side ``HealthMonitor``
+  anomaly rules (docs/observability.md §health).
 
 Every callsite stays OUTSIDE jitted bodies: instrumentation must never
 change a traced program (the exec-cache trace counters prove it adds
@@ -27,8 +33,13 @@ from __future__ import annotations
 from . import tracing
 from . import telemetry
 from . import instrument
+from . import flight_recorder
+from . import health
 from .tracing import span, emit_instant
 from .telemetry import counter, gauge, histogram, snapshot
+from .health import HealthMonitor, TrainingDivergedError
 
-__all__ = ["tracing", "telemetry", "instrument", "span", "emit_instant",
-           "counter", "gauge", "histogram", "snapshot"]
+__all__ = ["tracing", "telemetry", "instrument", "flight_recorder",
+           "health", "span", "emit_instant", "counter", "gauge",
+           "histogram", "snapshot", "HealthMonitor",
+           "TrainingDivergedError"]

@@ -1,0 +1,319 @@
+"""Flight recorder: a bounded black box for long unattended runs.
+
+A production training job that dies at step 12,400 of an overnight run
+must leave evidence behind.  The recorder keeps rings of recent state —
+the last ``MXNET_TPU_FLIGHT_STEPS`` (default 512) per-step records
+(health summary, step-breakdown timings, exec-cache trace counters),
+the last 200 ``mxnet_tpu.*`` log records (via a handler on the package
+root logger), recent discrete events (anomalies, serving failures,
+exceptions) — plus an env/config fingerprint, and dumps them all as ONE
+strict-JSON file:
+
+- on anomaly (``HealthMonitor`` actions ``dump``/``raise``),
+- on unhandled exception in ``fit`` / the serving dispatch thread
+  (hooks; gated on ``MXNET_TPU_HEALTH=1``),
+- on demand (``flight_recorder.dump()``).
+
+``tools/traceview.py --flight <dump.json>`` renders the dump: first
+anomaly step, per-rule counts, grad/loss trend table, and exits 1 when
+the dump contains a fired anomaly (CI-friendly).
+
+Everything here is host-side bookkeeping over a few scalars per step —
+no device syncs, no effect on traced programs.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from collections import deque
+
+from . import telemetry as _telemetry
+
+_STEPS_ENV = "MXNET_TPU_FLIGHT_STEPS"
+_PATH_ENV = "MXNET_TPU_FLIGHT_PATH"
+DEFAULT_STEPS = 512
+LOG_CAPACITY = 200
+EVENT_CAPACITY = 64
+
+# env fingerprint: every knob that could explain a divergence later
+_FINGERPRINT_PREFIXES = ("MXNET_TPU_", "JAX_", "XLA_", "DMLC_")
+
+
+def _json_safe(obj):
+    """Recursively convert to strict-JSON values: non-finite floats
+    become the telemetry exporters' string tokens, numpy scalars become
+    python numbers, unknown objects become their repr."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, bool) or obj is None or isinstance(obj, str):
+        return obj
+    if isinstance(obj, int):
+        return obj
+    if isinstance(obj, float):
+        if math.isfinite(obj):
+            return obj
+        return "NaN" if math.isnan(obj) else (
+            "Infinity" if obj > 0 else "-Infinity")
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return _json_safe(item())
+        except Exception:
+            pass
+    return repr(obj)
+
+
+class _RingHandler(logging.Handler):
+    """Captures formatted log records into a bounded deque.
+
+    Appends under the recorder's lock so ``dump()`` can snapshot the
+    ring without racing a concurrent emit (list(deque) raises if the
+    deque mutates mid-iteration)."""
+
+    def __init__(self, ring, lock):
+        super().__init__(level=logging.NOTSET)
+        self._ring = ring
+        self._ring_lock = lock
+
+    def emit(self, record):
+        try:
+            entry = {
+                "t": record.created,
+                "level": record.levelname,
+                "logger": record.name,
+                "message": record.getMessage(),
+            }
+            with self._ring_lock:
+                self._ring.append(entry)
+        except Exception:  # a log hook must never take the caller down
+            pass
+
+
+class FlightRecorder:
+    """The process black box.  Thread-safe; all rings are bounded."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            raw = os.environ.get(_STEPS_ENV, "")
+            try:
+                capacity = int(raw) if raw else DEFAULT_STEPS
+            except ValueError:
+                # the black box must not take a healthy run down — same
+                # posture as dump(): warn and carry on with the default
+                logging.getLogger("mxnet_tpu").warning(
+                    "ignoring malformed %s=%r (want an integer); using "
+                    "%d", _STEPS_ENV, raw, DEFAULT_STEPS)
+                capacity = DEFAULT_STEPS
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._steps = deque(maxlen=self.capacity)
+        self._events = deque(maxlen=EVENT_CAPACITY)
+        self._logs = deque(maxlen=LOG_CAPACITY)
+        self._anomalies = []
+        self._handler = None
+        self._dumped_reasons = set()
+        self._dump_seq = 0
+        self.last_dump_path = None
+
+    # -- capture -------------------------------------------------------------
+
+    def install_log_capture(self):
+        """Attach the ring handler to the ``mxnet_tpu`` package root
+        logger (every module logger propagates there — log.py's
+        single-root contract), once per recorder."""
+        if self._handler is not None:
+            return
+        self._handler = _RingHandler(self._logs, self._lock)
+        logging.getLogger("mxnet_tpu").addHandler(self._handler)
+
+    def remove_log_capture(self):
+        if self._handler is not None:
+            logging.getLogger("mxnet_tpu").removeHandler(self._handler)
+            self._handler = None
+
+    def record_step(self, step, epoch=0, batch=None, health=None,
+                    timings=None, extra=None):
+        """One per-step record: the unpacked health summary, the
+        StepTracker component timings (ms), and the exec-cache retrace
+        counters at this step (so a dump shows exactly when a recompile
+        landed)."""
+        from .. import executor_cache  # lazy: avoids an import cycle
+        entry = {"step": int(step), "epoch": int(epoch), "t": time.time(),
+                 "exec_cache": executor_cache.trace_counts()}
+        if batch is not None:
+            entry["batch"] = int(batch)
+        if health is not None:
+            entry["health"] = dict(health)
+        if timings is not None:
+            entry["timings"] = dict(timings)
+        if extra is not None:
+            entry["extra"] = dict(extra)
+        with self._lock:
+            self._steps.append(entry)
+
+    def note(self, kind, payload=None):
+        """One discrete event (serving failure, checkpoint, ...)."""
+        event = {"kind": str(kind), "t": time.time()}
+        if payload is not None:
+            event["payload"] = payload
+        with self._lock:
+            self._events.append(event)
+
+    def note_anomaly(self, record):
+        """A fired health anomaly (called by ``HealthMonitor``)."""
+        with self._lock:
+            self._anomalies.append(dict(record))
+        self.note("anomaly", {"rule": record.get("rule"),
+                              "step": record.get("step")})
+
+    def note_exception(self, exc):
+        """An unhandled exception on its way out (fit/serving hooks)."""
+        tb = traceback.format_exception(type(exc), exc, exc.__traceback__)
+        self.note("exception", {"type": type(exc).__name__,
+                                "message": str(exc),
+                                "traceback": "".join(tb)[-4000:]})
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def first_anomaly_step(self):
+        with self._lock:
+            return self._anomalies[0]["step"] if self._anomalies else None
+
+    def steps_recorded(self):
+        with self._lock:
+            return len(self._steps)
+
+    def fingerprint(self):
+        """Env/config snapshot: relevant env vars, interpreter, backend."""
+        env = {k: v for k, v in sorted(os.environ.items())
+               if k.startswith(_FINGERPRINT_PREFIXES)}
+        fp = {"pid": os.getpid(),
+              "argv0": sys.argv[0] if sys.argv else "",
+              "python": sys.version.split()[0],
+              "env": env}
+        try:
+            import jax
+            fp["jax"] = jax.__version__
+            fp["backend"] = jax.default_backend()
+        except Exception:
+            pass
+        return fp
+
+    # -- the dump ------------------------------------------------------------
+
+    def _default_path(self, reason):
+        explicit = os.environ.get(_PATH_ENV)
+        if explicit:
+            return explicit
+        self._dump_seq += 1
+        return os.path.join(
+            tempfile.gettempdir(),
+            "mxnet_tpu_flight_%d_%02d_%s.json"
+            % (os.getpid(), self._dump_seq, reason))
+
+    def dump(self, path=None, reason="on_demand"):
+        """Write the black box as one strict-JSON file and return its
+        path.  Never raises into the caller — a failing dump on the way
+        out of a dying run must not mask the original error."""
+        # fingerprint/telemetry can be slow (may resolve the jax
+        # backend) and may themselves log — build them OUTSIDE the lock
+        # so concurrent record_step/emit calls never stall or deadlock
+        fingerprint = self.fingerprint()
+        try:
+            telemetry_snap = _telemetry.snapshot()
+        except Exception:
+            telemetry_snap = {}
+        with self._lock:
+            doc = {
+                "kind": "mxnet_tpu_flight",
+                "version": 1,
+                "reason": reason,
+                "created": time.time(),
+                "created_iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "capacity": self.capacity,
+                "fingerprint": fingerprint,
+                "steps": list(self._steps),
+                "events": list(self._events),
+                "anomalies": list(self._anomalies),
+                "first_anomaly_step": (self._anomalies[0]["step"]
+                                       if self._anomalies else None),
+                "logs": list(self._logs),
+            }
+        doc["telemetry"] = telemetry_snap
+        if path is None:
+            path = self._default_path(reason)
+        try:
+            with open(path, "w") as f:
+                json.dump(_json_safe(doc), f, allow_nan=False)
+        except Exception:
+            logging.getLogger("mxnet_tpu").exception(
+                "flight recorder dump to %r failed", path)
+            return None
+        self.last_dump_path = path
+        self._dumped_reasons.add(reason)
+        return path
+
+    def dump_once(self, reason, path=None):
+        """Dump unless this reason already produced one this process —
+        the hook form for failure paths that can repeat (every failed
+        serving batch must not write a new file)."""
+        with self._lock:
+            if reason in self._dumped_reasons:
+                return None
+        return self.dump(path=path, reason=reason)
+
+
+# -- process-wide singleton ----------------------------------------------------
+
+_recorder = None
+_singleton_lock = threading.Lock()
+
+
+def get_recorder():
+    """The process recorder (created on first use, log capture armed)."""
+    global _recorder
+    with _singleton_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+            _recorder.install_log_capture()
+        return _recorder
+
+
+def record_step(step, **kwargs):
+    get_recorder().record_step(step, **kwargs)
+
+
+def note(kind, payload=None):
+    get_recorder().note(kind, payload)
+
+
+def note_exception(exc):
+    get_recorder().note_exception(exc)
+
+
+def dump(path=None, reason="on_demand"):
+    return get_recorder().dump(path=path, reason=reason)
+
+
+def dump_once(reason, path=None):
+    return get_recorder().dump_once(reason, path=path)
+
+
+def reset():
+    """Drop the recorder (tests; re-reads ``MXNET_TPU_FLIGHT_STEPS`` on
+    next use)."""
+    global _recorder
+    with _singleton_lock:
+        if _recorder is not None:
+            _recorder.remove_log_capture()
+        _recorder = None

@@ -140,18 +140,23 @@ class StepTracker:
         return _Component(self, name)
 
     def step_end(self, nbatch):
+        """Close out the step.  Returns the per-component millisecond
+        breakdown (plus ``total``) so callers — the flight recorder —
+        can keep the last-N of them, or None when no component ran."""
         if self._step_t0 is None:
-            return
+            return None
         if self._handle_key != (telemetry.registry_epoch(),
                                 telemetry.enabled()):
             self._resolve_handles()
         dur = self._last_end - self._step_t0
         args = {"span_id": self._step_span_id, "step": nbatch,
                 "epoch": self.epoch}
+        timings = {}
         for c in STEP_COMPONENTS:
             ms = self._parts[c] / 1e3
-            args[c + "_ms"] = round(ms, 4)
+            args[c + "_ms"] = timings[c] = round(ms, 4)
             self._hists[c].observe(ms)
+        timings["total"] = round(dur / 1e3, 4)
         self._hist_total.observe(dur / 1e3)
         self._steps.inc()
         if tracing.is_recording():
@@ -164,6 +169,7 @@ class StepTracker:
             # nobody is listening
             sample_device_memory(self._mem_gauge)
         self._reset_step()
+        return timings
 
 
 def sample_device_memory(gauge=None):

@@ -129,6 +129,18 @@ class Optimizer:
         its (mom, w32) layout)."""
         return (master_nd,) + (state_nd,)
 
+    def health_update_scale(self, index=0):
+        """Host-side magnitude of this optimizer's step per unit raw
+        gradient: ``lr * |rescale_grad|``.  The health sentinel's
+        general (non-fused) path carries grad/param norms in its packed
+        vector but not the applied update, so the update/param ratio is
+        estimated as ``scale * grad_norm / param_norm`` — exact ratios
+        come from the fused train step, which holds both old and new
+        weights in-program.  Momentum/adaptive terms are deliberately
+        ignored: this is a divergence detector's order-of-magnitude
+        signal, not an optimizer trace."""
+        return float(abs(self._get_lr(index)) * abs(self.rescale_grad))
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise MXNetError("LRScheduler of the optimizer has already been defined.")

@@ -31,6 +31,8 @@ from concurrent.futures import InvalidStateError
 
 import numpy as np
 
+from ..observability import flight_recorder as _flight
+from ..observability import health as _health
 from ..observability import tracing
 from . import metrics
 from .registry import bucket_for
@@ -160,9 +162,25 @@ class DynamicBatcher:
                     outs = model.run_batch(bucket, padded)
                 metrics.record_dispatch_ms((time.monotonic() - t0) * 1e3)
             metrics.record_batch(name, bucket, rows)
+            if _health.enabled():
+                self._note_output_health(name, bucket, outs)
             self._split(batch, outs, bucket)
         except Exception as exc:  # the dispatch thread must survive
             self._fail_batch(batch, exc, name)
+
+    @staticmethod
+    def _note_output_health(model_name, bucket, outs):
+        """Served-output numerics check (opt-in with the health
+        sentinel): host-side isfinite over the already-fetched output
+        arrays — no device sync, no program change.  Warn-only; the
+        batch still ships."""
+        bad = [i for i, o in enumerate(outs)
+               if not np.all(np.isfinite(np.asarray(o)))]
+        if bad:
+            metrics.record_nonfinite_response(model_name, len(bad))
+            _flight.note("serving_nonfinite",
+                         {"model": model_name, "bucket": bucket,
+                          "outputs": bad})
 
     @staticmethod
     def _fail_batch(batch, exc, model_name):
@@ -171,6 +189,16 @@ class DynamicBatcher:
         contract: requests_total minus rejected_total equals responses,
         so a 4-request batch failure must count 4, not 1)."""
         reason = getattr(exc, "reason", "dispatch_error")
+        if _health.enabled():
+            # black-box hook BEFORE the futures resolve: by the time a
+            # client sees the error, the dump exists.  dump_once — a
+            # persistently failing model must not write a file per
+            # batch, so only the process's FIRST failure pays the write
+            _flight.note("serving_dispatch_error",
+                         {"model": model_name,
+                          "error": "%s: %s" % (type(exc).__name__, exc),
+                          "requests": len(batch)})
+            _flight.dump_once(reason="serving_exception")
         for r in batch:
             if _fail_future(r.future, exc):
                 metrics.record_rejection(reason, model=model_name)

@@ -72,6 +72,20 @@ def record_dispatch_ms(ms):
                         help="executor wall time per batch").observe(ms)
 
 
+def record_nonfinite_response(model, n_outputs):
+    """Served-output health (MXNET_TPU_HEALTH=1): a dispatched batch
+    produced non-finite values in ``n_outputs`` of its outputs.  The
+    responses still ship (warn-only — the caller may legitimately serve
+    inf logits), but the counter + instant make a poisoned model
+    visible without client reports."""
+    telemetry.counter("serving.nonfinite_responses",
+                      help="batches with non-finite output values").inc()
+    if tracing.is_recording():
+        tracing.emit_instant("serving_nonfinite", category="serving",
+                             args={"model": model,
+                                   "outputs": n_outputs})
+
+
 def record_request_done(request, t_done):
     """Request completed: latency histograms + the request/queue spans.
     Spans are emitted from the dispatch thread with explicit timestamps

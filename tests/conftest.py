@@ -30,9 +30,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 _FAST_MODULES = {
     "test_analysis", "test_autograd", "test_executor_cache",
     "test_fused_extra", "test_fused_optimizers", "test_gluon_data",
-    "test_io_metric_kvstore", "test_kvstore_ici", "test_module",
-    "test_ndarray", "test_namespaces", "test_optimizer", "test_symbol",
-    "test_elastic", "test_serving",
+    "test_health", "test_io_metric_kvstore", "test_kvstore_ici",
+    "test_module", "test_ndarray", "test_namespaces", "test_optimizer",
+    "test_symbol", "test_elastic", "test_serving",
 }
 
 

@@ -1,0 +1,526 @@
+"""Training health monitor + flight recorder: in-program sentinel,
+anomaly rules, post-mortem dumps (observability/health.py,
+observability/flight_recorder.py, docs/observability.md §health)."""
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import math
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache
+from mxnet_tpu.observability import (flight_recorder, health, telemetry,
+                                     tracing)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """Health off unless the test opts in; fresh registry/recorder."""
+    monkeypatch.delenv("MXNET_TPU_HEALTH", raising=False)
+    monkeypatch.delenv("MXNET_TPU_HEALTH_RULES", raising=False)
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_PATH", raising=False)
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_STEPS", raising=False)
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+    flight_recorder.reset()
+    yield
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+    flight_recorder.reset()
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="h_fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="h_relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="h_fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _iter(nan_batch=None, n=24, bs=8, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, dim).astype(np.float32)
+    y = rng.randint(0, 4, (n,)).astype(np.float32)
+    if nan_batch is not None:
+        x[nan_batch * bs:(nan_batch + 1) * bs] = np.nan
+    return mx.io.NDArrayIter(x, y, batch_size=bs)
+
+
+def _fit(it, **kwargs):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1},
+            **kwargs)
+    return mod
+
+
+def _healthy(step, grad=1.0, loss=0.5, **over):
+    s = {"finite_mask": 1.0, "out_mean": loss, "grad_norm": grad,
+         "param_norm": 2.0, "update_ratio": 0.01, "all_finite": 1.0}
+    s.update(over)
+    return s
+
+
+# -- layout + packing --------------------------------------------------------
+
+def test_layout_slots_and_unpack_roundtrip():
+    layout = health.HealthLayout(2, ["a", "b", "c"], max_groups=2)
+    assert layout.slots[:5] == list(health.HealthLayout.HEAD)
+    assert layout.width == 5 + 2
+    assert layout.full_mask == 3.0
+    vec = [3.0, 0.5, 1.25, 2.0, -1.0, 0.1, 0.2]
+    summary = layout.unpack(vec)
+    assert summary["all_finite"] == 1.0
+    assert summary["grad_norm"] == 1.25
+    # one cleared bit -> not all finite
+    vec[0] = 1.0
+    assert layout.unpack(vec)["all_finite"] == 0.0
+    with pytest.raises(ValueError):
+        layout.unpack(vec[:-1])
+
+
+def test_pack_summary_detects_nonfinite_output():
+    import jax.numpy as jnp
+    layout = health.HealthLayout(2, ["w"])
+    outs = [jnp.ones((2, 2)), jnp.ones((3,))]
+    params = [jnp.full((2,), 2.0)]
+    grads = [jnp.array([3.0, 4.0])]
+    vec = np.asarray(health.pack_summary(layout, outs, params, grads))
+    summary = layout.unpack(vec)
+    assert summary["finite_mask"] == layout.full_mask
+    assert summary["grad_norm"] == pytest.approx(5.0)
+    assert summary["param_norm"] == pytest.approx(math.sqrt(8.0))
+    assert summary["update_ratio"] == -1.0
+    assert summary["max_abs_grad/w"] == pytest.approx(4.0)
+    # NaN in output 1 clears exactly bit 1
+    outs[1] = jnp.array([1.0, float("nan"), 1.0])
+    vec = np.asarray(health.pack_summary(layout, outs, params, grads))
+    assert layout.unpack(vec)["finite_mask"] == 1.0
+
+
+def test_combine_multi_exec_vectors():
+    layout = health.HealthLayout(1, ["w"])
+    a = [1.0, 0.4, 3.0, 7.0, -1.0, 0.5]
+    b = [1.0, 0.6, 4.0, 7.0, 0.2, 0.9]
+    merged = layout.unpack(health.combine([a, b], layout))
+    assert merged["all_finite"] == 1.0
+    assert merged["out_mean"] == pytest.approx(0.5)
+    assert merged["grad_norm"] == pytest.approx(5.0)  # l2 of (3, 4)
+    assert merged["update_ratio"] == pytest.approx(0.2)
+    assert merged["max_abs_grad/w"] == pytest.approx(0.9)
+    # a non-finite mask in one exec clears the merged mask
+    b[0] = 0.0
+    assert layout.unpack(health.combine([a, b], layout))["all_finite"] \
+        == 0.0
+
+
+# -- anomaly rules (synthetic fixtures: each fires exactly its rule) ---------
+
+def test_rule_nonfinite_fires_alone_and_raises():
+    mon = health.HealthMonitor()
+    for step in range(10):
+        assert mon.observe(step, _healthy(step)) == []
+    with pytest.raises(health.TrainingDivergedError) as err:
+        mon.observe(10, _healthy(10, grad=float("nan"),
+                                 loss=float("nan"), all_finite=0.0))
+    assert err.value.step == 10 and err.value.rule == "nonfinite"
+    assert "step 10" in str(err.value)
+    assert [a["rule"] for a in mon.anomalies] == ["nonfinite"]
+    assert telemetry.snapshot()["health.anomalies.nonfinite"]["value"] \
+        == 1.0
+
+
+def test_rule_grad_spike_fires_alone():
+    mon = health.HealthMonitor(spike_factor=10.0, warmup_steps=5)
+    for step in range(20):
+        assert mon.observe(step, _healthy(step, grad=1.0)) == []
+    fired = mon.observe(20, _healthy(20, grad=1000.0))
+    assert [a["rule"] for a in fired] == ["grad_spike"]
+    assert mon.first_anomaly["step"] == 20
+    # warn action: no raise, counted once
+    assert telemetry.snapshot()["health.anomalies.grad_spike"]["value"] \
+        == 1.0
+
+
+def test_rule_loss_explosion_fires_alone():
+    mon = health.HealthMonitor(explode_factor=100.0, warmup_steps=5)
+    for step in range(10):
+        assert mon.observe(step, _healthy(step, loss=1.0)) == []
+    fired = mon.observe(10, _healthy(10, loss=1e5))
+    assert [a["rule"] for a in fired] == ["loss_explosion"]
+
+
+def test_rule_plateau_opt_in_fires_once(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH_RULES", "loss_plateau=warn")
+    mon = health.HealthMonitor(plateau_window=10, plateau_rtol=1e-6)
+    fired_all = []
+    for step in range(30):
+        fired_all += mon.observe(step, _healthy(step, loss=0.5))
+    assert [a["rule"] for a in fired_all] == ["loss_plateau"]  # once
+    # default actions leave plateau off entirely
+    monkeypatch.delenv("MXNET_TPU_HEALTH_RULES")
+    mon2 = health.HealthMonitor(plateau_window=10, plateau_rtol=1e-6)
+    for step in range(30):
+        assert mon2.observe(step, _healthy(step, loss=0.5)) == []
+
+
+def test_rule_actions_env_parse(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH_RULES",
+                       "nonfinite=dump, grad_spike=off, bogus=warn, "
+                       "loss_explosion=banana")
+    actions = health.rule_actions()
+    assert actions["nonfinite"] == "dump"
+    assert actions["grad_spike"] == "off"
+    # malformed entries fall back to defaults
+    assert actions["loss_explosion"] \
+        == health.DEFAULT_ACTIONS["loss_explosion"]
+
+
+def test_callbacks_fire_before_action():
+    seen = []
+    mon = health.HealthMonitor(actions={"nonfinite": "warn"})
+    mon.add_callback(lambda rec: seen.append(rec["rule"]))
+    mon.observe(0, _healthy(0, all_finite=0.0))
+    assert seen == ["nonfinite"]
+
+
+def test_multi_rule_step_one_dump_most_severe_raise_wins(monkeypatch,
+                                                         tmp_path):
+    """A step firing several rules writes ONE dump holding them all,
+    and the first (most severe) raise-action rule names the error."""
+    mon = health.HealthMonitor(
+        actions={"nonfinite": "raise", "grad_spike": "raise"},
+        spike_factor=10.0, warmup_steps=5)
+    for step in range(20):
+        assert mon.observe(step, _healthy(step, grad=1.0)) == []
+    rec = flight_recorder.get_recorder()
+    real_dump, calls = rec.dump, []
+    def counting_dump(path=None, reason="on_demand"):
+        calls.append(reason)
+        return real_dump(path=str(tmp_path / "multi.json"), reason=reason)
+    monkeypatch.setattr(rec, "dump", counting_dump)
+    with pytest.raises(health.TrainingDivergedError) as err:
+        mon.observe(20, _healthy(20, grad=500.0, all_finite=0.0))
+    assert err.value.rule == "nonfinite"
+    assert calls == ["anomaly_nonfinite"]
+    dumped = json.loads((tmp_path / "multi.json").read_text())
+    assert [a["rule"] for a in dumped["anomalies"]] \
+        == ["nonfinite", "grad_spike"]
+
+
+# -- integration: NaN injection through fit ----------------------------------
+
+def test_fit_nan_injection_diverges_with_dump(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    dump_path = str(tmp_path / "flight.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+    with pytest.raises(mx.TrainingDivergedError) as err:
+        _fit(_iter(nan_batch=1))
+    assert err.value.step == 1
+    assert err.value.dump_path == dump_path
+    doc = json.load(open(dump_path))
+    assert doc["first_anomaly_step"] == 1
+    assert [s["step"] for s in doc["steps"]] == [0, 1]
+    assert doc["steps"][0]["health"]["all_finite"] == 1.0
+    assert doc["steps"][1]["health"]["finite_mask"] == 0.0
+    # traceview resolves the same step and exits 1 (the CI contract)
+    tv = _load_traceview()
+    assert tv.flight_stats(doc)["first_anomaly_step"] == 1
+    assert tv.main(["--flight", dump_path]) == 1
+
+
+def _load_traceview():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_tv_health", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_health_off_adds_nothing(monkeypatch):
+    """MXNET_TPU_HEALTH=0: zero added recompiles vs a second identical
+    run, zero health telemetry series, zero flight records."""
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "0")
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    _fit(_iter())
+    first = executor_cache.trace_counts()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    _fit(_iter())
+    assert executor_cache.trace_counts() == first
+    snap = telemetry.snapshot()
+    assert not any(k.startswith("health.") for k in snap), sorted(snap)
+    assert flight_recorder.get_recorder().steps_recorded() == 0
+
+
+def test_health_on_costs_at_most_one_retrace(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "0")
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    _fit(_iter())
+    off = executor_cache.trace_counts()
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    mod = _fit(_iter())
+    on = executor_cache.trace_counts()
+    assert sum(on.values()) - sum(off.values()) <= 1, (on, off)
+    snap = telemetry.snapshot()
+    assert snap["health.steps"]["value"] == 3.0
+    assert math.isfinite(snap["health.grad_norm"]["value"])
+    # the per-step summary is available to monitors / callers
+    step, summary = mod._last_health_summary
+    assert step == 2 and summary["all_finite"] == 1.0
+    assert summary["update_ratio"] > 0  # fused path: exact in-program
+    assert flight_recorder.get_recorder().steps_recorded() == 3
+
+
+def test_executor_cache_keys_on_health_flag(monkeypatch):
+    """Enabling the sentinel is one retrace; disabling is zero (both
+    entries stay cached side by side)."""
+    sym = _mlp()
+    ctx = mx.cpu()
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "0")
+    exe_off = sym.simple_bind(ctx, grad_req="write", data=(4, 8),
+                              softmax_label=(4,))
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    exe_on = sym.simple_bind(ctx, grad_req="write", data=(4, 8),
+                             softmax_label=(4,))
+    assert exe_off._fwd_bwd_jit is not exe_on._fwd_bwd_jit
+    assert not exe_off._health_on and exe_on._health_on
+    exe_off.forward_backward(is_train=True)
+    exe_on.forward_backward(is_train=True)
+    base = executor_cache.trace_counts()
+    # flipping back re-uses the cached health-off program: zero retraces
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "0")
+    exe_back = sym.simple_bind(ctx, grad_req="write", data=(4, 8),
+                               softmax_label=(4,))
+    exe_back.forward_backward(is_train=True)
+    assert executor_cache.trace_counts() == base
+    assert exe_back._last_health is None
+    assert exe_on._last_health is not None
+    summary = exe_on.health_layout.unpack(np.asarray(exe_on._last_health))
+    assert summary["all_finite"] == 1.0
+    # gradient-free (inference) signatures never split on the flag
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    pred_on = sym.simple_bind(ctx, grad_req="null", data=(4, 8),
+                              softmax_label=(4,))
+    assert not pred_on._health_on
+
+
+# -- monitor stats="health" rides the fused path ------------------------------
+
+def test_monitor_health_mode_stays_fused(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    _fit(_iter())
+    plain = executor_cache.trace_counts()
+
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    mon = mx.monitor.Monitor(1, stats="health")
+    with caplog.at_level(logging.INFO):
+        mod = _fit(_iter(), monitor=mon)
+    # the regression contract: IDENTICAL exec-cache trace counters with
+    # and without the health monitor — it taps nothing, retires nothing
+    assert executor_cache.trace_counts() == plain
+    assert mod._fused_step is not None, \
+        "health monitor must not retire the fused step"
+    infos = [r for r in caplog.records
+             if "stays active" in r.getMessage()]
+    assert len(infos) == 1 and infos[0].levelno == logging.INFO
+    assert not any("tap-capable" in r.getMessage()
+                   for r in caplog.records)
+    # and it produced readings (re-arm: fit consumed the last toc)
+    assert mod._last_health_summary is not None
+    mon.activated = True
+    rows = mon.toc()
+    assert any(name == "health/grad_norm" for _, name, _ in rows)
+    assert all(name.startswith("health/") for _, name, _ in rows)
+
+
+def test_monitor_health_mode_warns_when_sentinel_off(caplog):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (8, 8))], [("softmax_label", (8,))])
+    with caplog.at_level(logging.WARNING):
+        mod.install_monitor(mx.monitor.Monitor(1, stats="health"))
+    assert any("MXNET_TPU_HEALTH" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_monitor_rejects_unknown_stats():
+    with pytest.raises(ValueError):
+        mx.monitor.Monitor(1, stats="everything")
+
+
+def test_bucketing_module_health_monitor_binds_to_parent(monkeypatch):
+    """The fit loop sets _last_health_summary on the BucketingModule
+    driving the epoch — a health monitor must read from IT, not from a
+    per-bucket child (which never gets a summary)."""
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    mod = mx.mod.BucketingModule(
+        lambda key: (_mlp(), ("data",), ("softmax_label",)),
+        default_bucket_key=8, context=mx.cpu())
+    mod.bind([("data", (8, 8))], [("softmax_label", (8,))])
+    mon = mx.monitor.Monitor(1, stats="health")
+    mod.install_monitor(mon)
+    assert mon._module is mod
+    mod._last_health_summary = (3, {"grad_norm": 1.5})
+    mon.activated = True
+    assert mon.toc() == [(3, "health/grad_norm", "1.5")]
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_ring_bounded_by_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_STEPS", "8")
+    flight_recorder.reset()
+    rec = flight_recorder.get_recorder()
+    assert rec.capacity == 8
+    for step in range(20):
+        rec.record_step(step, health={"grad_norm": float(step)})
+    assert rec.steps_recorded() == 8
+    # a malformed value must not take a run down: warn, use the default
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_STEPS", "2k")
+    flight_recorder.reset()
+    assert flight_recorder.get_recorder().capacity \
+        == flight_recorder.DEFAULT_STEPS
+
+
+def test_flight_log_capture_last_200(monkeypatch, tmp_path):
+    rec = flight_recorder.get_recorder()
+    logger = logging.getLogger("mxnet_tpu.some.module")
+    for i in range(250):
+        logger.warning("ring message %d", i)
+    path = rec.dump(path=str(tmp_path / "d.json"), reason="on_demand")
+    doc = json.load(open(path))
+    assert len(doc["logs"]) == 200
+    assert doc["logs"][-1]["message"] == "ring message 249"
+    assert doc["logs"][0]["message"] == "ring message 50"
+    assert doc["logs"][-1]["logger"] == "mxnet_tpu.some.module"
+
+
+def test_flight_dump_strict_json_and_fingerprint(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    rec = flight_recorder.get_recorder()
+    rec.record_step(0, health={"grad_norm": float("nan"),
+                               "out_mean": float("inf")})
+    path = rec.dump(path=str(tmp_path / "d.json"))
+    text = open(path).read()
+    # strict JSON: a non-finite-rejecting parser accepts every byte
+    doc = json.loads(text, parse_constant=lambda s: pytest.fail(
+        "non-standard JSON token %r in flight dump" % s))
+    assert doc["steps"][0]["health"]["grad_norm"] == "NaN"
+    assert doc["fingerprint"]["env"].get("MXNET_TPU_HEALTH") == "1"
+    assert doc["fingerprint"]["pid"] == os.getpid()
+    assert "exec_cache" in doc["steps"][0]
+
+
+def test_flight_dump_once_per_reason(tmp_path):
+    rec = flight_recorder.get_recorder()
+    p1 = rec.dump_once("serving_exception",
+                       path=str(tmp_path / "one.json"))
+    p2 = rec.dump_once("serving_exception",
+                       path=str(tmp_path / "two.json"))
+    assert p1 is not None and p2 is None
+    assert not (tmp_path / "two.json").exists()
+
+
+def test_fit_exception_hook_dumps(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    dump_path = str(tmp_path / "crash.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+
+    def boom(param):
+        if param.nbatch == 1:
+            raise RuntimeError("callback exploded")
+
+    with pytest.raises(RuntimeError, match="callback exploded"):
+        _fit(_iter(), batch_end_callback=boom)
+    doc = json.load(open(dump_path))
+    assert doc["reason"] == "fit_exception"
+    exc_events = [e for e in doc["events"] if e["kind"] == "exception"]
+    assert exc_events and "callback exploded" \
+        in exc_events[0]["payload"]["message"]
+    # with health off the hook stays silent (no surprise files)
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "0")
+    flight_recorder.reset()
+    os.remove(dump_path)
+    with pytest.raises(RuntimeError):
+        _fit(_iter(), batch_end_callback=boom)
+    assert not os.path.exists(dump_path)
+
+
+# -- serving hooks ------------------------------------------------------------
+
+def _serving_setup(num_hidden=4, poison=False):
+    from mxnet_tpu import serving
+    rng = np.random.RandomState(0)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=num_hidden, name="s_fc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 8))
+    arg_params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        value = rng.normal(0, 0.1, shape).astype(np.float32)
+        if poison:
+            value[...] = np.nan
+        arg_params[name] = mx.nd.array(value)
+    server = serving.Server(max_batch_size=4, batch_window_ms=1.0)
+    server.add_model("m", sym, arg_params, input_shapes={"data": (8,)})
+    return server
+
+
+def test_serving_nonfinite_outputs_counted(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    server = _serving_setup(poison=True)
+    try:
+        outs = server.submit("m", {"data": np.ones((1, 8), np.float32)},
+                             timeout=30)
+        assert not np.isfinite(outs[0]).all()  # still served (warn-only)
+        snap = telemetry.snapshot()
+        assert snap["serving.nonfinite_responses"]["value"] >= 1.0
+    finally:
+        server.close(drain=True, timeout=30)
+
+
+def test_serving_dispatch_failure_dumps_once(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    dump_path = str(tmp_path / "serve_crash.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+    server = _serving_setup()
+    try:
+        model = server.registry.get("m")
+        monkeypatch.setattr(model, "run_batch",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("model exploded")))
+        fut = server.submit_async("m",
+                                  {"data": np.ones((1, 8), np.float32)})
+        with pytest.raises(RuntimeError, match="model exploded"):
+            fut.result(timeout=30)
+        assert server.batcher.alive  # the dispatch thread survived
+        doc = json.load(open(dump_path))
+        assert doc["reason"] == "serving_exception"
+        errs = [e for e in doc["events"]
+                if e["kind"] == "serving_dispatch_error"]
+        assert errs and "model exploded" in errs[0]["payload"]["error"]
+    finally:
+        server.close(drain=True, timeout=30)
+
+
+# -- optimizer satellite ------------------------------------------------------
+
+def test_optimizer_health_update_scale():
+    opt = mx.optimizer.SGD(learning_rate=0.25, rescale_grad=0.5)
+    assert opt.health_update_scale() == pytest.approx(0.125)

@@ -2,6 +2,7 @@
 
     python tools/traceview.py /tmp/mxnet_tpu_smoke_trace.json [--top N]
     python tools/traceview.py --serving /tmp/trace_or_telemetry.json
+    python tools/traceview.py --flight /tmp/flight_dump.json
 
 Three views over one trace:
 
@@ -23,12 +24,20 @@ telemetry JSON-lines dump from `observability.telemetry.to_json_lines`
 (percentiles estimated from the fixed log2 histogram buckets — each
 quantile reports its bucket's upper bound).
 
+`--flight` reads a flight-recorder dump
+(`observability/flight_recorder.py`): first-anomaly step, per-rule
+anomaly counts, a grad/loss trend table with sparklines over the
+recorded step window, captured events and log-record count.  Exits 1
+when the dump contains a fired anomaly, 0 otherwise — CI can gate on
+"did the black box record a divergence" without parsing JSON.
+
 Understands both the native "X" complete-event encoding and legacy
 "B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 
 # pinned copy of mxnet_tpu/observability/instrument.py:STEP_COMPONENTS —
@@ -168,6 +177,134 @@ def instants(events):
         if e.get("ph") == "i":
             out[e["name"]] = out.get(e["name"], 0) + 1
     return out
+
+
+# -- flight-recorder view ----------------------------------------------------
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _fnum(value, default=float("nan")):
+    """Float from a flight-dump field: strict-JSON non-finite tokens
+    ("NaN"/"Infinity"/"-Infinity") restore to floats."""
+    if isinstance(value, str):
+        return _NONFINITE_TOKENS.get(value, default)
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def _isfinite(x):
+    try:
+        return math.isfinite(x)
+    except TypeError:
+        return False
+
+
+def _sparkline(values):
+    """One block character per value; non-finite values render '!'.
+    Scaled min->max over the finite values."""
+    finite = [v for v in values if _isfinite(v)]
+    if not finite:
+        return "!" * len(values)
+    lo, hi = min(finite), max(finite)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if not _isfinite(v):
+            out.append("!")
+            continue
+        idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+        out.append(_SPARK_BLOCKS[idx])
+    return "".join(out)
+
+
+def flight_stats(doc):
+    """The machine-readable summary `--flight` renders (and tests
+    assert on): first anomaly, per-rule counts, per-step trend series."""
+    steps = doc.get("steps") or []
+    anomalies = doc.get("anomalies") or []
+    by_rule = {}
+    for a in anomalies:
+        by_rule[a.get("rule", "?")] = by_rule.get(a.get("rule", "?"), 0) + 1
+    series = []
+    for s in steps:
+        h = s.get("health") or {}
+        series.append({
+            "step": s.get("step"),
+            "loss": _fnum(h.get("out_mean")),
+            "grad_norm": _fnum(h.get("grad_norm")),
+            "update_ratio": _fnum(h.get("update_ratio")),
+            "finite": _fnum(h.get("all_finite"), 1.0) >= 1.0,
+        })
+    return {
+        "reason": doc.get("reason"),
+        "created": doc.get("created_iso") or doc.get("created"),
+        "steps": len(steps),
+        "capacity": doc.get("capacity"),
+        "first_anomaly_step": doc.get("first_anomaly_step"),
+        "anomaly_count": len(anomalies),
+        "anomalies_by_rule": by_rule,
+        "series": series,
+        "events": len(doc.get("events") or []),
+        "logs": len(doc.get("logs") or []),
+    }
+
+
+def summarize_flight(doc, trend_rows=12):
+    """The text report for one flight dump."""
+    stats = flight_stats(doc)
+    anomalies = doc.get("anomalies") or []
+    lines = []
+    lines.append("== flight recorder: reason=%s created=%s =="
+                 % (stats["reason"], stats["created"]))
+    fp = doc.get("fingerprint") or {}
+    env = fp.get("env") or {}
+    lines.append("pid %s  python %s  jax %s  backend %s"
+                 % (fp.get("pid"), fp.get("python"), fp.get("jax"),
+                    fp.get("backend")))
+    knobs = {k: env[k] for k in sorted(env) if k.startswith("MXNET_TPU_")}
+    if knobs:
+        lines.append("env: " + "  ".join("%s=%s" % kv
+                                         for kv in knobs.items()))
+    lines.append("steps recorded: %d (ring capacity %s)"
+                 % (stats["steps"], stats["capacity"]))
+    lines.append("")
+    lines.append("== anomalies ==")
+    if not anomalies:
+        lines.append("(none recorded)")
+    else:
+        first = anomalies[0]
+        lines.append("FIRST ANOMALY: step %s  rule=%s"
+                     % (first.get("step"), first.get("rule")))
+        lines.append("  %s" % first.get("message", ""))
+        lines.append("%-18s %7s" % ("Rule", "Fired"))
+        for rule in sorted(stats["anomalies_by_rule"]):
+            lines.append("%-18s %7d"
+                         % (rule, stats["anomalies_by_rule"][rule]))
+    lines.append("")
+    lines.append("== grad / loss trend ==")
+    series = stats["series"]
+    if not series:
+        lines.append("(no per-step health records — was MXNET_TPU_HEALTH"
+                     "=1 set?)")
+    else:
+        lines.append("grad-norm: %s"
+                     % _sparkline([r["grad_norm"] for r in series]))
+        lines.append("loss:      %s"
+                     % _sparkline([r["loss"] for r in series]))
+        lines.append("%-8s %12s %12s %12s %7s"
+                     % ("Step", "Loss", "GradNorm", "UpdRatio", "Finite"))
+        for r in series[-trend_rows:]:
+            lines.append("%-8s %12.5g %12.5g %12.5g %7s"
+                         % (r["step"], r["loss"], r["grad_norm"],
+                            r["update_ratio"],
+                            "yes" if r["finite"] else "NO"))
+    lines.append("")
+    lines.append("events: %d   captured log records: %d"
+                 % (stats["events"], stats["logs"]))
+    return "\n".join(lines)
 
 
 # -- serving view ------------------------------------------------------------
@@ -371,7 +508,17 @@ def main(argv=None):
                         help="inference-service view: request-latency "
                         "percentiles, batch-size distribution, rejection "
                         "counts")
+    parser.add_argument("--flight", action="store_true",
+                        help="flight-recorder view: first-anomaly step, "
+                        "per-rule counts, grad/loss trend; exits 1 when "
+                        "the dump holds a fired anomaly")
     args = parser.parse_args(argv)
+    if args.flight:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        print(summarize_flight(doc))
+        # CI contract: a dump holding a fired anomaly exits non-zero
+        return 1 if (doc.get("anomalies") or []) else 0
     if args.serving:
         kind, payload = load_any(args.trace)
         print(summarize_serving(kind, payload))
