@@ -43,6 +43,7 @@ from .initializer import Initializer
 from . import lr_scheduler
 from . import callback
 from . import io
+from . import io_pipeline
 from . import monitor
 from .monitor import Monitor
 from . import kvstore as kv

@@ -165,7 +165,15 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Threaded prefetcher over one or more iterators (ref: io.py:344; the
-    C++ analog is dmlc::ThreadedIter in iter_prefetcher.h)."""
+    C++ analog is dmlc::ThreadedIter in iter_prefetcher.h).
+
+    Lifecycle is explicit: call :meth:`close` (or use the iterator as a
+    context manager) to stop and join the worker threads; ``__del__``
+    remains as a gc-time fallback only.  The historical ``__del__``-only
+    teardown let workers outlive the iterator and join() during
+    interpreter shutdown — a deadlock when a worker sat blocked inside a
+    base iterator's ``next()``.  (`mxnet_tpu.io_pipeline` is the
+    multi-worker successor; this class keeps the reference surface.)"""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -182,6 +190,7 @@ class PrefetchingIter(DataIter):
         for e in self.data_taken:
             e.set()
         self.started = True
+        self._closed = False
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
 
@@ -198,18 +207,47 @@ class PrefetchingIter(DataIter):
                 self.data_ready[i].set()
 
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i])
+            threading.Thread(target=prefetch_func, args=[self, i],
+                             daemon=True)
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
-            thread.setDaemon(True)
             thread.start()
 
-    def __del__(self):
+    def close(self):
+        """Stop and join the prefetch threads (idempotent).  The
+        iterator is unusable afterwards; a worker stuck in a base
+        iterator's ``next()`` is abandoned (daemon) after a bounded
+        join instead of deadlocking the caller."""
+        if self._closed:
+            return
+        self._closed = True
         self.started = False
         for e in self.data_taken:
             e.set()
         for thread in self.prefetch_threads:
-            thread.join()
+            thread.join(timeout=5.0)
+        leaked = [t for t in self.prefetch_threads if t.is_alive()]
+        if leaked:
+            import warnings
+            warnings.warn(
+                "PrefetchingIter: %d worker(s) blocked in the base "
+                "iterator were abandoned at close" % len(leaked))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        # gc-time fallback for callers that never close(); during
+        # interpreter finalization the daemon threads die with the
+        # process, so the bounded join in close() cannot hang exit
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -230,6 +268,8 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
@@ -240,6 +280,8 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         if self.next_batch[0] is None:
@@ -556,18 +598,34 @@ def MXDataIter(name, **kwargs):
 def _build_rec_index(path_imgrec, path_idx):
     """Scan a bare .rec once and write a key\toffset index so shuffling and
     num_parts sharding work without a pre-built .idx (the reference's
-    chunk-shuffle reads bare .rec files too)."""
+    chunk-shuffle reads bare .rec files too).
+
+    Written to a private temp file and atomically renamed: concurrent
+    builders (pytest-xdist workers, multiple training hosts on a shared
+    filesystem) must never observe a half-written index — a reader of a
+    partial file would silently train on a truncated record set (same
+    hardening as io_native._run_gxx's .so builds)."""
     from . import recordio as _rio
     reader = _rio.MXRecordIO(path_imgrec, "r")
-    with open(path_idx, "w") as f:
-        i = 0
-        while True:
-            pos = reader.tell()
-            if reader.read() is None:
-                break
-            f.write("%d\t%d\n" % (i, pos))
-            i += 1
-    reader.close()
+    tmp = "%s.build.%d.%d" % (path_idx, os.getpid(),
+                              threading.get_ident())
+    try:
+        with open(tmp, "w") as f:
+            i = 0
+            while True:
+                pos = reader.tell()
+                if reader.read() is None:
+                    break
+                f.write("%d\t%d\n" % (i, pos))
+                i += 1
+        os.replace(tmp, path_idx)
+    finally:
+        reader.close()
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,

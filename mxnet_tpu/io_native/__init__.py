@@ -11,9 +11,21 @@ falls back to the pure-Python path if a toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import subprocess
 import threading
+
+
+def native_disabled():
+    """``MXNET_TPU_IO_NATIVE=0`` forces every native fast path
+    (recordio framing, host engine, image decode kernel) onto its pure
+    Python fallback — checked per call, not cached, so tests can flip
+    it to exercise the fallback instead of merely keeping it reachable
+    (docs/env_vars.md)."""
+    return os.environ.get("MXNET_TPU_IO_NATIVE", "1").strip().lower() \
+        in ("0", "false", "off")
+
 
 def _find_src_dir():
     """Native sources: <repo>/src from a checkout, the package-data copy
@@ -34,14 +46,28 @@ _lib = None
 _tried = False
 
 
+_build_seq = itertools.count()
+
+
 def _run_gxx(cmd, out_path):
     """Compile to a private temp file, then atomically rename into place:
     several test workers (pytest-xdist) may rebuild the same .so
-    concurrently, and a half-written library must never be dlopen-able."""
-    tmp = "%s.build.%d" % (out_path, os.getpid())
-    subprocess.run([c if c != out_path else tmp for c in cmd],
-                   check=True, capture_output=True)
-    os.replace(tmp, out_path)
+    concurrently, and a half-written library must never be dlopen-able.
+    The temp name carries pid AND a process-local counter — a pid alone
+    let two threads of one process (the lazy builders run under the
+    caller's thread) write the same temp file and rename corruption
+    into place."""
+    tmp = "%s.build.%d.%d" % (out_path, os.getpid(), next(_build_seq))
+    try:
+        subprocess.run([c if c != out_path else tmp for c in cmd],
+                       check=True, capture_output=True)
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _build():
@@ -55,8 +81,11 @@ def _build():
 
 
 def get_lib():
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable
+    or disabled via ``MXNET_TPU_IO_NATIVE=0``."""
     global _lib, _tried
+    if native_disabled():
+        return None
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -449,6 +478,8 @@ _imgdec_tried = False
 
 def get_imgdec_lib():
     global _imgdec_lib, _imgdec_tried
+    if native_disabled():
+        return None
     with _lock:
         if _imgdec_lib is not None or _imgdec_tried:
             return _imgdec_lib

@@ -19,6 +19,7 @@ import time
 from .. import metric as metric_mod
 from ..context import cpu
 from ..initializer import Uniform
+from ..io import DataIter
 from ..log import module_logger as _module_logger
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
@@ -173,10 +174,59 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """Bind, initialize, and train for ``num_epoch`` epochs."""
+        """Bind, initialize, and train for ``num_epoch`` epochs.
+
+        ``train_data``/``eval_data`` may be any ``DataIter`` — including
+        an ``io_pipeline.PipelineDataIter`` — or a raw
+        ``io_pipeline.Pipeline``, which is adapted (and closed when fit
+        returns) automatically; the epoch loop's lookahead + ``prepare``
+        contract is what the pipeline's double-buffered device transfer
+        overlaps against."""
         if num_epoch is None:
             raise AssertionError("fit() needs num_epoch")
 
+        owned_iters = []
+        try:
+            # adapt INSIDE the try: if the second adaptation (or the
+            # fit itself) raises, the first adapter's already-running
+            # workers still get torn down.  The eval adapter skips the
+            # warm start — score(reset=True) discards the armed epoch
+            # unconsumed anyway.
+            train_data = self._adapt_data(train_data, owned_iters)
+            eval_data = self._adapt_data(eval_data, owned_iters,
+                                         warm_start=False)
+            self._fit_impl(
+                train_data, eval_data, eval_metric, epoch_end_callback,
+                batch_end_callback, kvstore, optimizer, optimizer_params,
+                eval_end_callback, eval_batch_end_callback, initializer,
+                arg_params, aux_params, allow_missing, force_rebind,
+                force_init, begin_epoch, num_epoch, validation_metric,
+                monitor)
+        finally:
+            for it in owned_iters:
+                try:
+                    it.close()
+                except Exception:
+                    pass
+
+    @staticmethod
+    def _adapt_data(data, owned_iters, warm_start=True):
+        """A raw Pipeline is adapted here and registered in
+        ``owned_iters`` for fit's teardown; an already-built iterator
+        passes through and belongs to the caller."""
+        if data is not None and not isinstance(data, DataIter) \
+                and hasattr(data, "as_dataiter"):
+            it = data.as_dataiter(warm_start=warm_start)
+            owned_iters.append(it)
+            return it
+        return data
+
+    def _fit_impl(self, train_data, eval_data, eval_metric,
+                  epoch_end_callback, batch_end_callback, kvstore,
+                  optimizer, optimizer_params, eval_end_callback,
+                  eval_batch_end_callback, initializer, arg_params,
+                  aux_params, allow_missing, force_rebind, force_init,
+                  begin_epoch, num_epoch, validation_metric, monitor):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)

@@ -21,6 +21,8 @@ exec-cache trace counters in ``make bench-smoke`` hold that line).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from . import telemetry
@@ -224,6 +226,122 @@ def note_io_wait(seconds):
     hist.observe(ms)
     n_batches.inc()
     total.inc(ms)
+
+
+# io_pipeline handles, memoized like the io cache above: the pipeline's
+# consumer wait is the per-stage starvation signal (queue_wait), decode
+# and h2d histograms attribute where batch time goes, and h2d_ahead
+# counts uploads issued under the previous step's compute (the overlap
+# contract `bench.py --io-smoke` asserts on)
+_pipe_cache = (None, None)
+
+
+def _pipeline_handles():
+    global _pipe_cache
+    key = (telemetry.registry_epoch(), telemetry.enabled())
+    cached_key, handles = _pipe_cache
+    if cached_key != key:
+        handles = {
+            "queue_wait": telemetry.histogram(
+                "io_pipeline.queue_wait_ms",
+                help="consumer time blocked waiting on pipeline "
+                     "output (the starvation numerator)"),
+            "decode": telemetry.histogram(
+                "io_pipeline.decode_ms",
+                help="per-batch read+decode+assemble time (worker-side)"),
+            "h2d": telemetry.histogram(
+                "io_pipeline.h2d_ms",
+                help="host time issuing the device_put (transfer is "
+                     "async)"),
+            "batches": telemetry.counter(
+                "io_pipeline.batches", help="batches produced"),
+            "records": telemetry.counter(
+                "io_pipeline.records", help="records decoded"),
+            "h2d_ahead": telemetry.counter(
+                "io_pipeline.h2d_ahead_total",
+                help="uploads issued ahead of consumption (overlapped "
+                     "with compute)"),
+        }
+        _pipe_cache = (key, handles)
+    return handles
+
+
+# waits taken while ARMING an epoch (adapter priming at reset) happen
+# outside the fit loop's steps by design — counting them would inflate
+# the starvation ratio on healthy runs, so the adapter suppresses them
+# for its (consumer) thread while it primes
+_pipe_tls = threading.local()
+
+
+class suppress_pipeline_wait:
+    """Context manager: waits on this thread are not starvation."""
+
+    def __enter__(self):
+        self._prev = getattr(_pipe_tls, "suppress", False)
+        _pipe_tls.suppress = True
+        return self
+
+    def __exit__(self, *exc):
+        _pipe_tls.suppress = self._prev
+        return False
+
+
+def note_pipeline_wait(seconds):
+    """One consumer wait on the pipeline's reorder buffer (the
+    numerator of the pipeline starvation ratio).  Returns False when
+    suppressed (arm-time priming) so callers skip the matching span."""
+    if getattr(_pipe_tls, "suppress", False):
+        return False
+    h = _pipeline_handles()
+    h["queue_wait"].observe(seconds * 1e3)
+    h["batches"].inc()
+    return True
+
+
+def note_pipeline_decode(seconds, records):
+    h = _pipeline_handles()
+    h["decode"].observe(seconds * 1e3)
+    h["records"].inc(records)
+
+
+def note_pipeline_h2d(seconds):
+    _pipeline_handles()["h2d"].observe(seconds * 1e3)
+
+
+def note_pipeline_h2d_ahead():
+    _pipeline_handles()["h2d_ahead"].inc()
+
+
+# generation counter for the pipeline gauges: the gauges are
+# process-wide (like every io_pipeline series), so when several runs
+# are live the LAST-ARMED one owns them; a run tearing down must only
+# zero them if it is still the owner (disarm_pipeline_gauges), or an
+# ending eval run would stomp the live train run's gauges
+_pipe_gauge_token = 0
+
+
+def arm_pipeline_gauges(task_depth_fn, reorder_fill_fn):
+    """Wire the live per-stage queue-depth gauges to the current epoch
+    run.  Re-armed at every run start so the gauges survive a
+    telemetry.reset() between epochs; returns a token for
+    `disarm_pipeline_gauges`."""
+    global _pipe_gauge_token
+    _pipe_gauge_token += 1
+    telemetry.gauge(
+        "io_pipeline.task_queue_depth",
+        help="tasks parked for workers").set_function(task_depth_fn)
+    telemetry.gauge(
+        "io_pipeline.reorder_fill",
+        help="completed batches held for in-order release"
+    ).set_function(reorder_fill_fn)
+    return _pipe_gauge_token
+
+
+def disarm_pipeline_gauges(token):
+    """Zero the gauges (dropping their closures' references to the
+    run's queues) — only if ``token`` still owns them."""
+    if token == _pipe_gauge_token:
+        arm_pipeline_gauges(lambda: 0, lambda: 0)
 
 
 # push/pull handles, memoized per op against the registry epoch +

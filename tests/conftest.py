@@ -30,9 +30,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 _FAST_MODULES = {
     "test_analysis", "test_autograd", "test_executor_cache",
     "test_fused_extra", "test_fused_optimizers", "test_gluon_data",
-    "test_health", "test_io_metric_kvstore", "test_kvstore_ici",
-    "test_module", "test_ndarray", "test_namespaces", "test_optimizer",
-    "test_symbol", "test_elastic", "test_serving",
+    "test_health", "test_io_metric_kvstore", "test_io_pipeline",
+    "test_kvstore_ici", "test_module", "test_ndarray",
+    "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
+    "test_serving",
 }
 
 
@@ -78,6 +79,8 @@ _SLOW_WITHIN_FAST = {
     "test_fused_dp_step_multi_device", "test_module_fit_learns",
     "test_bf16_multi_precision_trains", "test_module_multi_device",
     "test_reshape_preserves_f32_masters",
+    # spawn-pool workers re-import the package (~10s on a cold cache)
+    "test_process_mode_matches_thread_mode",
 }
 
 

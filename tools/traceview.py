@@ -46,6 +46,11 @@ import sys
 STEP_COMPONENTS = ("data_wait", "fwd_bwd_dispatch", "update", "metric",
                    "sync")
 
+# pinned copy of the io_pipeline span names (category "io_pipeline",
+# names "pipe:<stage>") — emitted by mxnet_tpu/io_pipeline/{executor,
+# pipeline,device}.py; a stage added there must be added here
+PIPELINE_STAGES = ("queue_wait", "decode", "h2d")
+
 # pinned copy of observability/telemetry.py:BUCKET_BOUNDS (2**k for k in
 # [-10, 20] plus +Inf overflow) — needed to turn a JSON-lines histogram
 # snapshot back into quantile estimates without importing the framework
@@ -167,6 +172,36 @@ def step_breakdown(events):
         "coverage": covered / step_total if step_total else 0.0,
         "starvation": (comp["data_wait"]["total_ms"] / step_total
                        if step_total else 0.0),
+    }
+
+
+def pipeline_breakdown(events):
+    """Per-stage totals over the ``pipe:*`` spans the io_pipeline
+    emits: consumer queue wait vs worker decode vs H2D issue.  Returns
+    None when the trace holds no pipeline spans; otherwise per-stage
+    {count, total_ms, avg_ms} plus the pipeline starvation ratio
+    (queue_wait / step time) when step spans are present too."""
+    durations = span_durations(events)
+    stages = {s: {"count": 0, "total_ms": 0.0} for s in PIPELINE_STAGES}
+    seen = False
+    for cat, name, ms in durations:
+        if cat == "io_pipeline" and name.startswith("pipe:"):
+            stage = name[len("pipe:"):]
+            if stage in stages:
+                seen = True
+                stages[stage]["count"] += 1
+                stages[stage]["total_ms"] += ms
+    if not seen:
+        return None
+    for s in stages.values():
+        s["avg_ms"] = s["total_ms"] / s["count"] if s["count"] else 0.0
+    step_total = sum(ms for cat, name, ms in durations
+                     if cat == "step" and name == "step")
+    return {
+        "stages": stages,
+        "step_total_ms": step_total,
+        "starvation": (stages["queue_wait"]["total_ms"] / step_total
+                       if step_total else None),
     }
 
 
@@ -486,6 +521,21 @@ def summarize(trace, top=15):
                      % (bd["coverage"] * 100.0))
         lines.append("input starvation (data_wait / step): %.1f%%"
                      % (bd["starvation"] * 100.0))
+
+    pb = pipeline_breakdown(events)
+    if pb is not None:
+        lines.append("")
+        lines.append("== io pipeline breakdown ==")
+        lines.append("%-18s %7s %12s %12s"
+                     % ("Stage", "Calls", "Total(ms)", "Avg(ms)"))
+        for stage in PIPELINE_STAGES:
+            s = pb["stages"][stage]
+            lines.append("%-18s %7d %12.3f %12.3f"
+                         % (stage, s["count"], s["total_ms"],
+                            s["avg_ms"]))
+        if pb["starvation"] is not None:
+            lines.append("pipeline starvation (queue_wait / step): "
+                         "%.1f%%" % (pb["starvation"] * 100.0))
 
     inst = instants(events)
     if inst:
