@@ -1083,6 +1083,155 @@ def io_smoke():
         shutil.rmtree(tmpd, ignore_errors=True)
 
 
+def kernel_smoke():
+    """Pallas-kernel CI mode (`make bench-smoke` step 5, `bench.py
+    --kernel-smoke`): proves the kernel-layer contracts (docs/kernels.md)
+    on the CPU test backend, where every Pallas kernel runs through the
+    interpreter (same kernel code path as the chip):
+
+    1. **direct parity** — pooling backward (max + avg, stride != kernel)
+       and the BN channel-sums epilogue match their XLA fallbacks on
+       CPU-shaped inputs; int8 predict matches f32 predict to quant
+       tolerance with identical argmax;
+    2. **flag contract** — with the flags off, two identical
+       forward_backward runs produce identical exec-cache counters and
+       bitwise-identical gradients (the off path IS the parent program);
+       enabling `MXNET_TPU_PALLAS_POOL`+`MXNET_TPU_PALLAS_BN` re-keys the
+       program for exactly ONE retrace (`executor_cache.watch_traces`),
+       kernel-path gradients agree with the fallback to tolerance, and
+       flipping back off retraces NOTHING (the off entry is still cached)
+       with gradients bitwise equal to the first off run — the off-path
+       program is untouched.
+    """
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.predict import Predictor
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    for flag in ("MXNET_TPU_PALLAS_POOL", "MXNET_TPU_PALLAS_BN",
+                 "MXNET_TPU_QUANTIZE"):
+        os.environ.pop(flag, None)
+
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    # 1) direct kernel-vs-fallback parity (interpret mode on cpu)
+    parity = {}
+    x = jnp.asarray(rng.randn(2, 4, 12, 14).astype(np.float32))
+    from mxnet_tpu.ops.nn import _pool_core
+    for pool_type in ("max", "avg"):
+        cfg = (pool_type, (3, 3), (2, 2), (1, 1), "valid", True)
+        ref = jax.grad(lambda v: jnp.sum(_pool_core(*cfg, "off")(v) ** 2))(x)
+        got = jax.grad(
+            lambda v: jnp.sum(_pool_core(*cfg, "interpret")(v) ** 2))(x)
+        err = float(jnp.max(jnp.abs(got - ref)))
+        parity["pool_bwd_" + pool_type] = err
+        assert err < 1e-5, (pool_type, err)
+    s1, s2 = pk.bn_channel_sums(x, interpret=True)
+    err = max(float(jnp.max(jnp.abs(s1 - jnp.sum(x, (0, 2, 3))))),
+              float(jnp.max(jnp.abs(s2 - jnp.sum(x * x, (0, 2, 3))))))
+    parity["bn_channel_sums"] = err
+    assert err < 1e-3, err
+
+    def convnet():
+        net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                                 num_filter=8, pad=(1, 1), name="conv1")
+        net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             pool_type="max", name="pool1")
+        net = mx.sym.Flatten(net, name="flat1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc1")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    net_sym = convnet()  # ONE symbol: revisits must share its programs
+
+    def batch():
+        from mxnet_tpu.io import DataBatch, DataDesc
+        r = np.random.RandomState(7)
+        return DataBatch(
+            data=[mx.nd.array(r.rand(8, 3, 8, 8).astype(np.float32))],
+            label=[mx.nd.array(r.randint(0, 4, (8,)).astype(np.float32))],
+            provide_data=[DataDesc("data", (8, 3, 8, 8))],
+            provide_label=[DataDesc("softmax_label", (8,))])
+
+    def run_fb():
+        mod = mx.mod.Module(net_sym, context=mx.cpu())
+        mod.bind([("data", (8, 3, 8, 8))], [("softmax_label", (8,))])
+        mx.random.seed(0)
+        mod.init_params(mx.initializer.Xavier())
+        with executor_cache.watch_traces() as w:
+            mod.forward_backward(batch())
+        exe = mod._exec_group.execs[0]
+        grads = {n: np.asarray(g._h.array)
+                 for n, g in exe.grad_dict.items()}
+        return w, grads
+
+    # 2) flag contract through the executor program
+    w_off1, g_off1 = run_fb()
+    w_off2, g_off2 = run_fb()
+    assert w_off2.total() == 0, ("off revisit retraced", w_off2.delta())
+    assert all(np.array_equal(g_off1[k], g_off2[k]) for k in g_off1)
+
+    os.environ["MXNET_TPU_PALLAS_POOL"] = "1"
+    os.environ["MXNET_TPU_PALLAS_BN"] = "1"
+    w_on, g_on = run_fb()
+    on_delta = w_on.delta()
+    assert w_on.total() == 1 and on_delta.get("traces_fwd_bwd") == 1, (
+        "enabling the kernel flags must cost exactly one retrace of the "
+        "fused fwd_bwd program", on_delta)
+    kernel_vs_fallback = max(
+        float(np.max(np.abs(g_on[k].astype(np.float32)
+                            - g_off1[k].astype(np.float32))))
+        for k in g_off1)
+    assert kernel_vs_fallback < 1e-3, kernel_vs_fallback
+
+    os.environ.pop("MXNET_TPU_PALLAS_POOL")
+    os.environ.pop("MXNET_TPU_PALLAS_BN")
+    w_back, g_back = run_fb()
+    assert w_back.total() == 0, (
+        "the flag-off path must come back from the cache untouched",
+        w_back.delta())
+    assert all(np.array_equal(g_off1[k], g_back[k]) for k in g_off1), \
+        "off-path gradients changed after a kernel-flag round trip"
+
+    # 3) int8 predict vs f32 (dynamic ranges; docs/serving.md §int8)
+    qsym = convnet()
+    arg_shapes, _, _ = qsym.infer_shape(data=(1, 3, 8, 8))
+    params = {"arg:%s" % n: mx.nd.array(
+        rng.normal(0, 0.3, s).astype(np.float32))
+        for n, s in zip(qsym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+    xq = rng.rand(8, 3, 8, 8).astype(np.float32)
+    p32 = Predictor(qsym.tojson(), dict(params), {"data": (8, 3, 8, 8)})
+    p8 = Predictor(qsym.tojson(), dict(params), {"data": (8, 3, 8, 8)},
+                   quantize="int8")
+    p32.forward(data=xq)
+    p8.forward(data=xq)
+    o32 = p32.get_output(0).asnumpy()
+    o8 = p8.get_output(0).asnumpy()
+    int8_dev = float(np.max(np.abs(o8 - o32)))
+    int8_top1 = float((np.argmax(o8, 1) == np.argmax(o32, 1)).mean())
+    assert int8_dev < 0.05 and int8_top1 == 1.0, (int8_dev, int8_top1)
+
+    print(json.dumps({
+        "metric": "bench_kernel_smoke",
+        "parity_max_err": parity,
+        "enable_retraces": on_delta,
+        "disable_retraces": w_back.delta(),
+        "kernel_vs_fallback_grad_err": kernel_vs_fallback,
+        "off_path_bitwise": True,
+        "int8_vs_f32_max_dev": int8_dev,
+        "int8_top1_agreement": int8_top1,
+    }))
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -1103,6 +1252,8 @@ if __name__ == "__main__":
         health_smoke()
     elif "--io-smoke" in sys.argv:
         io_smoke()
+    elif "--kernel-smoke" in sys.argv:
+        kernel_smoke()
     elif "--smoke" in sys.argv:
         smoke()
     else:

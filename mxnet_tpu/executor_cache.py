@@ -126,6 +126,11 @@ def _note(event):
 
 
 def _signature(symbol, arg_dict, aux_dict, grad_names, platform, health):
+    # the resolved Pallas-kernel modes key the entry exactly like the
+    # health flag: flipping MXNET_TPU_PALLAS_* re-keys the program (one
+    # retrace to enable, zero to disable, off-path program untouched) —
+    # the op impls resolve the same modes at trace time (docs/kernels.md)
+    from .ops import pallas_kernels as _pk
     fp = symbol.structural_hash()
     arg_sig = tuple(sorted(
         (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
@@ -134,7 +139,7 @@ def _signature(symbol, arg_dict, aux_dict, grad_names, platform, health):
         (n, tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
         for n, a in aux_dict.items()))
     return (fp, arg_sig, aux_sig, tuple(grad_names), platform,
-            bool(health))
+            bool(health), _pk.kernel_signature())
 
 
 def _build_entry(symbol, known_shapes, grad_names, platform, health=False):

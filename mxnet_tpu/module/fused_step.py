@@ -17,9 +17,12 @@ kvstores) fall back to the general path.
 Mixed precision (ref: optimizer.py:446-476 multi_precision): when the bound
 parameters are half-width (float16/bfloat16) and the optimizer has
 multi_precision set, the step keeps float32 MASTER weights and optimizer
-state internally, casts to the storage dtype for the forward, and receives
-float32 gradients through the cast's vjp — the exact mp_sgd_* semantics,
-generalized to every optimizer.  On TPU this is the native training mode:
+state internally and casts to the storage dtype for the forward.  The vjp
+differentiates the STORAGE-dtype values, so activations and gradients stay
+bfloat16 end-to-end — no materialized f32 gradient copies — and the single
+f32 cast per parameter fuses into the master-weight update's elementwise
+epilogue (value-identical to mp_sgd_*'s cast-at-the-boundary semantics,
+generalized to every optimizer).  On TPU this is the native training mode:
 bfloat16 compute feeds the MXU and halves HBM traffic while updates
 accumulate in float32.
 """
@@ -210,15 +213,27 @@ class FusedTrainStep:
             arg_map = dict(zip(other_names, other_vals))
             aux_map = dict(zip(aux_names, aux_vals))
 
-            def f(mvals):
+            # Cast elimination (roofline kernel sprint): differentiate the
+            # STORAGE-dtype parameter values, not the f32 masters.  The
+            # old form (vjp through the master->bf16 cast) made the vjp
+            # boundary materialize a full f32 copy of every gradient —
+            # pure HBM traffic (the convert_reduce_fusion.* family in
+            # ROOFLINE_r05.json).  Here activations AND gradients stay
+            # bf16 end-to-end; the one f32 cast per parameter happens at
+            # the master-weight update below, where XLA fuses the convert
+            # into the update's elementwise epilogue.  The update math is
+            # value-identical: cast-then-update(f32) == the old
+            # update(cast_vjp(g)) — the master path remains f32.
+            pvals = [m.astype(param_dtypes[j]) if mixed[j] else m
+                     for j, m in enumerate(masters)]
+
+            def f(pv):
                 amap = dict(arg_map)
-                pvals = [m.astype(param_dtypes[j]) if mixed[j] else m
-                         for j, m in enumerate(mvals)]
-                amap.update(zip(param_names, pvals))
+                amap.update(zip(param_names, pv))
                 outs, new_aux = prog_ref.evaluate(amap, aux_map, keys, True)
                 return outs, [new_aux[n] for n in aux_names]
 
-            (outs, new_aux), vjp_fn = jax.vjp(f, masters)
+            (outs, new_aux), vjp_fn = jax.vjp(f, pvals)
             heads = [jnp.ones_like(o) for o in outs]
             zeros_aux = [jnp.zeros_like(a) for a in new_aux]
             (grads,) = vjp_fn((heads, zeros_aux))
@@ -227,6 +242,9 @@ class FusedTrainStep:
                 else [None] * n_params
             new_masters, new_states, new_exec = [], [], []
             for j, (w, g) in enumerate(zip(masters, grads)):
+                if mixed[j]:
+                    # the ONLY master-precision cast on the gradient path
+                    g = g.astype(w.dtype)
                 ex = extras[j] if n_extra else ()
                 nw, nst = opt.fused_update(w, g, states[j], lrs[j], wds[j],
                                            ex, key=opt_keys[j])

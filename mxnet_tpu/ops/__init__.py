@@ -13,3 +13,4 @@ from . import contrib_ops  # noqa: F401
 from . import extra  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import quantize  # noqa: F401

@@ -384,40 +384,156 @@ register("Deconvolution", _deconvolution, input_names=("data", "weight", "bias")
                      no_bias=(pBool, True)))
 
 # ---------------------------------------------------------------------------
-# Pooling (ref: pooling-inl.h, pool.h) — lax.reduce_window
+# Pooling (ref: pooling-inl.h, pool.h) — lax.reduce_window forward; the
+# input gradient is either XLA's autodiff (select-and-scatter for max) or
+# the hand-scheduled Pallas kernel (ops/pallas_kernels.py, flag
+# MXNET_TPU_PALLAS_POOL) selected at trace time through a custom_vjp — so
+# the fused fwd_bwd program (module/fused_step.py, executor_cache.py)
+# picks the kernel up with no module-layer change.
 # ---------------------------------------------------------------------------
 
+def _pool_spatial_pads(spatial, kernel, stride, pad, convention):
+    """Per-axis (lo, hi) spatial padding honoring the 'full' ceil mode
+    (widen the right pad so ceil division is covered)."""
+    nd = len(kernel)
+    if convention != "full":
+        return tuple((p, p) for p in pad)
+    pads = []
+    for i in range(nd):
+        d = spatial[i]
+        out_full = int(np.ceil((d + 2 * pad[i] - kernel[i])
+                               / stride[i])) + 1
+        needed = (out_full - 1) * stride[i] + kernel[i] - d - pad[i]
+        pads.append((pad[i], max(needed, pad[i])))
+    return tuple(pads)
+
+
+def _pool_out_shape(spatial, kernel, stride, pad, convention):
+    out = []
+    for i in range(len(kernel)):
+        span = spatial[i] + 2 * pad[i] - kernel[i]
+        o = (int(np.ceil(span / stride[i])) if convention == "full"
+             else span // stride[i]) + 1
+        out.append(int(o))
+    return tuple(out)
+
+
+def _pool_window_counts(spatial, kernel, stride, pad, convention):
+    """(OH, ...) float32 map of VALID (non-padded) elements per window —
+    the count_include_pad=False divisor (ref: pooling-inl.h, where padded
+    zeros are excluded from the average's denominator)."""
+    pads = _pool_spatial_pads(spatial, kernel, stride, pad, convention)
+    ones = jnp.ones(tuple(spatial), jnp.float32)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(kernel),
+                            tuple(stride), pads)
+    return jnp.maximum(cnt, 1.0)
+
+
+def _pool_xla_forward(data, pool_type, kernel, stride, pad, convention,
+                      count_include_pad):
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + _pool_spatial_pads(
+        data.shape[2:], kernel, stride, pad, convention)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    out = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+    if pool_type == "avg":
+        if count_include_pad:
+            out = out / float(np.prod(kernel))
+        else:
+            # data-independent valid-count divisor: XLA constant-folds it
+            cnt = _pool_window_counts(data.shape[2:], kernel, stride, pad,
+                                      convention)
+            out = out / cnt.reshape((1, 1) + cnt.shape)
+    return out.astype(data.dtype)
+
+
+@_functools.lru_cache(maxsize=None)
+def _pool_core(pool_type, kernel, stride, pad, convention,
+               count_include_pad, mode):
+    """Per-static-config pooling core.  mode 'off' returns the plain XLA
+    forward (autodiff = the parent program's select-and-scatter backward,
+    bit-identical to a build without the kernel); 'pallas'/'interpret'
+    wrap it in a custom_vjp whose backward is the recompute-argmax Pallas
+    kernel.  The forward saves the phase-major (s2d) input view as the
+    residual so the transpose fuses into the producer's epilogue."""
+    fwd_fn = lambda x: _pool_xla_forward(  # noqa: E731
+        x, pool_type, kernel, stride, pad, convention, count_include_pad)
+    if mode == "off":
+        return fwd_fn
+    from . import pallas_kernels as _pk
+    interpret = True if mode == "interpret" else None
+
+    @jax.custom_vjp
+    def core(x):
+        return fwd_fn(x)
+
+    def fwd(x):
+        out = fwd_fn(x)
+        if pool_type == "max":
+            oshape = _pool_out_shape(x.shape[2:], kernel, stride, pad,
+                                     convention)
+            xs = _pk.pool_s2d(x, kernel, stride, pad, oshape, -jnp.inf)
+        else:
+            xs = None  # avg/sum backward never reads x
+        # x rides along for its shape/dtype only; XLA DCEs the unused
+        # residual (the make_loss precedent above)
+        return out, (x, xs)
+
+    def bwd(res, dy):
+        x, xs = res
+        oshape = _pool_out_shape(x.shape[2:], kernel, stride, pad,
+                                 convention)
+        if pool_type == "max":
+            dx = _pk.max_pool_backward(xs, dy, x.shape, x.dtype, kernel,
+                                       stride, pad, oshape,
+                                       interpret=interpret)
+        else:
+            if pool_type == "sum":
+                div = jnp.ones(oshape, jnp.float32)
+            elif count_include_pad:
+                div = jnp.full(oshape, 1.0 / float(np.prod(kernel)),
+                               jnp.float32)
+            else:
+                div = 1.0 / _pool_window_counts(x.shape[2:], kernel,
+                                                stride, pad, convention)
+            dx = _pk.avg_pool_backward(dy, div, x.shape, x.dtype, kernel,
+                                       stride, pad, oshape,
+                                       interpret=interpret)
+        return (dx.astype(x.dtype),)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
 def _pooling(data, pool_type="max", kernel=(1, 1), stride=None, pad=None,
-             global_pool=False, pooling_convention="valid", cudnn_off=False):
+             global_pool=False, pooling_convention="valid", cudnn_off=False,
+             count_include_pad=True):
     nd = len(kernel)
     if global_pool:
         kernel = data.shape[2:]
-        stride = (1,) * nd
-        pad = (0,) * nd
+        stride = (1,) * len(kernel)
+        pad = (0,) * len(kernel)
         nd = len(kernel)
-    stride = stride or (1,) * nd
-    pad = pad or (0,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
-    if pooling_convention == "full":
-        # ceil-mode: widen right pad so ceil division is covered
-        extra = []
-        for i in range(nd):
-            d = data.shape[2 + i]
-            out_full = int(np.ceil((d + 2 * pad[i] - kernel[i]) / stride[i])) + 1
-            needed = (out_full - 1) * stride[i] + kernel[i] - d - pad[i]
-            extra.append(max(needed, pad[i]))
-        pads = ((0, 0), (0, 0)) + tuple((pad[i], extra[i]) for i in range(nd))
-    if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, init, lax.max, window, strides, pads)
-    if pool_type in ("avg", "sum"):
-        out = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
-        if pool_type == "avg":
-            out = out / float(np.prod(kernel))
-        return out.astype(data.dtype)
-    raise MXNetError("unknown pool_type %s" % pool_type)
+    stride = tuple(stride or (1,) * nd)
+    pad = tuple(pad or (0,) * nd)
+    kernel = tuple(int(k) for k in kernel)
+    if pool_type not in ("max", "avg", "sum"):
+        raise MXNetError("unknown pool_type %s" % pool_type)
+    from . import pallas_kernels as _pk
+    mode = _pk.kernel_mode("pool")
+    if mode != "off" and not (
+            data.ndim == 4 and nd == 2
+            and jnp.issubdtype(data.dtype, jnp.floating)
+            and int(np.prod(kernel)) <= 64):  # tap loop is unrolled
+        mode = "off"
+    core = _pool_core(pool_type, kernel, stride, pad,
+                      str(pooling_convention), bool(count_include_pad),
+                      mode)
+    return core(data)
 
 
 def _pool_infer_shape(in_shapes, attrs):
@@ -447,7 +563,8 @@ register("Pooling", _pooling, num_inputs=1, infer_shape=_pool_infer_shape,
                  "stride": (pShape, None), "pad": (pShape, None),
                  "global_pool": (pBool, False),
                  "pooling_convention": (pStr, "valid"),
-                 "cudnn_off": (pBool, False)})
+                 "cudnn_off": (pBool, False),
+                 "count_include_pad": (pBool, True)})
 
 # ---------------------------------------------------------------------------
 # BatchNorm (ref: batch_norm-inl.h). inputs: data, gamma, beta; aux:
@@ -456,7 +573,7 @@ register("Pooling", _pooling, num_inputs=1, infer_shape=_pool_infer_shape,
 # ---------------------------------------------------------------------------
 
 @_functools.lru_cache(maxsize=None)
-def _bn_train_core(ndim, ax, eps):
+def _bn_train_core(ndim, ax, eps, kernel_mode="off"):
     """Training-mode BN with a hand-written VJP (ref: batch_norm-inl.h
     backward).  Autodiff of the naive formulation makes XLA carry f32
     normalized activations as residuals and re-reduce twice — on TPU the
@@ -466,11 +583,29 @@ def _bn_train_core(ndim, ax, eps):
     of just the compute-dtype input plus per-channel mean/invstd.  The
     backward is exact, including the cotangent paths through the returned
     batch mean/var (which feed the moving-average update and
-    output_mean_var consumers)."""
+    output_mean_var consumers).
+
+    kernel_mode != 'off' (MXNET_TPU_PALLAS_BN, NCHW only) routes BOTH
+    reduction pairs — forward (sum x, sum x^2) and backward (sum dy,
+    sum dy*x) — through the single-pass Pallas channel-sums kernel
+    (ops/pallas_kernels.py): the bf16 activation is read once per pair
+    with f32 VMEM accumulation, replacing XLA's convert_reduce_fusion.*
+    kernels and their materialized f32 converts."""
     red = tuple(i for i in range(ndim) if i != ax)
     bshape = tuple(-1 if i == ax else 1 for i in range(ndim))
+    interpret = True if kernel_mode == "interpret" else None
+    if kernel_mode != "off":
+        from . import pallas_kernels as _pk
 
     def stats(x):
+        if kernel_mode != "off":
+            m_count = 1.0
+            for i in red:
+                m_count *= x.shape[i]
+            s1, s2 = _pk.bn_channel_sums(x, interpret=interpret)
+            m = s1 / m_count
+            var = jnp.maximum(s2 / m_count - jnp.square(m), 0.0)
+            return m, var
         x32 = x.astype(jnp.float32)
         m = jnp.mean(x32, axis=red)
         sq = jnp.mean(jnp.square(x32), axis=red)
@@ -505,8 +640,15 @@ def _bn_train_core(ndim, ax, eps):
         x32 = x.astype(jnp.float32)
         dy32 = dy.astype(jnp.float32)
         xc = x32 - mean.reshape(bshape)          # x - mean (recomputed)
-        sum_dy = jnp.sum(dy32, axis=red)
-        sum_dy_xc = jnp.sum(dy32 * xc, axis=red)
+        if kernel_mode != "off":
+            # one fused pass instead of two reductions: sum dy*(x-mean)
+            # expands to sum dy*x - mean*sum dy
+            sum_dy, sum_dy_x = _pk.bn_channel_sums(dy, x,
+                                                   interpret=interpret)
+            sum_dy_xc = sum_dy_x - mean * sum_dy
+        else:
+            sum_dy = jnp.sum(dy32, axis=red)
+            sum_dy_xc = jnp.sum(dy32 * xc, axis=red)
         g32 = g.astype(jnp.float32)
         # y-path (batch stats depend on x), + mean/var output cotangents
         dx = (g32 * inv).reshape(bshape) * (
@@ -529,7 +671,13 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _train and not use_global_stats:
-        core = _bn_train_core(data.ndim, ax, float(eps))
+        from . import pallas_kernels as _pk
+        kmode = _pk.kernel_mode("bn")
+        if kmode != "off" and not (data.ndim == 4 and ax == 1
+                                   and jnp.issubdtype(data.dtype,
+                                                      jnp.floating)):
+            kmode = "off"  # the channel-sums kernel is NCHW-shaped
+        core = _bn_train_core(data.ndim, ax, float(eps), kmode)
         out, mean, var = core(data, g, beta)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)

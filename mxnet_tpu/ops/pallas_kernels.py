@@ -13,6 +13,7 @@ backends run the same math via jnp) and are validated against it in tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -306,3 +307,347 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
         **({"interpret": interpret} if interpret is not None else {}),
     )(qf, kf, vf)
     return res if with_lse else (res[0], None)
+
+
+# ---------------------------------------------------------------------------
+# Kernel flags (docs/kernels.md).  Every kernel family resolves to one of
+# three modes; the resolved tuple is part of the executor-cache signature
+# (executor_cache._signature), so flipping a flag re-keys the program the
+# same way MXNET_TPU_HEALTH does: enabling costs one retrace per program,
+# disabling costs zero, and the off-path program is bit-identical to a
+# build that never knew the kernel existed.
+# ---------------------------------------------------------------------------
+
+_KERNEL_ENV = {
+    "pool": "MXNET_TPU_PALLAS_POOL",
+    "bn": "MXNET_TPU_PALLAS_BN",
+}
+
+
+def kernel_mode(kind):
+    """Resolved mode of kernel family ``kind`` ('pool' / 'bn'):
+
+    - ``'off'``     — XLA fallback (env ``0``; or unset on non-TPU backends)
+    - ``'pallas'``  — compiled Pallas kernel (TPU backends, unless env ``0``)
+    - ``'interpret'`` — the same kernel code path through the Pallas
+      interpreter (env ``1`` on a non-TPU backend: the CI form — the whole
+      executor program runs with the kernel inlined, so parity and retrace
+      contracts are testable without a chip).
+
+    Resolved against the process default backend at TRACE time; the
+    executor cache keys programs on the same resolution, so a flag flip
+    takes effect at the next bind, never mid-program.
+    """
+    val = os.environ.get(_KERNEL_ENV[kind], "auto").strip().lower()
+    if val in ("0", "off", "false"):
+        return "off"
+    if jax.default_backend() in ("tpu", "axon"):
+        return "pallas"
+    return "interpret" if val in ("1", "on", "true", "interpret") else "off"
+
+
+def kernel_signature():
+    """The resolved mode of every kernel family, as a hashable tuple —
+    the executor-cache key component that makes kernel flags obey the
+    health-sentinel retrace contract."""
+    return tuple((k, kernel_mode(k)) for k in sorted(_KERNEL_ENV))
+
+
+# ---------------------------------------------------------------------------
+# Pooling backward (ref: pool.h unpool kernels; XLA's lowering is
+# select-and-scatter.11 = 423 us/step of the ResNet-50 train step,
+# ROOFLINE_r05.json).  Strategy: recompute-argmax over input tiles staged
+# through VMEM.  Stride-s pooling relates input lanes to output lanes at
+# ratio s, which a TPU kernel cannot cross with strided lane access — so
+# the input is viewed PHASE-MAJOR (space-to-depth by the stride, the same
+# rewrite ops/nn.py uses for the conv stem): plane (i%sh)*sw + (j%sw) of
+# ``xs[R, sh*sw, Hq, Wq]`` holds every input pixel congruent to that
+# residue, and window tap (i, j) becomes a CONTIGUOUS (OH, OW) slice of
+# its plane at offset (i//sh, j//sw).  The s2d view is built where XLA
+# fuses it (the forward saves it as the vjp residual, so the transpose
+# rides the producer fusion's epilogue; the inverse rides the consumer of
+# dx), and the kernel itself touches x and dy exactly once.
+# ---------------------------------------------------------------------------
+
+
+def _pool_geometry(kernel, stride, out_shape):
+    """(Hq, Wq, planes) of the s2d view: Hq = OH + (kh-1)//sh quotient
+    rows cover every tap offset, exactly."""
+    kh, kw = kernel
+    sh, sw = stride
+    oh, ow = out_shape
+    return oh + (kh - 1) // sh, ow + (kw - 1) // sw, sh * sw
+
+
+def _pool_taps(kernel, stride):
+    """Window taps in row-major window order (the tie-break order of the
+    recomputed argmax): (plane, dh, dw) per tap."""
+    kh, kw = kernel
+    sh, sw = stride
+    return tuple(((i % sh) * sw + (j % sw), i // sh, j // sw)
+                 for i in range(kh) for j in range(kw))
+
+
+def pool_s2d(x, kernel, stride, pad, out_shape, pad_value):
+    """Phase-major (space-to-depth by stride) view of the padded pooling
+    input: (N, C, H, W) -> (N*C, sh*sw, Hq, Wq).  Input rows past the last
+    window are cropped (they take zero gradient); short rows pad with
+    ``pad_value`` (-inf for max so padding never wins the argmax, 0
+    otherwise)."""
+    n, c, h, w = x.shape
+    sh, sw = stride
+    ph, pw = pad
+    hq, wq, _ = _pool_geometry(kernel, stride, out_shape)
+    hp2, wp2 = hq * sh, wq * sw
+    h_take = min(h, hp2 - ph)
+    w_take = min(w, wp2 - pw)
+    xp = jnp.full((n, c, hp2, wp2), jnp.asarray(pad_value, x.dtype), x.dtype)
+    xp = xp.at[:, :, ph:ph + h_take, pw:pw + w_take].set(
+        x[:, :, :h_take, :w_take])
+    xs = xp.reshape(n * c, hq, sh, wq, sw)
+    return xs.transpose(0, 2, 4, 1, 3).reshape(n * c, sh * sw, hq, wq)
+
+
+def _pool_s2d_inverse(dxs, x_shape, kernel, stride, pad, out_shape):
+    """Assemble (N, C, H, W) input gradients from the kernel's phase-major
+    output (the inverse s2d view; XLA fuses it into dx's consumer)."""
+    n, c, h, w = x_shape
+    sh, sw = stride
+    ph, pw = pad
+    hq, wq, _ = _pool_geometry(kernel, stride, out_shape)
+    hp2, wp2 = hq * sh, wq * sw
+    dxp = dxs.reshape(n, c, sh, sw, hq, wq)
+    dxp = dxp.transpose(0, 1, 4, 2, 5, 3).reshape(n, c, hp2, wp2)
+    h_take = min(h, hp2 - ph)
+    w_take = min(w, wp2 - pw)
+    dx = dxp[:, :, ph:ph + h_take, pw:pw + w_take]
+    if h_take < h or w_take < w:
+        dx = jnp.pad(dx, ((0, 0), (0, 0),
+                          (0, h - h_take), (0, w - w_take)))
+    return dx
+
+
+def _pool_block_rows(rows):
+    """Largest power-of-two row block (<=8) dividing the flattened N*C
+    extent — whole-spatial blocks keep VMEM per step in the hundreds of
+    KB for real conv-net shapes."""
+    for b in (8, 4, 2, 1):
+        if rows % b == 0:
+            return b
+    return 1
+
+
+def _max_pool_bwd_kernel(xs_ref, dy_ref, out_ref, acc_ref, *, taps, oh, ow):
+    """One R-block: recompute the window max and its FIRST achieving tap
+    (row-major window order — the same tie-break select-and-scatter's
+    ``ge`` select applies in its iteration order), then route each output
+    cotangent to that tap's plane slice.  All tap reads/writes are
+    contiguous (OH, OW) slices of VMEM-resident planes; accumulation runs
+    in a float32 scratch and casts once on the way out."""
+    n_taps = len(taps)
+
+    def tap_x(t):
+        plane, dh, dw = taps[t]
+        return xs_ref[:, plane, dh:dh + oh, dw:dw + ow].astype(jnp.float32)
+
+    m = tap_x(0)
+    for t in range(1, n_taps):
+        m = jnp.maximum(m, tap_x(t))
+    am = jnp.full(m.shape, n_taps, jnp.int32)
+    for t in range(n_taps):
+        hit = (tap_x(t) == m) & (am == n_taps)
+        am = jnp.where(hit, jnp.int32(t), am)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    dyv = dy_ref[:].astype(jnp.float32)
+    for t in range(n_taps):
+        plane, dh, dw = taps[t]
+        acc_ref[:, plane, dh:dh + oh, dw:dw + ow] += jnp.where(
+            am == t, dyv, jnp.float32(0.0))
+    out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+def _avg_pool_bwd_kernel(dy_ref, div_ref, out_ref, acc_ref, *, taps, oh,
+                         ow):
+    """avg/sum pooling backward never reads x: every tap of a window
+    takes the same cotangent share dy * div (div folds the window-count
+    divisor — per-position under count_include_pad=False)."""
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    c = dy_ref[:].astype(jnp.float32) * div_ref[:][None]
+    for plane, dh, dw in taps:
+        acc_ref[:, plane, dh:dh + oh, dw:dw + ow] += c
+    out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+@functools.lru_cache(maxsize=512)
+def _pool_bwd_jitted(pool_type, rows, planes, hq, wq, oh, ow, taps, dtype,
+                     interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    br = _pool_block_rows(rows)
+    out_dtype = jnp.dtype(dtype)
+    if pool_type == "max":
+        kernel = functools.partial(_max_pool_bwd_kernel, taps=taps, oh=oh,
+                                   ow=ow)
+        in_specs = [
+            pl.BlockSpec((br, planes, hq, wq), lambda r: (r, 0, 0, 0)),
+            pl.BlockSpec((br, oh, ow), lambda r: (r, 0, 0)),
+        ]
+    else:
+        kernel = functools.partial(_avg_pool_bwd_kernel, taps=taps, oh=oh,
+                                   ow=ow)
+        in_specs = [
+            pl.BlockSpec((br, oh, ow), lambda r: (r, 0, 0)),
+            pl.BlockSpec((oh, ow), lambda r: (0, 0)),
+        ]
+
+    def run(*operands):
+        with _enable_x64(False):
+            return pl.pallas_call(
+                kernel,
+                grid=(rows // br,),
+                in_specs=in_specs,
+                out_specs=pl.BlockSpec((br, planes, hq, wq),
+                                       lambda r: (r, 0, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct((rows, planes, hq, wq),
+                                               out_dtype),
+                scratch_shapes=[
+                    pltpu.VMEM((br, planes, hq, wq), jnp.float32)],
+                compiler_params=_compiler_params_cls(pltpu)(
+                    dimension_semantics=("parallel",)),
+                **({"interpret": interpret} if interpret is not None
+                   else {}),
+            )(*operands)
+
+    return jax.jit(run)
+
+
+def max_pool_backward(xs, dy, x_shape, x_dtype, kernel, stride, pad,
+                      out_shape, interpret=None):
+    """Input gradient of 2-D max pooling from the phase-major residual
+    ``xs = pool_s2d(x, ..., -inf)`` and the output cotangent ``dy``
+    (N, C, OH, OW).  Returns dx shaped/typed like x."""
+    n, c = x_shape[:2]
+    oh, ow = out_shape
+    hq, wq, planes = _pool_geometry(kernel, stride, out_shape)
+    fn = _pool_bwd_jitted("max", n * c, planes, hq, wq, oh, ow,
+                          _pool_taps(kernel, stride),
+                          str(jnp.dtype(x_dtype)), interpret)
+    dxs = fn(xs, dy.reshape(n * c, oh, ow))
+    return _pool_s2d_inverse(dxs, x_shape, kernel, stride, pad, out_shape)
+
+
+def avg_pool_backward(dy, divisor, x_shape, x_dtype, kernel, stride, pad,
+                      out_shape, interpret=None):
+    """Input gradient of 2-D avg/sum pooling: ``divisor`` is the (OH, OW)
+    float32 map each cotangent is multiplied by — 1 for sum pooling,
+    1/prod(kernel) for avg, 1/valid-count under count_include_pad=False.
+    Never touches x."""
+    n, c = x_shape[:2]
+    oh, ow = out_shape
+    hq, wq, planes = _pool_geometry(kernel, stride, out_shape)
+    fn = _pool_bwd_jitted("avg", n * c, planes, hq, wq, oh, ow,
+                          _pool_taps(kernel, stride),
+                          str(jnp.dtype(x_dtype)), interpret)
+    dxs = fn(dy.reshape(n * c, oh, ow), divisor.astype(jnp.float32))
+    return _pool_s2d_inverse(dxs, x_shape, kernel, stride, pad, out_shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused BN-stats epilogue (ref: batch_norm-inl.h; XLA's lowering of the
+# one-pass stats is the convert_reduce_fusion.* family — ~1 ms/step
+# combined on the ResNet-50 train step, ROOFLINE_r05.json, because each
+# reduction re-reads the bf16 activation and materializes an f32 convert).
+# One Pallas kernel computes BOTH per-channel moments (sum and
+# sum-of-squares) in a single pass over the activation, reading bf16 and
+# accumulating f32 in VMEM — the same kernel shape serves the backward's
+# (sum dy, sum dy*x) pair, so training BN costs two activation passes
+# total instead of XLA's four-plus converts.
+# ---------------------------------------------------------------------------
+
+
+def _make_channel_sums_kernel(pair, n_steps):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        if pair:
+            a_ref, b_ref, out1_ref, out2_ref, acc1_ref, acc2_ref = refs
+        else:
+            a_ref, out1_ref, out2_ref, acc1_ref, acc2_ref = refs
+            b_ref = a_ref
+        n = pl.program_id(1)
+
+        @pl.when(n == 0)
+        def _init():
+            acc1_ref[:] = jnp.zeros_like(acc1_ref)
+            acc2_ref[:] = jnp.zeros_like(acc2_ref)
+
+        av = a_ref[0].astype(jnp.float32)     # (block_c, H, W)
+        bv = av if not pair else b_ref[0].astype(jnp.float32)
+        acc1_ref[:] += av
+        acc2_ref[:] += av * bv
+
+        @pl.when(n == n_steps - 1)
+        def _emit():
+            out1_ref[0] = jnp.sum(acc1_ref[:], axis=(1, 2))
+            out2_ref[0] = jnp.sum(acc2_ref[:], axis=(1, 2))
+
+    return kernel
+
+
+def _bn_block_c(c, h, w):
+    """Largest divisor of C whose f32 accumulator pair stays under ~1 MiB
+    of VMEM at this spatial extent."""
+    budget = max(1, (512 * 1024) // max(h * w * 4, 1))
+    best = 1
+    for b in range(1, min(c, 512) + 1):
+        if c % b == 0 and b <= budget:
+            best = b
+    return best
+
+
+@functools.lru_cache(maxsize=512)
+def _channel_sums_jitted(pair, n, c, h, w, dtype_a, dtype_b, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    block_c = _bn_block_c(c, h, w)
+    n_cb = c // block_c
+    kernel = _make_channel_sums_kernel(pair, n)
+    x_spec = pl.BlockSpec((1, block_c, h, w), lambda cb, i: (i, cb, 0, 0))
+    in_specs = [x_spec, x_spec] if pair else [x_spec]
+    out_specs = [pl.BlockSpec((1, block_c), lambda cb, i: (cb, 0)),
+                 pl.BlockSpec((1, block_c), lambda cb, i: (cb, 0))]
+    out_shape = [jax.ShapeDtypeStruct((n_cb, block_c), jnp.float32),
+                 jax.ShapeDtypeStruct((n_cb, block_c), jnp.float32)]
+
+    def run(*operands):
+        with _enable_x64(False):
+            s1, s2 = pl.pallas_call(
+                kernel,
+                grid=(n_cb, n),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                out_shape=out_shape,
+                scratch_shapes=[
+                    pltpu.VMEM((block_c, h, w), jnp.float32),
+                    pltpu.VMEM((block_c, h, w), jnp.float32)],
+                compiler_params=_compiler_params_cls(pltpu)(
+                    dimension_semantics=("parallel", "arbitrary")),
+                **({"interpret": interpret} if interpret is not None
+                   else {}),
+            )(*operands)
+        return s1.reshape(c), s2.reshape(c)
+
+    return jax.jit(run)
+
+
+def bn_channel_sums(a, b=None, interpret=None):
+    """Per-channel single-pass paired reduction over an NCHW tensor:
+    returns float32 ``(sum_c a, sum_c a*b)`` with ``b = a`` when ``b`` is
+    None (the stats epilogue: sum + sum-of-squares) — the backward pair
+    is ``bn_channel_sums(dy, x)`` = (sum dy, sum dy*x)."""
+    n, c, h, w = a.shape
+    pair = b is not None
+    fn = _channel_sums_jitted(pair, n, c, h, w, str(jnp.dtype(a.dtype)),
+                              str(jnp.dtype(b.dtype)) if pair else "",
+                              interpret)
+    return fn(a, b) if pair else fn(a)

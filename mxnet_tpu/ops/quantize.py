@@ -1,0 +1,389 @@
+"""int8 inference: per-channel weight quantization + quantized conv/FC ops
++ the graph rewrite that retargets a trained Symbol onto them.
+
+TPU-native analog of the reference's quantization pass
+(src/operator/quantization/: quantize_graph_pass.cc rewrites
+Convolution/FullyConnected onto _contrib_quantized_* twins; calibration
+via MinMax collectors).  Here the quantized ops are pure jnp — int8
+operands into ``lax.conv_general_dilated`` / ``lax.dot_general`` with
+``preferred_element_type=int32`` hit the chip's int8 MXU path where the
+hardware has one and XLA's int8 lowering elsewhere — and the rewrite is a
+topo-order node map producing a NEW Symbol whose int8 weights and f32
+per-channel scales bind like any other parameters (so the executor cache,
+serving buckets, and ``warmup()``'s zero-retrace verification all apply
+unchanged).
+
+Scales:
+
+- **weights** — exact, offline: symmetric per-output-channel
+  ``max|w| / 127`` (``quantize_weight``), computed from the checkpoint at
+  rewrite time.
+- **activations** — per-tensor, either **dynamic** (``max|x| / 127``
+  recomputed in-program per batch; one tiny reduce, always correct) or
+  **calibrated offline** (``calibrate()``): a jitted collector evaluates
+  the FP graph and packs every quantized layer's input ``max|x|`` into
+  ONE vector per batch — the health sentinel's packed-reduction design
+  (observability/health.py) applied to serving calibration: zero
+  per-tensor host syncs, one small fetch per calibration batch.  The
+  resulting :class:`CalibrationTable` pins ``act_scale`` per layer so the
+  serving-time program needs no dynamic range pass at all.
+
+Entry points: ``Predictor(..., quantize="int8")``,
+``ServedModel(..., quantize="int8")`` and the ``MXNET_TPU_QUANTIZE`` env
+default (docs/serving.md §int8).
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from .nn import _CONV_PARAMS, _conv_dn, _conv_out_dim
+from .registry import register, pInt, pBool, pFloat
+
+_QUANT_MODES = ("int8",)
+
+
+# ---------------------------------------------------------------------------
+# Quantization math
+# ---------------------------------------------------------------------------
+
+def quantize_weight(w, axis=0):
+    """Symmetric per-channel int8 quantization of a weight array along
+    ``axis`` (the output-channel axis for Convolution/FullyConnected).
+    Returns ``(q_int8, scales_f32)`` with ``w ~= q * scales`` broadcast
+    over ``axis``."""
+    w = np.asarray(w, dtype=np.float32)
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.max(np.abs(w), axis=red) if red else np.abs(w)
+    scales = (np.maximum(amax, 1e-12) / 127.0).astype(np.float32)
+    bshape = tuple(-1 if i == axis else 1 for i in range(w.ndim))
+    q = np.clip(np.rint(w / scales.reshape(bshape)), -127, 127)
+    return q.astype(np.int8), scales
+
+
+def _quantize_act(x, act_scale):
+    """(x_int8, scale): symmetric activation quantization — the
+    calibrated static scale when ``act_scale > 0``, else a dynamic
+    PER-ROW range (reduce over every axis but the batch).  Per-row, not
+    per-tensor, on purpose: serving co-batches unrelated requests and
+    pads rows (docs/serving.md, determinism contract — no op may mix
+    information across the batch axis), so a row's quantization grid
+    must depend only on that row."""
+    if act_scale and act_scale > 0.0:
+        s = jnp.float32(act_scale)
+    else:
+        red = tuple(range(1, x.ndim))
+        s = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red,
+                                keepdims=True),
+                        jnp.float32(1e-12)) / jnp.float32(127.0)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s),
+                  -127.0, 127.0).astype(jnp.int8)
+    return xq, s
+
+
+# ---------------------------------------------------------------------------
+# Quantized ops (int8 operands, int32 accumulation, f32 rescale)
+# ---------------------------------------------------------------------------
+
+def _quantized_convolution(data, weight, scale, *rest, kernel=(1, 1),
+                           stride=None, dilate=None, pad=None, num_filter=1,
+                           num_group=1, no_bias=False, workspace=1024,
+                           cudnn_tune=None, cudnn_off=False, layout=None,
+                           act_scale=0.0):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    xq, sx = _quantize_act(data, act_scale)
+    out = lax.conv_general_dilated(
+        xq, weight.astype(jnp.int8),
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32,
+    )
+    # sx is scalar (calibrated) or (N, 1, ..., 1) (dynamic per-row);
+    # either broadcasts against the per-channel weight scales
+    rescale = sx * scale.astype(jnp.float32).reshape((1, -1) + (1,) * nd)
+    y = out.astype(jnp.float32) * rescale
+    if not no_bias:
+        y = y + rest[0].astype(jnp.float32).reshape((1, -1) + (1,) * nd)
+    return y.astype(data.dtype)
+
+
+def _qconv_infer_shape(in_shapes, attrs):
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = attrs.get("stride") or (1,) * nd
+    dilate = attrs.get("dilate") or (1,) * nd
+    pad = attrs.get("pad") or (0,) * nd
+    num_filter = int(attrs["num_filter"])
+    num_group = int(attrs.get("num_group", 1))
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    filled[1] = (num_filter, dshape[1] // num_group) + tuple(kernel)
+    filled[2] = (num_filter,)
+    if not attrs.get("no_bias", False):
+        filled[3] = (num_filter,)
+    spatial = tuple(_conv_out_dim(dshape[2 + i], kernel[i], stride[i],
+                                  pad[i], dilate[i]) for i in range(nd))
+    return filled, [(dshape[0], num_filter) + spatial]
+
+
+def _quantized_fully_connected(data, weight, scale, *rest, num_hidden=1,
+                               no_bias=False, flatten=True, act_scale=0.0):
+    x = data.reshape(data.shape[0], -1) if flatten or data.ndim == 2 \
+        else data
+    xq, sx = _quantize_act(x, act_scale)
+    out = lax.dot_general(
+        xq, weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = out.astype(jnp.float32) * (sx * scale.astype(jnp.float32))
+    if not no_bias:
+        y = y + rest[0].astype(jnp.float32)
+    return y.astype(data.dtype)
+
+
+def _qfc_infer_shape(in_shapes, attrs):
+    num_hidden = int(attrs["num_hidden"])
+    flatten = attrs.get("flatten", True)
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes, [None]
+    filled = list(in_shapes)
+    if flatten or len(dshape) == 2:
+        in_dim = int(np.prod(dshape[1:]))
+        oshape = (dshape[0], num_hidden)
+    else:
+        in_dim = int(dshape[-1])
+        oshape = tuple(dshape[:-1]) + (num_hidden,)
+    filled[1] = (num_hidden, in_dim)
+    filled[2] = (num_hidden,)
+    if not attrs.get("no_bias", False):
+        filled[3] = (num_hidden,)
+    return filled, [oshape]
+
+
+def _q_infer_type(in_dtypes, attrs):
+    d = in_dtypes[0]
+    if d is None:
+        return in_dtypes, None
+    filled = [d, np.int8, np.float32, np.float32][:len(in_dtypes)]
+    return filled, [d]
+
+
+register("_contrib_quantized_conv", _quantized_convolution,
+         input_names=("data", "weight", "scale", "bias"),
+         infer_shape=_qconv_infer_shape, infer_type=_q_infer_type,
+         params=dict(_CONV_PARAMS, act_scale=(pFloat, 0.0)))
+
+register("_contrib_quantized_fc", _quantized_fully_connected,
+         input_names=("data", "weight", "scale", "bias"),
+         infer_shape=_qfc_infer_shape, infer_type=_q_infer_type,
+         params={"num_hidden": (pInt, 1), "no_bias": (pBool, False),
+                 "flatten": (pBool, True), "act_scale": (pFloat, 0.0)})
+
+_QUANT_OF = {"Convolution": "_contrib_quantized_conv",
+             "FullyConnected": "_contrib_quantized_fc"}
+
+
+# ---------------------------------------------------------------------------
+# Graph rewrite
+# ---------------------------------------------------------------------------
+
+def _quantizable(node, arg_params):
+    """A node the rewrite retargets: Convolution/FullyConnected whose
+    weight input is a variable with a known (checkpointed) value.
+    Deconvolution and weight-producing subgraphs stay float."""
+    if node.is_var or node.op_name not in _QUANT_OF:
+        return False
+    if len(node.inputs) < 2:
+        return False
+    wsrc, _ = node.inputs[1]
+    return wsrc.is_var and wsrc.name in arg_params
+
+
+def quantize_symbol(symbol, arg_params, aux_params=None, mode="int8",
+                    calibration=None, skip=()):
+    """Rewrite ``symbol`` for int8 inference: every quantizable
+    Convolution/FullyConnected becomes its ``_contrib_quantized_*`` twin
+    reading an int8 weight + f32 per-channel scale (new variables named
+    ``<weight>_int8`` / ``<weight>_scale``), with ``act_scale`` pinned
+    from ``calibration`` (a :class:`CalibrationTable` / {node_name:
+    scale} map) or 0 for in-program dynamic ranging.  ``skip`` names
+    layers to keep float (e.g. a range-sensitive head).
+
+    Returns ``(qsym, qarg_params, qaux_params)`` — bind/serve them
+    exactly like the float artifacts."""
+    if mode not in _QUANT_MODES:
+        raise MXNetError("unsupported quantize mode %r (supported: %s)"
+                         % (mode, _QUANT_MODES))
+    from ..ndarray import array as nd_array
+    from ..symbol.symbol import Symbol, _Node
+    calibration = dict(calibration or {})
+    skip = set(skip)
+    order = symbol._topo()
+    qargs = {k: v for k, v in arg_params.items()}
+    mapped = {}
+    qvars = {}       # weight name -> (wq_node, sc_node): tied weights
+    replaced = set()  # quantize once and share
+    for node in order:
+        if node.is_var:
+            mapped[node] = node
+            continue
+        inputs = [(mapped[src], idx) for src, idx in node.inputs]
+        if _quantizable(node, arg_params) and node.name not in skip:
+            wsrc, _ = node.inputs[1]
+            if wsrc.name not in qvars:
+                q, scales = quantize_weight(
+                    arg_params[wsrc.name].asnumpy())
+                wq_node = _Node(None, wsrc.name + "_int8",
+                                {"__dtype__": "int8"})
+                sc_node = _Node(None, wsrc.name + "_scale",
+                                {"__dtype__": "float32"})
+                qvars[wsrc.name] = (wq_node, sc_node)
+                qargs[wq_node.name] = nd_array(q, dtype=np.int8)
+                qargs[sc_node.name] = nd_array(scales, dtype=np.float32)
+                replaced.add(wsrc.name)
+            wq_node, sc_node = qvars[wsrc.name]
+            attrs = dict(node.attrs)
+            act = float(calibration.get(node.name, 0.0))
+            if act > 0.0:
+                attrs["act_scale"] = repr(act)
+            new_inputs = [inputs[0], (wq_node, 0), (sc_node, 0)]
+            new_inputs.extend(inputs[2:])  # bias rides along untouched
+            mapped[node] = _Node(_QUANT_OF[node.op_name], node.name,
+                                 attrs, new_inputs)
+        elif all(mapped[src] is src for src, _ in node.inputs):
+            mapped[node] = node  # untouched subgraph: share the nodes
+        else:
+            mapped[node] = _Node(node.op_name, node.name,
+                                 dict(node.attrs), inputs)
+    qsym = Symbol([(mapped[n], i) for n, i in symbol._entries])
+    # drop a replaced float weight only when NOTHING in the rewritten
+    # graph still reads it (a weight tied into a non-quantized consumer
+    # — e.g. an embedding sharing an FC weight — keeps its float copy,
+    # with its checkpoint shape stamped on the var: the conv/FC node
+    # that used to anchor shape inference for it now reads the int8
+    # twin instead)
+    still_used = {}
+    for n in qsym._topo():
+        if n.is_var:
+            still_used[n.name] = n
+    for name in replaced:
+        if name not in still_used:
+            qargs.pop(name, None)
+        elif "__shape__" not in still_used[name].attrs:
+            still_used[name].attrs["__shape__"] = str(
+                tuple(int(d) for d in arg_params[name].shape))
+            # the var node is shared with the source symbol: invalidate
+            # memoized structural hashes the same way _set_attr does
+            from ..symbol import symbol as _sym_mod
+            _sym_mod._attr_epoch += 1
+    return qsym, qargs, dict(aux_params or {})
+
+
+# ---------------------------------------------------------------------------
+# Offline activation calibration (the health-sentinel design, applied to
+# serving: one packed in-program max vector per calibration batch)
+# ---------------------------------------------------------------------------
+
+class CalibrationTable(dict):
+    """{node_name: act_scale} with a serializable layout description
+    (mirrors HealthLayout.describe(): the label list IS the slot map of
+    the packed per-batch vector the collector fetched)."""
+
+    def describe(self):
+        return {"slots": ["max_abs_act/%s" % k for k in sorted(self)],
+                "scales": {k: float(v) for k, v in sorted(self.items())}}
+
+    def dumps(self):
+        return json.dumps(self.describe())
+
+    @classmethod
+    def loads(cls, s):
+        return cls(json.loads(s)["scales"])
+
+
+def calibrate(symbol, arg_params, aux_params, input_shapes, batches,
+              ctx=None):
+    """Offline activation-range calibration for :func:`quantize_symbol`:
+    run the FLOAT graph over ``batches`` (iterable of {input_name: host
+    array}) and record each quantizable layer's input ``max|x|``.
+
+    The collector is ONE jitted program evaluating the graph with a tap
+    that packs every layer's max-reduction into a single vector — the
+    same packed-summary shape the health sentinel uses for training
+    numerics, so calibration costs one small device→host fetch per
+    batch, never a per-tensor sync.  Returns a :class:`CalibrationTable`
+    of per-layer ``act_scale`` (= running max / 127)."""
+    from ..context import cpu as _cpu
+    exe = symbol.simple_bind(ctx or _cpu(), grad_req="null",
+                             **{k: tuple(v) for k, v in
+                                input_shapes.items()})
+    exe.copy_params_from(arg_params, aux_params or {},
+                         allow_extra_params=True)
+    prog = exe._prog
+    qnodes = [n for n in prog.order if _quantizable(n, arg_params)]
+    if not qnodes:
+        return CalibrationTable()
+    arg_names, aux_names = prog.arg_names, prog.aux_names
+    keys = tuple(jax.random.PRNGKey(i) for i in range(len(prog.rng_nodes)))
+
+    @jax.jit
+    def collect(arg_vals, aux_vals):
+        cap = {}
+
+        def tap(node, i, val):
+            cap[(id(node), i)] = val
+
+        amap = dict(zip(arg_names, arg_vals))
+        prog.evaluate(amap, dict(zip(aux_names, aux_vals)), keys, False,
+                      tap=tap)
+        maxes = []
+        for node in qnodes:
+            src, idx = node.inputs[0]
+            v = amap[src.name] if src.is_var else cap[(id(src), idx)]
+            # per-tensor max is reshape-invariant (FC flatten included)
+            maxes.append(jnp.max(jnp.abs(v.astype(jnp.float32))))
+        return jnp.stack(maxes)
+
+    aux_vals = [exe.aux_dict[n]._h.array for n in aux_names]
+    running = None
+    for batch in batches:
+        arg_vals = []
+        for n in arg_names:
+            bound = exe.arg_dict[n]._h.array
+            if n in batch:
+                # graftlint: disable=GL003 — host->device UPLOAD of the
+                # user-fed calibration batch (offline tool, not a hot path)
+                v = jnp.asarray(np.asarray(batch[n]))
+                arg_vals.append(v.astype(bound.dtype)
+                                if v.dtype != bound.dtype else v)
+            else:
+                arg_vals.append(bound)
+        # graftlint: disable=GL003 — the ONE small per-batch fetch of the
+        # packed max vector (the sentinel-style contract: a few scalars)
+        vec = np.asarray(collect(arg_vals, aux_vals))
+        # graftlint: disable=GL003 — host-side running max over those
+        # scalars between offline calibration batches
+        running = vec if running is None else np.maximum(running, vec)
+    if running is None:
+        raise MXNetError(
+            "calibrate() saw no batches: pass a non-empty iterable of "
+            "{input_name: array} dicts (a generator can only be "
+            "consumed once)")
+    return CalibrationTable(
+        {node.name: float(m) / 127.0
+         for node, m in zip(qnodes, running)})

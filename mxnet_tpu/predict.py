@@ -21,7 +21,8 @@ class Predictor:
     """MXPredCreate equivalent: (symbol_json, params) -> forward machine."""
 
     def __init__(self, symbol_json, param_bytes_or_file, input_shapes,
-                 dev_type="cpu", dev_id=0, ctx=None):
+                 dev_type="cpu", dev_id=0, ctx=None, quantize=None,
+                 calibration=None):
         from . import symbol as sym_mod
         if isinstance(symbol_json, str) and symbol_json.lstrip().startswith("{"):
             self._symbol = sym_load_json(symbol_json)
@@ -42,6 +43,17 @@ class Predictor:
                       if k.startswith("aux:")}
         if not arg_params and not aux_params:
             arg_params = params
+        # int8 inference (ops/quantize.py, docs/serving.md §int8): rewrite
+        # the graph onto _contrib_quantized_* twins BEFORE binding, so the
+        # bound program computes int8 conv/FC with per-channel scales;
+        # `calibration` (a CalibrationTable / {layer: act_scale}) pins
+        # static activation ranges, else ranges are dynamic in-program
+        if quantize:
+            from .ops import quantize as _quant
+            self._symbol, arg_params, aux_params = _quant.quantize_symbol(
+                self._symbol, arg_params, aux_params, mode=quantize,
+                calibration=calibration)
+        self._quantize = quantize
         if ctx is None:
             from .context import Context
             ctx = Context(Context.devstr2type.get(dev_type, 1), dev_id)
@@ -116,8 +128,9 @@ class Predictor:
         reference MXPredReshape contract — old and new handles are
         independent and both must be freed)."""
         new = object.__new__(Predictor)
-        new._symbol = self._symbol
+        new._symbol = self._symbol  # already quantized when this one is
         new._ctx = self._ctx
+        new._quantize = getattr(self, "_quantize", None)
         shape_kwargs = dict(input_shapes)
         new._exe = new._symbol.simple_bind(new._ctx, grad_req="null",
                                            **shape_kwargs)

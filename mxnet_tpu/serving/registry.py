@@ -17,6 +17,7 @@ thread.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -59,11 +60,19 @@ class ServedModel:
     weights, plus the metadata the batcher and HTTP front-end need."""
 
     def __init__(self, name, symbol, arg_params, aux_params, input_shapes,
-                 max_batch_size=8, ctx=None):
+                 max_batch_size=8, ctx=None, quantize=None,
+                 calibration=None):
         self.name = name
         self.symbol = symbol
         self.buckets = bucket_sizes(max_batch_size)
         self.max_batch_size = max_batch_size
+        # int8 serving (docs/serving.md §int8): quantize=None defers to
+        # the MXNET_TPU_QUANTIZE env default; the rewrite happens once in
+        # the base predictor and every bucket shares its int8 weights
+        if quantize is None:
+            env = os.environ.get("MXNET_TPU_QUANTIZE", "").strip().lower()
+            quantize = env if env not in ("", "0", "off", "none") else None
+        self.quantize = quantize
         # feature shapes EXCLUDE the batch dim: {"data": (8,)} serves
         # requests shaped (rows, 8)
         self.input_shapes = {k: tuple(int(d) for d in v)
@@ -72,7 +81,8 @@ class ServedModel:
         params.update({"aux:%s" % k: v for k, v in (aux_params or {}).items()})
         base_shapes = self._bind_shapes(self.buckets[0])
         self._base = Predictor(symbol.tojson(), params, base_shapes,
-                               ctx=ctx)
+                               ctx=ctx, quantize=quantize,
+                               calibration=calibration)
         self.output_names = self._base.output_names
         self._by_bucket = {self.buckets[0]: self._base}
         self._lock = threading.Lock()
@@ -127,25 +137,28 @@ class ModelRegistry:
         self._lock = threading.Lock()
 
     def register(self, name, symbol, arg_params, aux_params, input_shapes,
-                 max_batch_size=8, ctx=None):
+                 max_batch_size=8, ctx=None, quantize=None,
+                 calibration=None):
         """Register a live symbol + params under ``name`` (replacing any
         previous registration) and return its :class:`ServedModel`."""
         model = ServedModel(name, symbol, arg_params, aux_params,
                             input_shapes, max_batch_size=max_batch_size,
-                            ctx=ctx)
+                            ctx=ctx, quantize=quantize,
+                            calibration=calibration)
         with self._lock:
             self._models[name] = model
         return model
 
     def load(self, name, prefix, epoch, input_shapes, max_batch_size=8,
-             ctx=None):
+             ctx=None, quantize=None, calibration=None):
         """Register from ``save_checkpoint`` artifacts (prefix-symbol.json
         + prefix-%04d.params — the two-artifact reference format)."""
         from ..model import load_checkpoint
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         return self.register(name, symbol, arg_params, aux_params,
                              input_shapes, max_batch_size=max_batch_size,
-                             ctx=ctx)
+                             ctx=ctx, quantize=quantize,
+                             calibration=calibration)
 
     def get(self, name):
         with self._lock:

@@ -75,26 +75,33 @@ class Server:
     # -- model management ----------------------------------------------------
 
     def add_model(self, name, symbol, arg_params, aux_params=None,
-                  input_shapes=None, ctx=None):
+                  input_shapes=None, ctx=None, quantize=None,
+                  calibration=None):
         """Register a live symbol + params; buckets sized to this
         server's ``max_batch_size``.  ``input_shapes`` maps input name
         -> per-row feature shape (no batch dim): ``{"data": (8,)}``.
         The graph must be row-wise — no op may mix information across
         the batch axis at inference (docs/serving.md, Determinism
-        contract) — or padding/co-batching silently corrupts results."""
+        contract) — or padding/co-batching silently corrupts results.
+        ``quantize="int8"`` serves the int8 rewrite of the graph
+        (per-channel weight scales; ``calibration`` pins activation
+        ranges — docs/serving.md §int8)."""
         if not input_shapes:
             raise BadRequest("input_shapes is required: {input_name: "
                              "per-row feature shape}, e.g. {'data': (8,)}")
         return self.registry.register(
             name, symbol, arg_params, aux_params, input_shapes,
-            max_batch_size=self.max_batch_size, ctx=ctx)
+            max_batch_size=self.max_batch_size, ctx=ctx,
+            quantize=quantize, calibration=calibration)
 
-    def load_model(self, name, prefix, epoch, input_shapes, ctx=None):
+    def load_model(self, name, prefix, epoch, input_shapes, ctx=None,
+                   quantize=None, calibration=None):
         """Register from checkpoint artifacts (``save_checkpoint``'s
         prefix-symbol.json + prefix-%04d.params)."""
         return self.registry.load(
             name, prefix, epoch, input_shapes,
-            max_batch_size=self.max_batch_size, ctx=ctx)
+            max_batch_size=self.max_batch_size, ctx=ctx,
+            quantize=quantize, calibration=calibration)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -33,7 +33,7 @@ _FAST_MODULES = {
     "test_health", "test_io_metric_kvstore", "test_io_pipeline",
     "test_kvstore_ici", "test_module", "test_ndarray",
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
-    "test_serving",
+    "test_serving", "test_pallas_kernels",
 }
 
 

@@ -95,6 +95,10 @@ TESTED_ELSEWHERE = {
     "_contrib_ifft": "tests/test_contrib_custom.py",
     "_contrib_quantize": "tests/test_contrib_custom.py",
     "_contrib_dequantize": "tests/test_contrib_custom.py",
+    "_contrib_quantized_conv":
+        "tests/test_pallas_kernels.py (int8 predict + served replay)",
+    "_contrib_quantized_fc":
+        "tests/test_pallas_kernels.py (int8 predict + served replay)",
     "_contrib_count_sketch": "tests/test_detection.py",
     "_contrib_Proposal": "tests/test_detection.py",
     "_contrib_MultiProposal": "tests/test_detection.py",

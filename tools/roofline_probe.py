@@ -145,9 +145,83 @@ def classify(name, mxu_set):
     return "other"
 
 
+def kernel_family(name):
+    """Kernel-family key for cross-round attribution: kernel (HLO
+    instruction) numbering is compilation-specific, so rounds are
+    compared on the name with its trailing instance number stripped
+    (select-and-scatter.11 -> select-and-scatter; convert_reduce_fusion.191
+    -> convert_reduce_fusion).  Truncate to the report's 60-char key
+    width FIRST so a full current name and its stored (already
+    truncated, possibly mid-suffix) previous key canonicalize the same
+    way."""
+    import re
+    return re.sub(r"\.\d*$", "", name.split("/")[-1][:60])
+
+
+def previous_report(baseline):
+    """The round-of-record to diff against: an explicit --baseline path,
+    or the newest ROOFLINE_r*.json in the repo root."""
+    if baseline == "none":
+        return None, None
+    if baseline != "auto":
+        with open(baseline) as f:
+            return json.load(f), baseline
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(root, "ROOFLINE_r*.json")))
+    if not paths:
+        return None, None
+    with open(paths[-1]) as f:
+        return json.load(f), paths[-1]
+
+
+def attribute_deltas(report, by_name, iters, prev, threshold_us=20.0):
+    """Per-kernel-class attribution vs the previous round (the ISSUE-7
+    satellite: every future perf PR gets automatic attribution).  Diffs
+    ``class_shares`` per class and us/step per kernel FAMILY (families
+    present in either round; the previous round contributes its recorded
+    top list), and splits families into wins (freed us/step) and
+    regressions."""
+    share_delta = {}
+    classes = set(report["class_shares"]) | set(prev.get("class_shares",
+                                                         {}))
+    for c in sorted(classes):
+        share_delta[c] = round(report["class_shares"].get(c, 0.0)
+                               - prev.get("class_shares", {}).get(c, 0.0),
+                               3)
+    cur_fam, prev_fam = {}, {}
+    for name, dur in by_name.items():
+        f = kernel_family(name)
+        cur_fam[f] = cur_fam.get(f, 0.0) + dur / iters
+    for name, us in prev.get("top_kernels_us_per_step", {}).items():
+        f = kernel_family(name)
+        prev_fam[f] = prev_fam.get(f, 0.0) + float(us)
+    fam_delta = {}
+    for f in set(cur_fam) | set(prev_fam):
+        fam_delta[f] = round(cur_fam.get(f, 0.0) - prev_fam.get(f, 0.0), 1)
+    wins = {f: d for f, d in fam_delta.items() if d <= -threshold_us}
+    regress = {f: d for f, d in fam_delta.items() if d >= threshold_us}
+    return {
+        "device_step_ms_delta": round(
+            report["device_step_ms"] - prev.get("device_step_ms", 0.0), 3),
+        "device_mfu_delta": round(
+            report["device_mfu"] - prev.get("device_mfu", 0.0), 3),
+        "class_share_delta": share_delta,
+        "kernel_family_us_delta": dict(
+            sorted(fam_delta.items(), key=lambda kv: kv[1])),
+        "wins_us_per_step": dict(sorted(wins.items(),
+                                        key=lambda kv: kv[1])),
+        "regressions_us_per_step": dict(sorted(regress.items(),
+                                               key=lambda kv: -kv[1])),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--baseline", default="auto",
+                    help="previous ROOFLINE_*.json to attribute deltas "
+                         "against: a path, 'auto' (newest in the repo "
+                         "root, default), or 'none'")
     args = ap.parse_args()
     import jax
 
@@ -168,7 +242,7 @@ def main():
         if mxu_t else 0.0
     measured_mfu = flops / (step_us * 1e-6) / peak
     top = sorted(by_name.items(), key=lambda kv: -kv[1])[:12]
-    print(json.dumps({
+    report = {
         "metric": "train_step_roofline",
         "device_step_ms": round(step_us / 1e3, 3),
         "mxu_share": round(mxu_t / total, 3),
@@ -181,7 +255,13 @@ def main():
             measured_mfu / max(mxu_t / total, 1e-9), 3),
         "top_kernels_us_per_step": {
             n[:60]: round(d / args.iters, 1) for n, d in top},
-    }, indent=1))
+    }
+    prev, prev_path = previous_report(args.baseline)
+    if prev is not None:
+        report["vs_previous"] = dict(
+            {"baseline": os.path.basename(prev_path)},
+            **attribute_deltas(report, by_name, args.iters, prev))
+    print(json.dumps(report, indent=1))
 
 
 if __name__ == "__main__":
