@@ -29,6 +29,21 @@ import time
 
 import numpy as np
 
+
+def _load_traceview():
+    """Import tools/traceview.py by path (the smokes assert on its
+    summaries and exit codes without needing it on sys.path)."""
+    import importlib.util
+    import os
+    tv_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_bench_traceview",
+                                                  tv_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
 BASELINE_TRAIN_IMG_S = 181.53  # ResNet-50 training, batch 32, P100 (BASELINE.md)
 BASELINE_INFER_IMG_S = 713.17  # ResNet-50 inference, batch 32, P100
 BATCH = 32
@@ -585,12 +600,7 @@ def _smoke_observability(mx, ctx, rng, mlp):
     else:
         os.environ["MXNET_TPU_TELEMETRY"] = prev_env
 
-    import importlib.util
-    tv_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "tools", "traceview.py")
-    spec = importlib.util.spec_from_file_location("_traceview", tv_path)
-    traceview = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(traceview)
+    traceview = _load_traceview()
     breakdown = traceview.step_breakdown(
         traceview.load_trace(trace_path).get("traceEvents", []))
     print(json.dumps({
@@ -870,12 +880,7 @@ def health_smoke():
         os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
         os.environ["MXNET_TPU_HEALTH"] = "0"
 
-    import importlib.util
-    tv_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "tools", "traceview.py")
-    spec = importlib.util.spec_from_file_location("_traceview_h", tv_path)
-    traceview = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(traceview)
+    traceview = _load_traceview()
     rc = traceview.main(["--flight", dump_path])
     assert rc == 1, "traceview --flight must exit 1 on an anomalous dump"
     with open(dump_path) as f:
@@ -892,6 +897,168 @@ def health_smoke():
         "nan_diverged_step": diverged.step,
         "flight_dump": dump_path,
         "traceview_exit": rc,
+    }))
+
+
+def mem_smoke():
+    """Memory & compile observability CI mode (`make bench-smoke`
+    step 6, `bench.py --mem-smoke`): proves the memprof contracts on
+    the same 3-step fit the health smoke uses:
+
+    1. **memprof is invisible to the compiler** — identical 3-step fits
+       with ``MXNET_TPU_MEMPROF=0`` and ``=1`` produce IDENTICAL
+       exec-cache trace counters (zero added retraces/dispatches) and
+       bitwise-identical trained parameters (the AOT dispatch twin runs
+       the same lowering/compile pipeline), while the on-run captures
+       per-program ``memory_analysis`` and the compile-time histogram —
+       and `traceview --memory` renders the written report;
+    2. **the retrace explainer names the component** — a forced
+       same-symbol miss (same graph re-bound at a different batch
+       shape) emits a ``recompile_cause`` naming "shapes";
+    3. **a simulated OOM leaves the augmented black box** — a
+       monkeypatched serving dispatch raising RESOURCE_EXHAUSTED writes
+       a flight dump embedding the memory report (program table +
+       census) that ``tools/traceview.py --flight`` parses with exit 1.
+    """
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, serving
+    from mxnet_tpu.observability import flight_recorder, memprof, telemetry
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    os.environ["MXNET_TPU_HEALTH"] = "0"
+    os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
+
+    ctx = mx.cpu()
+
+    def mlp():
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                    name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def fit_once():
+        """One fresh 3-step fit; returns (trace counts, params)."""
+        executor_cache.clear()
+        executor_cache.reset_stats()
+        memprof.reset()
+        telemetry.reset()
+        flight_recorder.reset()
+        mx.random.seed(0)  # identical init across runs (bitwise oracle)
+        rng = np.random.RandomState(0)
+        x = rng.rand(24, 8).astype(np.float32)
+        y = rng.randint(0, 4, (24,)).astype(np.float32)
+        from mxnet_tpu.io import NDArrayIter
+        mod = mx.mod.Module(mlp(), context=ctx)
+        mod.fit(NDArrayIter(x, y, batch_size=8), num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+        params = {k: v.asnumpy().copy()
+                  for k, v in mod.get_params()[0].items()}
+        return executor_cache.trace_counts(), params
+
+    # 1) memprof on/off: identical counters, bitwise params, and the
+    #    on-run actually captures the attribution
+    os.environ["MXNET_TPU_MEMPROF"] = "0"
+    counts_off, params_off = fit_once()
+    stats_off = executor_cache.stats()
+    assert not any(r.get("memory") for r in stats_off["programs"]), \
+        "memprof off must not capture memory_analysis"
+    os.environ["MXNET_TPU_MEMPROF"] = "1"
+    counts_on, params_on = fit_once()
+    assert counts_on == counts_off, (counts_on, counts_off)
+    assert set(params_on) == set(params_off)
+    assert all(np.array_equal(params_on[k], params_off[k])
+               for k in params_on), "AOT dispatch changed the math"
+    stats_on = executor_cache.stats()
+    with_mem = [r for r in stats_on["programs"] if r.get("memory")]
+    assert with_mem, "memprof on captured no memory_analysis"
+    assert all(r["memory"]["total_bytes"] > 0 for r in with_mem)
+    assert stats_on["compile_ms"]["count"] >= 1, stats_on["compile_ms"]
+    snap = telemetry.snapshot()
+    assert snap.get("exec_cache.compile_ms", {}).get("count"), \
+        "exec_cache.compile_ms histogram did not fill"
+
+    report_path = "/tmp/mxnet_tpu_mem_smoke_report.json"
+    memprof.write_report(report_path)
+
+    # 2) forced same-symbol reshape miss -> recompile_cause "shapes"
+    executor_cache.reset_stats()
+    sym = mlp()
+    for batch in (8, 16):
+        mod = mx.mod.Module(sym, context=ctx)
+        mod.bind(data_shapes=[("data", (batch, 8))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+    causes = executor_cache.stats()["recompile_causes"]
+    assert causes.get("shapes", 0) >= 1, causes
+
+    # 3) simulated OOM through the serving dispatch path
+    flight_recorder.reset()
+    dump_path = "/tmp/mxnet_tpu_mem_smoke_flight.json"
+    os.environ["MXNET_TPU_FLIGHT_PATH"] = dump_path
+    try:
+        if os.path.exists(dump_path):
+            os.remove(dump_path)
+        server = serving.Server(max_batch_size=4)
+        mod = mx.mod.Module(mlp(), context=ctx)
+        mod.bind(data_shapes=[("data", (4, 8))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params()
+        args_d, _ = mod.get_params()
+        served = server.add_model("mlp", mlp(), dict(args_d),
+                                  input_shapes={"data": (8,)})
+        server.warmup()
+
+        class XlaRuntimeError(RuntimeError):
+            """Stand-in for jaxlib's class (is_oom matches the status
+            token, not the import path)."""
+
+        def boom(bucket, inputs):
+            raise XlaRuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating "
+                "9876543210 bytes (simulated)")
+
+        served.run_batch = boom
+        oom_seen = False
+        try:
+            server.submit("mlp", np.ones((2, 8), np.float32), timeout=30)
+        except RuntimeError as exc:
+            oom_seen = "RESOURCE_EXHAUSTED" in str(exc)
+        server.close(drain=True, timeout=30)
+        assert oom_seen, "the simulated OOM did not reach the client"
+        assert os.path.exists(dump_path), "OOM wrote no flight dump"
+        with open(dump_path) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "oom", doc["reason"]
+        assert any(a.get("rule") == "oom" for a in doc["anomalies"])
+        mem = doc.get("memory") or {}
+        assert mem.get("programs") is not None
+        assert (mem.get("census") or {}).get("array_count", 0) > 0
+    finally:
+        os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
+        os.environ["MXNET_TPU_MEMPROF"] = "0"
+
+    traceview = _load_traceview()
+    rc_flight = traceview.main(["--flight", dump_path])
+    assert rc_flight == 1, \
+        "traceview --flight must exit 1 on the OOM dump"
+    rc_mem = traceview.main(["--memory", report_path])
+    assert rc_mem == 0, "traceview --memory failed on the report"
+
+    print(json.dumps({
+        "metric": "bench_mem_smoke",
+        "trace_counters_off": counts_off,
+        "trace_counters_on": counts_on,
+        "params_bitwise_identical": True,
+        "programs_with_memory": len(with_mem),
+        "compile_ms_total": stats_on["compile_ms"]["total_ms"],
+        "recompile_causes": causes,
+        "memory_report": report_path,
+        "oom_flight_dump": dump_path,
+        "traceview_flight_exit": rc_flight,
     }))
 
 
@@ -1254,6 +1421,8 @@ if __name__ == "__main__":
         io_smoke()
     elif "--kernel-smoke" in sys.argv:
         kernel_smoke()
+    elif "--mem-smoke" in sys.argv:
+        mem_smoke()
     elif "--smoke" in sys.argv:
         smoke()
     else:

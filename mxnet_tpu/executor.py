@@ -13,6 +13,7 @@ match between forward and backward.
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +22,7 @@ import numpy as np
 from .base import MXNetError, np_dtype
 from .context import current_context
 from .log import module_logger as _module_logger
+from .observability import memprof as _memprof
 from .ops.registry import get_op
 from .ndarray import NDArray, zeros as nd_zeros
 from .ndarray.ndarray import _Handle
@@ -32,6 +34,19 @@ def _to_device(arr, dev):
     """Move `arr` to `dev` unless already there (single shared impl for
     every cross-device placement site in this file)."""
     return arr if arr.devices() == {dev} else jax.device_put(arr, dev)
+
+
+@contextmanager
+def _oom_guard(what):
+    """OOM black box over one program dispatch: RESOURCE_EXHAUSTED
+    writes the augmented flight dump (per-program memory table, buffer
+    census, allocator peaks) before the error propagates; every other
+    exception passes through untouched (observability/memprof.py)."""
+    try:
+        yield
+    except Exception as exc:
+        _memprof.maybe_record_oom(what, exc)
+        raise
 
 
 
@@ -259,13 +274,14 @@ class Executor:
                 # (ref: kOnlySymbolic profiler mode, profiler.h:94-121)
                 with _profiler.record_span(
                         "executor_forward", category="symbolic",
-                        dev=str(self._ctx)):
+                        dev=str(self._ctx)), _oom_guard("executor_forward"):
                     outs, new_aux = self._fwd_jit(
                         arg_vals, aux_vals, keys, bool(is_train))
                     jax.block_until_ready(outs)
             else:
-                outs, new_aux = self._fwd_jit(
-                    arg_vals, aux_vals, keys, bool(is_train))
+                with _oom_guard("executor_forward"):
+                    outs, new_aux = self._fwd_jit(
+                        arg_vals, aux_vals, keys, bool(is_train))
         if is_train:
             for n, v in zip(self._prog.aux_names, new_aux):
                 buf = self.aux_dict[n]
@@ -325,11 +341,12 @@ class Executor:
         if _profiler.is_running():
             with _profiler.record_span(
                     "executor_fwd_bwd", category="symbolic",
-                    dev=str(self._ctx)):
+                    dev=str(self._ctx)), _oom_guard("executor_fwd_bwd"):
                 res = self._fwd_bwd_jit(arg_vals, aux_vals, keys, heads)
                 jax.block_until_ready(res[0])
         else:
-            res = self._fwd_bwd_jit(arg_vals, aux_vals, keys, heads)
+            with _oom_guard("executor_fwd_bwd"):
+                res = self._fwd_bwd_jit(arg_vals, aux_vals, keys, heads)
         if self._health_on:
             outs, new_aux, grads, health_vec = res
             self._last_health = health_vec  # stays on device until read
@@ -397,7 +414,8 @@ class Executor:
                                         for _ in range(self._n_keys))
         # the NON-donating twin: these aux buffers stay live (the stash,
         # or aux_dict itself) and must survive the dispatch
-        res = self._fwd_bwd_nd_jit(arg_vals, aux_vals, keys, heads)
+        with _oom_guard("executor_backward"):
+            res = self._fwd_bwd_nd_jit(arg_vals, aux_vals, keys, heads)
         if self._health_on:
             self._last_health = res[3]
         self._store_grads(res[2])

@@ -39,7 +39,6 @@ the profiler is running (`profiler.record_counter`).
 """
 from __future__ import annotations
 
-import functools
 import os
 import threading
 from collections import OrderedDict
@@ -49,13 +48,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import profiler as _profiler
+from .log import module_logger as _module_logger
 from .observability import health as _health
+from .observability import memprof as _memprof
 from .observability import telemetry as _telemetry
 
 _lock = threading.Lock()
 _entries = OrderedDict()  # key -> ProgramEntry, LRU order
 _stats = {"hits": 0, "misses": 0, "evictions": 0,
           "traces_fwd": 0, "traces_fwd_bwd": 0, "traces_fused_step": 0}
+_recompile_causes = {}  # cause slug -> count (the retrace explainer)
 
 
 def _enabled():
@@ -78,13 +80,17 @@ class ProgramEntry:
     numerics summary (observability/health.py) and returns a 4-tuple
     `(outputs, new_aux, grads, health_vec)`; the flag is part of the
     cache key, so enabling the sentinel costs exactly one retrace per
-    program and disabling it costs zero."""
+    program and disabling it costs zero.
+
+    `label` names the entry in the memory/compile observability layer
+    (observability/memprof.py): program records, `stats()["programs"]`,
+    and `traceview --memory` all carry it."""
 
     __slots__ = ("prog", "fwd", "fwd_bwd", "fwd_bwd_nd", "donates_aux",
-                 "n_keys", "health", "health_layout")
+                 "n_keys", "health", "health_layout", "label")
 
     def __init__(self, prog, fwd, fwd_bwd, fwd_bwd_nd, donates_aux, n_keys,
-                 health=False, health_layout=None):
+                 health=False, health_layout=None, label=None):
         self.prog = prog
         self.fwd = fwd
         self.fwd_bwd = fwd_bwd
@@ -93,9 +99,10 @@ class ProgramEntry:
         self.n_keys = n_keys
         self.health = health
         self.health_layout = health_layout
+        self.label = label
 
 
-def note_trace(kind):
+def note_trace(kind, label=None):
     """Record one jax trace of kind 'fwd' / 'fwd_bwd' / 'fused_step'.
 
     Called from INSIDE jitted function bodies: the body only executes
@@ -104,11 +111,15 @@ def note_trace(kind):
     A recompile is the single most important instant on a TPU timeline,
     so it also lands as an "i" marker in the trace and increments the
     registry counter (both emits run at trace time, on the host — they
-    cannot themselves change the program being traced).
+    cannot themselves change the program being traced).  ``label``
+    (the entry's label) opens a memprof program record that the
+    compile-duration listener fills in — the per-program compile-time
+    attribution behind ``stats()["programs"]``.
     """
     with _lock:
         _stats["traces_" + kind] += 1
         value = _stats["traces_" + kind]
+    _memprof.note_build(kind, label)
     _telemetry.counter("exec_cache.traces_" + kind,
                        help="real jax retraces of the %s program"
                        % kind).inc()
@@ -142,6 +153,101 @@ def _signature(symbol, arg_dict, aux_dict, grad_names, platform, health):
             bool(health), _pk.kernel_signature())
 
 
+# -- retrace explainer --------------------------------------------------------
+#
+# a cache miss whose symbol already has a cached sibling is the
+# interesting kind: the graph did not change, so SOMETHING in the
+# dispatch signature did, and "1 unexpected retrace" should come with a
+# name.  diff_signatures names the differing component(s); the miss
+# path emits a `recompile_cause:<primary>` instant + counter + log line.
+
+# primary-cause priority: the most common/most actionable first
+_CAUSE_PRIORITY = ("shapes", "dtypes", "arg_names", "aux_names",
+                   "grad_names", "platform", "health", "kernel_flags")
+
+
+def _diff_shape_sig(prefix, old_sig, new_sig, causes, details):
+    """Diff two sorted (name, shape, dtype) tuples; appends causes
+    '<prefix>_names' / 'shapes' / 'dtypes' with one-line details."""
+    old_d = {n: (s, d) for n, s, d in old_sig}
+    new_d = {n: (s, d) for n, s, d in new_sig}
+    if set(old_d) != set(new_d):
+        causes.append(prefix + "_names")
+        added = sorted(set(new_d) - set(old_d))
+        removed = sorted(set(old_d) - set(new_d))
+        details.append("%s added=%s removed=%s"
+                       % (prefix, added or "[]", removed or "[]"))
+    shape_diffs = [(n, old_d[n][0], new_d[n][0])
+                   for n in sorted(set(old_d) & set(new_d))
+                   if old_d[n][0] != new_d[n][0]]
+    dtype_diffs = [(n, old_d[n][1], new_d[n][1])
+                   for n in sorted(set(old_d) & set(new_d))
+                   if old_d[n][1] != new_d[n][1]]
+    if shape_diffs:
+        causes.append("shapes")
+        n, a, b = shape_diffs[0]
+        more = "" if len(shape_diffs) == 1 \
+            else " (+%d more)" % (len(shape_diffs) - 1)
+        details.append("%s %r: %s -> %s%s" % (prefix, n, a, b, more))
+    if dtype_diffs:
+        causes.append("dtypes")
+        n, a, b = dtype_diffs[0]
+        more = "" if len(dtype_diffs) == 1 \
+            else " (+%d more)" % (len(dtype_diffs) - 1)
+        details.append("%s %r: %s -> %s%s" % (prefix, n, a, b, more))
+
+
+def diff_signatures(old_key, new_key):
+    """Explain how two same-symbol cache keys differ.
+
+    Returns ``(primary_cause, all_causes, detail)`` where causes are
+    slugs from ``shapes / dtypes / arg_names / aux_names / grad_names /
+    platform / health / kernel_flags`` (primary = highest-priority one)
+    and ``detail`` is a human one-liner naming the first difference per
+    component.  ``(None, [], "")`` when the keys are identical."""
+    causes, details = [], []
+    _diff_shape_sig("arg", old_key[1], new_key[1], causes, details)
+    _diff_shape_sig("aux", old_key[2], new_key[2], causes, details)
+    if old_key[3] != new_key[3]:
+        causes.append("grad_names")
+        details.append("grad names %s -> %s"
+                       % (list(old_key[3]), list(new_key[3])))
+    if old_key[4] != new_key[4]:
+        causes.append("platform")
+        details.append("platform %s -> %s" % (old_key[4], new_key[4]))
+    if old_key[5] != new_key[5]:
+        causes.append("health")
+        details.append("health sentinel %s -> %s"
+                       % (old_key[5], new_key[5]))
+    if old_key[6] != new_key[6]:
+        causes.append("kernel_flags")
+        details.append("kernel flags %s -> %s"
+                       % (old_key[6], new_key[6]))
+    if not causes:
+        return None, [], ""
+    primary = next(c for c in _CAUSE_PRIORITY if c in causes)
+    return primary, causes, "; ".join(details)
+
+
+def _explain_miss(sibling_key, new_key):
+    """A miss with a cached same-symbol sibling: name what changed.
+    Host-side, on the (rare, compile-bound) miss path only."""
+    primary, causes, detail = diff_signatures(sibling_key, new_key)
+    if primary is None:
+        return
+    with _lock:
+        _recompile_causes[primary] = _recompile_causes.get(primary, 0) + 1
+    _telemetry.counter(
+        "exec_cache.recompile_cause." + primary,
+        help="same-symbol cache misses explained by this component").inc()
+    _profiler.record_instant(
+        "recompile_cause:" + primary, category="exec_cache",
+        args={"causes": list(causes), "detail": detail})
+    _module_logger(__name__).info(
+        "executor cache miss on an already-cached symbol: %s changed "
+        "(%s) — this dispatch will trace a new program", primary, detail)
+
+
 def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     # lazy import: executor.py imports this module at its top level
     from .executor import _Program
@@ -152,14 +258,20 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     arg_names = prog.arg_names
     aux_names = prog.aux_names
     grad_names = list(grad_names)
+    # the memprof label: human symbol name + structural fingerprint
+    # prefix, stable across rebinds of the same graph
+    label = "%s@%s" % (getattr(symbol, "name", None) or "sym",
+                       symbol.structural_hash()[:10])
 
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def _fwd(arg_vals, aux_vals, keys, train):
-        note_trace("fwd")
+    def _fwd_impl(arg_vals, aux_vals, keys, train):
+        note_trace("fwd", label)
         arg_map = dict(zip(arg_names, arg_vals))
         aux_map = dict(zip(aux_names, aux_vals))
         outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
         return outs, [new_aux[n] for n in aux_names]
+
+    _fwd = _memprof.wrap_jit(jax.jit(_fwd_impl, static_argnums=(3,)),
+                             "fwd", label, static_argnums=(3,))
 
     # the sentinel layout is derived from the program's static structure
     # (output count, grad-name order), never from traced values
@@ -167,7 +279,7 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
         if health else None
 
     def _fwd_bwd_impl(arg_vals, aux_vals, keys, head_grads):
-        note_trace("fwd_bwd")
+        note_trace("fwd_bwd", label)
         arg_map = dict(zip(arg_names, arg_vals))
         aux_map = dict(zip(aux_names, aux_vals))
 
@@ -202,12 +314,14 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     # non-donating twin because the buffers it feeds stay live in
     # aux_dict.
     donate = (1,) if platform == "tpu" else ()
-    _fwd_bwd = jax.jit(_fwd_bwd_impl, donate_argnums=donate)
-    _fwd_bwd_nd = jax.jit(_fwd_bwd_impl) if donate else _fwd_bwd
+    _fwd_bwd = _memprof.wrap_jit(
+        jax.jit(_fwd_bwd_impl, donate_argnums=donate), "fwd_bwd", label)
+    _fwd_bwd_nd = _memprof.wrap_jit(jax.jit(_fwd_bwd_impl), "fwd_bwd",
+                                    label) if donate else _fwd_bwd
 
     return ProgramEntry(prog, _fwd, _fwd_bwd, _fwd_bwd_nd, bool(donate),
                         n_keys, health=bool(health),
-                        health_layout=health_layout)
+                        health_layout=health_layout, label=label)
 
 
 def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
@@ -233,6 +347,7 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
                             health=health)
     key = _signature(symbol, arg_dict, aux_dict, grad_names, platform,
                      health)
+    sibling_key = None
     with _lock:
         entry = _entries.get(key)
         if entry is not None:
@@ -241,10 +356,18 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
             hits = _stats["hits"]
         else:
             hits = None
+            # most-recently-used cached signature of the SAME symbol:
+            # the retrace explainer's diff baseline
+            for k in reversed(_entries):
+                if k[0] == key[0]:
+                    sibling_key = k
+                    break
     if entry is not None:
         _telemetry.counter("exec_cache.hits").inc()
         _profiler.record_counter("exec_cache_hits", hits)
         return entry
+    if sibling_key is not None:
+        _explain_miss(sibling_key, key)
     _note("misses")
     entry = _build_entry(symbol, known, grad_names, platform,
                          health=health)
@@ -308,19 +431,31 @@ class watch_traces:
 
 def stats():
     """Counter snapshot: hits/misses/evictions, per-kind trace counts,
-    live entry count, and whether sharing is enabled."""
+    live entry count, whether sharing is enabled, the retrace-explainer
+    tallies (``recompile_causes``), and the memory/compile observability
+    layer's view of the cached programs — ``programs`` (one record per
+    real compile: label, kind, trace/lower/compile ms, and under
+    ``MXNET_TPU_MEMPROF=1`` the compiled ``memory_analysis`` byte
+    breakdown) plus the backend-compile-time summary ``compile_ms``
+    (full distribution in the ``exec_cache.compile_ms`` telemetry
+    histogram)."""
     with _lock:
         out = dict(_stats)
         out["entries"] = len(_entries)
+        out["recompile_causes"] = dict(_recompile_causes)
     out["enabled"] = _enabled()
+    out["programs"] = _memprof.program_records()
+    out["compile_ms"] = _memprof.compile_summary()
     return out
 
 
 def reset_stats():
-    """Zero the counters (entries stay cached)."""
+    """Zero the counters (entries stay cached; the memprof program
+    records are owned by observability.memprof and reset there)."""
     with _lock:
         for k in _stats:
             _stats[k] = 0
+        _recompile_causes.clear()
 
 
 def clear():

@@ -23,6 +23,8 @@ from ..io import DataIter
 from ..log import module_logger as _module_logger
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
+from ..observability import instrument as _instrument
+from ..observability import memprof as _memprof
 from ..observability.instrument import StepTracker
 
 
@@ -269,11 +271,23 @@ class BaseModule:
         except _health.TrainingDivergedError:
             raise  # the raise action already wrote the flight dump
         except Exception as exc:
+            # OOM black box, unconditional: on async backends an
+            # execution-time RESOURCE_EXHAUSTED surfaces at whatever
+            # sync point consumes the step's results (metric update,
+            # grad read) — not at the guarded dispatch — so the fit
+            # loop is the one frame that always sees it
+            oomed = _memprof.maybe_record_oom("fit", exc) is not None \
+                or (_memprof.is_oom(exc)
+                    and _flight.get_recorder().has_dumped("oom"))
             # black-box hook: an unattended run dying mid-fit leaves its
-            # last-N-steps record behind (opt-in with the sentinel)
+            # last-N-steps record behind (opt-in with the sentinel).
+            # Skipped when THIS error already wrote the augmented oom
+            # dump: with a fixed MXNET_TPU_FLIGHT_PATH a second dump
+            # would overwrite the memory post-mortem
             if _health.enabled():
                 _flight.note_exception(exc)
-                _flight.dump_once(reason="fit_exception")
+                if not oomed:
+                    _flight.dump_once(reason="fit_exception")
             raise
 
     def _run_epoch(self, epoch, train_data, eval_metric,
@@ -334,10 +348,14 @@ class BaseModule:
             timings = tracker.step_end(nbatch)
             if pending_health is not None:
                 # record first, judge second: a raising rule's flight
-                # dump must already contain the offending step
+                # dump must already contain the offending step — and
+                # carry the latest device-memory sample so the dump
+                # shows the memory trend leading into an anomaly
                 step, summary = pending_health
-                _flight.record_step(step, epoch=epoch, batch=nbatch,
-                                    health=summary, timings=timings)
+                _flight.record_step(
+                    step, epoch=epoch, batch=nbatch, health=summary,
+                    timings=timings,
+                    mem=_instrument.last_memory_sample())
                 health_mon.observe(step, summary)
             batch = upcoming
             nbatch += 1

@@ -38,6 +38,7 @@ from .. import executor_cache as _exec_cache
 from .. import random as _random
 from ..ndarray import NDArray
 from ..observability import health as _health
+from ..observability import memprof as _memprof
 from ..optimizer import _is_low_precision
 
 
@@ -186,6 +187,11 @@ class FusedTrainStep:
             else None
         self.last_health = None
 
+        # memprof label: the fused step is THE training program — its
+        # memory_analysis row is the one an OOM post-mortem reads first
+        memprof_label = "fused@%s" % exe._symbol.structural_hash()[:10]
+        self._memprof_label = memprof_label
+
         prog_ref = prog
         param_names = self.param_names
         other_names = self.other_names
@@ -209,7 +215,7 @@ class FusedTrainStep:
                   extras, opt_key):
             # body runs only when jax (re)traces: counts real recompiles
             # of the fused step alongside the executor-cache counters
-            _exec_cache.note_trace("fused_step")
+            _exec_cache.note_trace("fused_step", memprof_label)
             arg_map = dict(zip(other_names, other_vals))
             aux_map = dict(zip(aux_names, aux_vals))
 
@@ -273,8 +279,9 @@ class FusedTrainStep:
             return outs, new_masters, new_states, new_aux, new_exec
 
         if self.n_dev == 1:
-            self._step = jax.jit(
-                _step, donate_argnums=(0, 2) if donate else ())
+            self._step = _memprof.wrap_jit(
+                jax.jit(_step, donate_argnums=(0, 2) if donate else ()),
+                "fused_step", memprof_label)
             # identity of the arrays we last wrote into exec's dicts; a
             # mismatch means set_params/init_params replaced them and the
             # master state must refresh from the exec value
@@ -318,17 +325,19 @@ class FusedTrainStep:
         if health_on:
             # the packed health vector is a global reduction: replicated
             out_sh = out_sh + (repl,)
-        self._step = jax.jit(
-            _step,
-            in_shardings=(
-                [repl] * n_params,
-                [dp if b else repl for b in self._other_is_batch],
-                state_sh,
-                [repl] * len(aux_names),
-                (repl,) * exe._n_keys,
-                repl, repl, repl, repl),
-            out_shardings=out_sh,
-            donate_argnums=(0, 2) if donate else ())
+        self._step = _memprof.wrap_jit(
+            jax.jit(
+                _step,
+                in_shardings=(
+                    [repl] * n_params,
+                    [dp if b else repl for b in self._other_is_batch],
+                    state_sh,
+                    [repl] * len(aux_names),
+                    (repl,) * exe._n_keys,
+                    repl, repl, repl, repl),
+                out_shardings=out_sh,
+                donate_argnums=(0, 2) if donate else ()),
+            "fused_step", memprof_label)
         self._scattered = {}
 
     def _init_state(self, j):
@@ -405,9 +414,16 @@ class FusedTrainStep:
         aux_vals = list(self._gaux)
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        res = self._step(
-            self._masters, other_vals, self.states, aux_vals, keys, lrs,
-            wds, extras, opt_key)
+        try:
+            res = self._step(
+                self._masters, other_vals, self.states, aux_vals, keys,
+                lrs, wds, extras, opt_key)
+        except Exception as exc:
+            # OOM black box: RESOURCE_EXHAUSTED on the training step
+            # leaves the augmented flight dump behind before it kills
+            # the run (observability/memprof.py; no-op otherwise)
+            _memprof.maybe_record_oom("fused_step", exc)
+            raise
         outs, new_masters, new_states, new_aux, new_exec = res[:5]
         self.last_health = res[5] if self._health_on else None
 
@@ -479,9 +495,13 @@ class FusedTrainStep:
         lrs, wds, extras, opt_key = self._per_step_scalars()
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
-        res = self._step(
-            self._masters, other_vals, self.states, self._gaux, keys, lrs,
-            wds, extras, opt_key)
+        try:
+            res = self._step(
+                self._masters, other_vals, self.states, self._gaux, keys,
+                lrs, wds, extras, opt_key)
+        except Exception as exc:
+            _memprof.maybe_record_oom("fused_step_dp", exc)
+            raise
         outs, new_masters, new_states, new_aux, new_exec = res[:5]
         self.last_health = res[5] if self._health_on else None
 

@@ -23,6 +23,11 @@ Three layers, lowest first:
 - ``health`` — the training health sentinel: the in-program numerics
   summary (``MXNET_TPU_HEALTH=1``) and the host-side ``HealthMonitor``
   anomaly rules (docs/observability.md §health).
+- ``memprof`` — memory & compile observability: per-program compile
+  times (always on, via a jax.monitoring listener), per-program
+  ``memory_analysis`` byte attribution (``MXNET_TPU_MEMPROF=1``), the
+  live-array census, and the OOM black box
+  (docs/observability.md §memory).
 
 Every callsite stays OUTSIDE jitted bodies: instrumentation must never
 change a traced program (the exec-cache trace counters prove it adds
@@ -35,11 +40,12 @@ from . import telemetry
 from . import instrument
 from . import flight_recorder
 from . import health
+from . import memprof
 from .tracing import span, emit_instant
 from .telemetry import counter, gauge, histogram, snapshot
 from .health import HealthMonitor, TrainingDivergedError
 
 __all__ = ["tracing", "telemetry", "instrument", "flight_recorder",
-           "health", "span", "emit_instant", "counter", "gauge",
-           "histogram", "snapshot", "HealthMonitor",
+           "health", "memprof", "span", "emit_instant", "counter",
+           "gauge", "histogram", "snapshot", "HealthMonitor",
            "TrainingDivergedError"]

@@ -141,11 +141,13 @@ class FlightRecorder:
             self._handler = None
 
     def record_step(self, step, epoch=0, batch=None, health=None,
-                    timings=None, extra=None):
+                    timings=None, mem=None, extra=None):
         """One per-step record: the unpacked health summary, the
-        StepTracker component timings (ms), and the exec-cache retrace
+        StepTracker component timings (ms), the exec-cache retrace
         counters at this step (so a dump shows exactly when a recompile
-        landed)."""
+        landed), and the latest sampled device-memory gauges (``mem``:
+        {live_bytes, peak_bytes, t} — the memory trend leading into an
+        anomaly, rendered by ``traceview --flight``)."""
         from .. import executor_cache  # lazy: avoids an import cycle
         entry = {"step": int(step), "epoch": int(epoch), "t": time.time(),
                  "exec_cache": executor_cache.trace_counts()}
@@ -155,6 +157,8 @@ class FlightRecorder:
             entry["health"] = dict(health)
         if timings is not None:
             entry["timings"] = dict(timings)
+        if mem is not None:
+            entry["mem"] = dict(mem)
         if extra is not None:
             entry["extra"] = dict(extra)
         with self._lock:
@@ -193,6 +197,23 @@ class FlightRecorder:
         with self._lock:
             return len(self._steps)
 
+    def last_step(self):
+        """Step number of the newest per-step record (None when no step
+        was recorded) — the OOM black box stamps its anomaly with it."""
+        with self._lock:
+            return self._steps[-1]["step"] if self._steps else None
+
+    def anomaly_count(self, rule=None):
+        """Recorded anomalies, optionally for one rule — repeat-failure
+        hooks use it to stop appending once a rule's story is told
+        (the anomaly list is unbounded by design: the FIRST entry is
+        the diagnosis and must never be evicted)."""
+        with self._lock:
+            if rule is None:
+                return len(self._anomalies)
+            return sum(1 for a in self._anomalies
+                       if a.get("rule") == rule)
+
     def fingerprint(self):
         """Env/config snapshot: relevant env vars, interpreter, backend."""
         env = {k: v for k, v in sorted(os.environ.items())
@@ -221,10 +242,13 @@ class FlightRecorder:
             "mxnet_tpu_flight_%d_%02d_%s.json"
             % (os.getpid(), self._dump_seq, reason))
 
-    def dump(self, path=None, reason="on_demand"):
+    def dump(self, path=None, reason="on_demand", sections=None):
         """Write the black box as one strict-JSON file and return its
-        path.  Never raises into the caller — a failing dump on the way
-        out of a dying run must not mask the original error."""
+        path.  ``sections`` merges extra top-level documents into the
+        dump (the OOM black box attaches its memory report as
+        ``{"memory": ...}``); core keys cannot be overridden.  Never
+        raises into the caller — a failing dump on the way out of a
+        dying run must not mask the original error."""
         # fingerprint/telemetry can be slow (may resolve the jax
         # backend) and may themselves log — build them OUTSIDE the lock
         # so concurrent record_step/emit calls never stall or deadlock
@@ -250,6 +274,9 @@ class FlightRecorder:
                 "logs": list(self._logs),
             }
         doc["telemetry"] = telemetry_snap
+        if sections:
+            for k, v in sections.items():
+                doc.setdefault(str(k), v)
         if path is None:
             path = self._default_path(reason)
         try:
@@ -263,14 +290,20 @@ class FlightRecorder:
         self._dumped_reasons.add(reason)
         return path
 
-    def dump_once(self, reason, path=None):
+    def has_dumped(self, reason):
+        """Has this reason already produced a dump this process?  Lets
+        repeat-failure hooks skip building expensive dump sections that
+        ``dump_once`` would discard anyway."""
+        with self._lock:
+            return reason in self._dumped_reasons
+
+    def dump_once(self, reason, path=None, sections=None):
         """Dump unless this reason already produced one this process —
         the hook form for failure paths that can repeat (every failed
         serving batch must not write a new file)."""
-        with self._lock:
-            if reason in self._dumped_reasons:
-                return None
-        return self.dump(path=path, reason=reason)
+        if self.has_dumped(reason):
+            return None
+        return self.dump(path=path, reason=reason, sections=sections)
 
 
 # -- process-wide singleton ----------------------------------------------------
@@ -301,12 +334,12 @@ def note_exception(exc):
     get_recorder().note_exception(exc)
 
 
-def dump(path=None, reason="on_demand"):
-    return get_recorder().dump(path=path, reason=reason)
+def dump(path=None, reason="on_demand", sections=None):
+    return get_recorder().dump(path=path, reason=reason, sections=sections)
 
 
-def dump_once(reason, path=None):
-    return get_recorder().dump_once(reason, path=path)
+def dump_once(reason, path=None, sections=None):
+    return get_recorder().dump_once(reason, path=path, sections=sections)
 
 
 def reset():

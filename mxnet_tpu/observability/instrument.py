@@ -16,20 +16,53 @@ exec-cache trace counters in ``make bench-smoke`` hold that line).
   consumer waited for the batch (the numerator of the input-starvation
   ratio ``tools/traceview.py`` prints).
 - ``record_kv``: kvstore push/pull bytes + latency.
-- ``sample_device_memory``: the live-bytes gauge, sampled every
-  ``MEM_SAMPLE_INTERVAL`` steps by the tracker (and on demand).
+- ``sample_device_memory``: the live-bytes + peak-bytes gauges, sampled
+  every ``MXNET_TPU_MEM_SAMPLE_STEPS`` steps (default 10) by the
+  tracker (and on demand); the latest sample is kept host-side
+  (``last_memory_sample``) so flight-recorder step records carry the
+  memory trend into post-mortem dumps.
 """
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
 
 import numpy as np
 
 from . import telemetry
 from . import tracing
 
-# device-memory gauge sampling cadence, in training steps
-MEM_SAMPLE_INTERVAL = 10
+# device-memory gauge sampling cadence, in training steps (the
+# MXNET_TPU_MEM_SAMPLE_STEPS default; MEM_SAMPLE_INTERVAL is the
+# historical name, kept as an alias)
+DEFAULT_MEM_SAMPLE_STEPS = 10
+MEM_SAMPLE_INTERVAL = DEFAULT_MEM_SAMPLE_STEPS
+_MEM_STEPS_ENV = "MXNET_TPU_MEM_SAMPLE_STEPS"
+_mem_env_warned = False
+
+
+def mem_sample_steps():
+    """The device-memory sampling cadence in training steps: the
+    ``MXNET_TPU_MEM_SAMPLE_STEPS`` env (clamped to >= 1), default 10.
+    A malformed value warns once and falls back to the default — the
+    same never-take-the-run-down posture as ``MXNET_TPU_FLIGHT_STEPS``.
+    Re-read per ``StepTracker`` (i.e. per epoch), so tests and tools
+    can flip it without a process restart."""
+    global _mem_env_warned
+    raw = os.environ.get(_MEM_STEPS_ENV, "")
+    if not raw:
+        return DEFAULT_MEM_SAMPLE_STEPS
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        if not _mem_env_warned:
+            _mem_env_warned = True
+            logging.getLogger("mxnet_tpu").warning(
+                "ignoring malformed %s=%r (want an integer); using %d",
+                _MEM_STEPS_ENV, raw, DEFAULT_MEM_SAMPLE_STEPS)
+        return DEFAULT_MEM_SAMPLE_STEPS
 
 # tools/traceview.py carries an import-free pinned copy of this tuple —
 # keep the two in sync when adding a component
@@ -94,13 +127,14 @@ class StepTracker:
     durations accumulate.  ``step_end`` emits the enclosing ``step``
     span (complete event spanning first-component-start to
     last-component-end, with per-component millisecond args), feeds the
-    histograms, and samples the device-memory gauge every
-    ``MEM_SAMPLE_INTERVAL`` steps.
+    histograms, and samples the device-memory gauges every
+    ``MXNET_TPU_MEM_SAMPLE_STEPS`` steps (default 10).
     """
 
     def __init__(self, epoch=0, pid="train"):
         self.epoch = epoch
         self.pid = pid
+        self._mem_every = mem_sample_steps()
         self._resolve_handles()
         self._reset_step()
 
@@ -122,6 +156,10 @@ class StepTracker:
             "module.steps", help="training steps observed")
         self._mem_gauge = telemetry.gauge(
             "device.live_bytes", help="live device memory (sampled)")
+        self._peak_gauge = telemetry.gauge(
+            "device.peak_bytes",
+            help="allocator peak bytes in use (sampled; backends with "
+                 "memory_stats only)")
         self._telemetry_on = self._hist_total is not telemetry.NOOP
 
     def _reset_step(self):
@@ -165,21 +203,32 @@ class StepTracker:
             tracing.emit_complete("step", self._step_t0, dur,
                                   category="step", pid=self.pid,
                                   args=args)
-        if nbatch % MEM_SAMPLE_INTERVAL == 0 \
+        if nbatch % self._mem_every == 0 \
                 and (self._telemetry_on or tracing.is_recording()):
             # jax.live_arrays() is O(live arrays) — never pay it when
             # nobody is listening
-            sample_device_memory(self._mem_gauge)
+            sample_device_memory(self._mem_gauge, self._peak_gauge)
         self._reset_step()
         return timings
 
 
-def sample_device_memory(gauge=None):
+# the most recent device-memory sample, host-side: flight-recorder
+# step records carry it so post-mortem dumps show the memory trend
+# leading into an anomaly (traceview --flight renders the sparkline)
+_last_mem_sample = None
+
+
+def sample_device_memory(gauge=None, peak_gauge=None):
     """Total live device bytes: the backend allocator's view when it
     has one (``Device.memory_stats`` on TPU), else the sum over jax's
-    live arrays.  Sets the ``device.live_bytes`` gauge, drops a counter
-    sample onto the trace timeline, and returns the byte count."""
+    live arrays.  Sets the ``device.live_bytes`` gauge — and, where the
+    allocator reports ``peak_bytes_in_use``, the ``device.peak_bytes``
+    gauge — drops a counter sample onto the trace timeline, stashes the
+    sample for ``last_memory_sample``, and returns the live byte
+    count."""
+    global _last_mem_sample
     total = 0
+    peak = None
     try:
         import jax
         stats_seen = False
@@ -188,6 +237,8 @@ def sample_device_memory(gauge=None):
             if stats and "bytes_in_use" in stats:
                 total += int(stats["bytes_in_use"])
                 stats_seen = True
+            if stats and "peak_bytes_in_use" in stats:
+                peak = (peak or 0) + int(stats["peak_bytes_in_use"])
         if not stats_seen:
             total = sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
     except Exception:
@@ -197,7 +248,24 @@ def sample_device_memory(gauge=None):
                                 help="live device memory (sampled)")
     gauge.set(total)
     tracing.emit_counter("device_live_bytes", total, category="memory")
+    if peak is not None:
+        if peak_gauge is None:
+            peak_gauge = telemetry.gauge(
+                "device.peak_bytes",
+                help="allocator peak bytes in use (sampled; backends "
+                     "with memory_stats only)")
+        peak_gauge.set(peak)
+        tracing.emit_counter("device_peak_bytes", peak, category="memory")
+    _last_mem_sample = {"live_bytes": total, "peak_bytes": peak,
+                        "t": time.time()}
     return total
+
+
+def last_memory_sample():
+    """The most recent ``sample_device_memory`` result as
+    ``{live_bytes, peak_bytes, t}`` (None before the first sample).
+    ``peak_bytes`` is None on backends without allocator stats."""
+    return dict(_last_mem_sample) if _last_mem_sample else None
 
 
 # per-batch handles, memoized against the registry epoch + enabled flag

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
+from ..observability import memprof as _memprof
 from ..observability import tracing
 from . import metrics
 from .registry import bucket_for
@@ -189,16 +190,26 @@ class DynamicBatcher:
         contract: requests_total minus rejected_total equals responses,
         so a 4-request batch failure must count 4, not 1)."""
         reason = getattr(exc, "reason", "dispatch_error")
+        # OOM black box (unconditional — a serving process out of HBM
+        # must leave the memory post-mortem behind even without the
+        # health sentinel): one augmented dump per process, before the
+        # clients see their errors
+        _memprof.maybe_record_oom("serving:%s" % model_name, exc)
         if _health.enabled():
             # black-box hook BEFORE the futures resolve: by the time a
             # client sees the error, the dump exists.  dump_once — a
             # persistently failing model must not write a file per
-            # batch, so only the process's FIRST failure pays the write
+            # batch, so only the process's FIRST failure pays the write.
+            # An OOM skips the generic dump: the augmented oom dump
+            # already exists, and with a fixed MXNET_TPU_FLIGHT_PATH a
+            # second dump would overwrite its memory post-mortem
             _flight.note("serving_dispatch_error",
                          {"model": model_name,
                           "error": "%s: %s" % (type(exc).__name__, exc),
                           "requests": len(batch)})
-            _flight.dump_once(reason="serving_exception")
+            if not (_memprof.is_oom(exc)
+                    and _flight.get_recorder().has_dumped("oom")):
+                _flight.dump_once(reason="serving_exception")
         for r in batch:
             if _fail_future(r.future, exc):
                 metrics.record_rejection(reason, model=model_name)

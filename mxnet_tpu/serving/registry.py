@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
 from .. import executor_cache
+from ..observability import memprof as _memprof
 from ..predict import Predictor
 from .errors import ModelNotFound, RequestTooLarge
 
@@ -84,6 +86,9 @@ class ServedModel:
                                ctx=ctx, quantize=quantize,
                                calibration=calibration)
         self.output_names = self._base.output_names
+        # filled by warmup() under MXNET_TPU_MEMPROF=1: per-bucket
+        # program byte footprints from XLA's memory_analysis
+        self.bucket_memory = {}
         self._by_bucket = {self.buckets[0]: self._base}
         self._lock = threading.Lock()
         # serializes run_batch: predictors are forward()+get_output()
@@ -118,14 +123,46 @@ class ServedModel:
         """Pre-trace every bucket's forward program so steady-state
         serving recompiles nothing.  Returns {bucket: traces_added} from
         the executor-cache retrace counters — the verification pass in
-        ``Server.warmup`` asserts a second sweep adds zero."""
+        ``Server.warmup`` asserts a second sweep adds zero.
+
+        Under ``MXNET_TPU_MEMPROF=1`` the programs traced here carry
+        XLA's ``memory_analysis``; the per-bucket byte footprints land
+        in ``self.bucket_memory`` ({bucket: {argument/output/temp/
+        total_bytes}}), which ``Server.warmup`` sums against device
+        capacity.  A bucket whose program was already cached (a second
+        model over the same graph) traces nothing and so attributes
+        nothing — only measured programs are reported."""
         traced = {}
+        # bucket_memory accumulates rather than resets: the verify
+        # sweep (and any later warm re-warmup) traces nothing and must
+        # not erase the footprints the first pass measured
+        #
+        # attribution filter: records are matched by THIS model's bound
+        # graph fingerprint (the entry label suffix — the predictor's
+        # symbol, so the int8 rewrite attributes too), not just by time
+        # window; a concurrent training thread compiling its own
+        # programs mid-warmup must not be charged to the bucket
+        label_suffix = "@" + self._base._symbol.structural_hash()[:10]
         for b in self.buckets:
+            t0 = time.time()
             with executor_cache.watch_traces() as w:
                 zeros = {k: np.zeros((b,) + v, dtype=np.float32)
                          for k, v in self.input_shapes.items()}
                 self.run_batch(b, zeros)
             traced[b] = w.total()
+            mems = [r["memory"] for r in _memprof.program_records()
+                    if r["t"] >= t0 and r.get("memory")
+                    and str(r.get("label", "")).endswith(label_suffix)]
+            if mems:
+                self.bucket_memory[b] = {
+                    "argument_bytes": sum(m.get("argument_bytes", 0)
+                                          for m in mems),
+                    "output_bytes": sum(m.get("output_bytes", 0)
+                                        for m in mems),
+                    "temp_bytes": sum(m.get("temp_bytes", 0)
+                                      for m in mems),
+                    "total_bytes": sum(m.get("total_bytes", 0)
+                                       for m in mems)}
         return traced
 
 

@@ -41,6 +41,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..base import MXNetError
+from ..log import module_logger as _module_logger
+from ..observability import memprof as _memprof
 from ..observability import telemetry
 from . import metrics
 from .admission import AdmissionController, Request
@@ -108,12 +110,25 @@ class Server:
     def start(self):
         self.batcher.start()
 
+    # a summed warmup footprint within this fraction of device capacity
+    # is "thin": one more replica, bucket, or model likely OOMs
+    THIN_MEMORY_MARGIN = 0.10
+
     def warmup(self, verify=True):
         """Pre-trace every bucket of every registered model.  With
         ``verify=True`` (default) a second sweep must add zero executor
         retraces, or MXNetError — a failing verify means some dispatch
         path escapes the program cache and steady-state serving would
-        recompile under load.  Returns the per-model report."""
+        recompile under load.  Returns the per-model report.
+
+        Under ``MXNET_TPU_MEMPROF=1`` the report gains a ``memory``
+        section: per-model per-bucket byte footprints (XLA's
+        ``memory_analysis`` of each bucket program), the summed serving
+        footprint (per-bucket temp+output, plus each model's widest
+        argument block once — bucket predictors share their weights),
+        and — where the backend reports ``bytes_limit`` — the headroom
+        against device capacity, warning when the margin is under
+        ``THIN_MEMORY_MARGIN``."""
         report = {}
         names = self.registry.names()
         # two phases: warm EVERY model, then verify every model — the
@@ -140,7 +155,67 @@ class Server:
                         "— steady-state serving would recompile"
                         % (name, report[name]["traces_verify_pass"],
                            second))
+        memory = self._warmup_memory_report(names)
+        if memory is not None:
+            if "memory" in report:
+                # a model registered under the literal name "memory":
+                # its warmup entry wins the key; the footprint section
+                # is dropped rather than silently replacing it
+                _module_logger(__name__).warning(
+                    'a served model is named "memory": the warmup '
+                    "report's footprint section is omitted (rename the "
+                    "model to get it)")
+            else:
+                report["memory"] = memory
         return report
+
+    def _warmup_memory_report(self, names):
+        """The summed-footprint-vs-capacity section of the warmup
+        report (None when no bucket program was measured — memprof off,
+        or every program already cached)."""
+        per_model = {}
+        footprint = 0
+        for name in names:
+            bm = self.registry.get(name).bucket_memory
+            if not bm:
+                continue
+            per_model[name] = {str(b): dict(v) for b, v in bm.items()}
+            # weights are shared across a model's bucket predictors:
+            # count the widest argument block once, temps/outputs per
+            # bucket (each bucket's program plan is resident)
+            footprint += max(v.get("argument_bytes", 0)
+                             for v in bm.values())
+            footprint += sum(v.get("temp_bytes", 0)
+                             + v.get("output_bytes", 0)
+                             for v in bm.values())
+        if not per_model:
+            return None
+        limits = [d["bytes_limit"] for d in _memprof.device_memory()
+                  if d.get("bytes_limit")]
+        memory = {"per_model": per_model,
+                  "footprint_bytes": int(footprint),
+                  "device_limit_bytes": int(limits[0]) if limits else None,
+                  "headroom_frac": None}
+        telemetry.gauge(
+            "serving.warmup_footprint_bytes",
+            help="summed per-bucket program footprint measured at "
+                 "warmup").set(footprint)
+        if limits:
+            headroom = (limits[0] - footprint) / float(limits[0])
+            memory["headroom_frac"] = round(headroom, 4)
+            if headroom < self.THIN_MEMORY_MARGIN:
+                _module_logger(__name__).warning(
+                    "serving warmup footprint %d bytes leaves only "
+                    "%.1f%% of device capacity (%d bytes) — thin margin "
+                    "(< %.0f%%): one more bucket, model, or replica "
+                    "likely RESOURCE_EXHAUSTs",
+                    footprint, headroom * 100.0, limits[0],
+                    self.THIN_MEMORY_MARGIN * 100.0)
+                telemetry.counter(
+                    "serving.warmup_thin_memory_margin",
+                    help="warmups whose footprint left under the thin-"
+                         "margin threshold of device capacity").inc()
+        return memory
 
     def close(self, drain=True, timeout=None):
         """Graceful shutdown: stop the HTTP listener, refuse new

@@ -1,0 +1,583 @@
+"""Memory & compile observability: per-program HBM attribution, the
+retrace explainer, and the OOM black box (observability/memprof.py,
+executor_cache diff_signatures, docs/observability.md §memory)."""
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache
+from mxnet_tpu.observability import (flight_recorder, instrument, memprof,
+                                     telemetry, tracing)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """Memprof off unless the test opts in; fresh registries/records."""
+    monkeypatch.delenv("MXNET_TPU_MEMPROF", raising=False)
+    monkeypatch.delenv("MXNET_TPU_MEM_SAMPLE_STEPS", raising=False)
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_PATH", raising=False)
+    monkeypatch.delenv("MXNET_TPU_HEALTH", raising=False)
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+    flight_recorder.reset()
+    memprof.reset()
+    executor_cache.reset_stats()
+    yield
+    telemetry.reset()
+    tracing.set_recording(False)
+    tracing.clear_events()
+    flight_recorder.reset()
+    memprof.reset()
+    executor_cache.reset_stats()
+
+
+def _mlp(prefix="mp"):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name=prefix + "_fc1")
+    net = mx.sym.Activation(net, act_type="relu", name=prefix + "_relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name=prefix + "_fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit_once(seed=0, prefix="mp"):
+    """One fresh 2-batch fit over a cleared cache; returns (counts,
+    params)."""
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    memprof.reset()
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    x = rng.rand(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.float32)
+    mod = mx.mod.Module(_mlp(prefix), context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(x, y, batch_size=8), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    params = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    return executor_cache.trace_counts(), params
+
+
+def _bind_module(sym, batch, dim=8, ctx=None):
+    mod = mx.mod.Module(sym, context=ctx or mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, dim))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    return mod
+
+
+def _load_traceview():
+    tv_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_tv_memprof", tv_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# -- per-program capture -----------------------------------------------------
+
+def test_memory_analysis_captured_on_cpu(monkeypatch):
+    """MXNET_TPU_MEMPROF=1: the fit's programs carry memory_analysis
+    byte breakdowns even on the CPU backend, and stats() surfaces
+    them."""
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    _fit_once(prefix="cap")
+    stats = executor_cache.stats()
+    with_mem = [r for r in stats["programs"] if r.get("memory")]
+    assert with_mem, stats["programs"]
+    rec = with_mem[0]
+    assert rec["kind"] == "fused_step"
+    assert rec["memory"]["argument_bytes"] > 0
+    assert rec["memory"]["output_bytes"] > 0
+    assert rec["memory"]["total_bytes"] >= (
+        rec["memory"]["argument_bytes"] + rec["memory"]["output_bytes"])
+
+
+def test_memprof_off_captures_no_memory():
+    _fit_once(prefix="off")
+    stats = executor_cache.stats()
+    assert stats["programs"], "trace records should exist regardless"
+    assert not any(r.get("memory") for r in stats["programs"])
+
+
+def test_trace_counters_identical_on_off(monkeypatch):
+    """The acceptance contract: memprof on/off is invisible to the
+    compiler — identical trace counters AND bitwise-identical trained
+    parameters."""
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "0")
+    counts_off, params_off = _fit_once(prefix="par")
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    counts_on, params_on = _fit_once(prefix="par")
+    assert counts_on == counts_off
+    assert set(params_on) == set(params_off)
+    for k in params_on:
+        assert np.array_equal(params_on[k], params_off[k]), k
+
+
+def test_compile_time_histogram_always_on():
+    """The exec_cache.compile_ms histogram fills from the
+    jax.monitoring listener with memprof OFF — compile-time
+    observability costs nothing on the dispatch path."""
+    _fit_once(prefix="hist")
+    snap = telemetry.snapshot()
+    hist = snap.get("exec_cache.compile_ms")
+    assert hist and hist["count"] >= 1, sorted(snap)
+    summary = executor_cache.stats()["compile_ms"]
+    assert summary["count"] >= 1
+    assert summary["total_ms"] > 0
+    # records carry the phase breakdown the listener filled in
+    recs = [r for r in memprof.program_records() if r["compile_ms"] > 0]
+    assert recs and recs[0]["trace_ms"] >= 0
+
+
+def test_entry_forward_program_capture(monkeypatch):
+    """A gradient-free bind + forward captures the entry's fwd program
+    (labelled with the symbol fingerprint) under memprof."""
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    executor_cache.clear()
+    memprof.reset()
+    sym = _mlp("fwd")
+    mod = _bind_module(sym, 4)
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    [o.asnumpy() for o in mod.get_outputs()]
+    recs = [r for r in memprof.program_records()
+            if r["kind"] == "fwd" and r.get("memory")]
+    assert recs, memprof.program_records()
+    assert "@" in recs[0]["label"]
+
+
+# -- retrace explainer -------------------------------------------------------
+
+def _sig(arg_shapes, arg_dtypes=None, aux_shapes=(), grad=("w",),
+         platform="cpu", health=False, kernel=("auto",)):
+    """Hand-built cache key in executor_cache._signature's shape."""
+    dtypes = arg_dtypes or {}
+    arg_sig = tuple(sorted(
+        (n, tuple(s), dtypes.get(n, "float32")) for n, s in arg_shapes))
+    aux_sig = tuple(sorted((n, tuple(s), "float32") for n, s in aux_shapes))
+    return ("fp0", arg_sig, aux_sig, tuple(grad), platform, bool(health),
+            tuple(kernel))
+
+
+def test_diff_signatures_shapes():
+    old = _sig([("data", (8, 4)), ("w", (4, 2))])
+    new = _sig([("data", (16, 4)), ("w", (4, 2))])
+    primary, causes, detail = executor_cache.diff_signatures(old, new)
+    assert primary == "shapes" and causes == ["shapes"]
+    assert "'data'" in detail and "(8, 4)" in detail and "(16, 4)" in detail
+
+
+def test_diff_signatures_dtypes():
+    old = _sig([("data", (8, 4))])
+    new = _sig([("data", (8, 4))], arg_dtypes={"data": "bfloat16"})
+    primary, causes, _ = executor_cache.diff_signatures(old, new)
+    assert primary == "dtypes" and causes == ["dtypes"]
+
+
+def test_diff_signatures_arg_and_aux_names():
+    old = _sig([("data", (8, 4))], aux_shapes=[("bn_mean", (4,))])
+    new = _sig([("data2", (8, 4))], aux_shapes=[("bn_var", (4,))])
+    primary, causes, detail = executor_cache.diff_signatures(old, new)
+    assert primary == "arg_names"
+    assert set(causes) == {"arg_names", "aux_names"}
+    assert "data2" in detail
+
+
+def test_diff_signatures_grad_platform_health_kernel():
+    base = _sig([("data", (8, 4))])
+    for key, cause in (
+            (_sig([("data", (8, 4))], grad=("w", "b")), "grad_names"),
+            (_sig([("data", (8, 4))], platform="tpu"), "platform"),
+            (_sig([("data", (8, 4))], health=True), "health"),
+            (_sig([("data", (8, 4))], kernel=("force",)), "kernel_flags")):
+        primary, causes, _ = executor_cache.diff_signatures(base, key)
+        assert primary == cause and causes == [cause], (cause, causes)
+    assert executor_cache.diff_signatures(base, base) == (None, [], "")
+
+
+def test_diff_signatures_shape_beats_secondary_causes():
+    """Primary-cause priority: a reshape that also flips the platform
+    still leads with 'shapes'."""
+    old = _sig([("data", (8, 4))])
+    new = _sig([("data", (16, 4))], platform="tpu")
+    primary, causes, _ = executor_cache.diff_signatures(old, new)
+    assert primary == "shapes" and set(causes) == {"shapes", "platform"}
+
+
+def test_recompile_cause_emitted_on_real_miss(caplog):
+    """A same-symbol rebind at a new batch shape tallies a 'shapes'
+    cause, increments the telemetry counter, and logs the diagnosis."""
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    sym = _mlp("why")
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu"):
+        _bind_module(sym, 8)
+        _bind_module(sym, 16)
+    causes = executor_cache.stats()["recompile_causes"]
+    assert causes.get("shapes", 0) >= 1, causes
+    snap = telemetry.snapshot()
+    assert snap.get("exec_cache.recompile_cause.shapes", {}).get(
+        "value", 0) >= 1
+    assert any("shapes changed" in r.message for r in caplog.records)
+
+
+def test_recompile_cause_instant_in_trace():
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    tracing.set_recording(True)
+    sym = _mlp("inst")
+    _bind_module(sym, 8)
+    _bind_module(sym, 16)
+    tracing.set_recording(False)
+    names = [e["name"] for e in tracing.snapshot_events()
+             if e.get("ph") == "i"]
+    assert "recompile_cause:shapes" in names, names
+
+
+def test_fresh_symbol_miss_has_no_cause():
+    """First-ever bind of a graph is a plain miss — nothing to
+    explain, no cause tallied."""
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    _bind_module(_mlp("fresh"), 8)
+    assert executor_cache.stats()["recompile_causes"] == {}
+
+
+# -- census + device memory --------------------------------------------------
+
+def test_live_array_census_groups_by_shape_dtype():
+    import jax.numpy as jnp
+    pins = [jnp.zeros((17, 23), jnp.float32) for _ in range(3)]
+    census = memprof.live_array_census(limit=10000)
+    group = [g for g in census["groups"]
+             if tuple(g["shape"]) == (17, 23) and g["dtype"] == "<f4"]
+    assert group and group[0]["count"] >= 3
+    assert group[0]["total_bytes"] >= 3 * 17 * 23 * 4
+    assert census["total_bytes"] >= group[0]["total_bytes"]
+    del pins
+
+
+def test_device_memory_rows_per_device():
+    rows = memprof.device_memory()
+    assert rows, "one row per local device"
+    assert "device" in rows[0] and "bytes_limit" in rows[0]
+
+
+# -- the OOM black box -------------------------------------------------------
+
+class _FakeOOM(RuntimeError):
+    """Stand-in for jaxlib's XlaRuntimeError: is_oom matches the
+    RESOURCE_EXHAUSTED status token, not the class."""
+
+
+def test_is_oom_matches_status_token():
+    assert memprof.is_oom(_FakeOOM("RESOURCE_EXHAUSTED: Out of memory"))
+    assert not memprof.is_oom(_FakeOOM("INVALID_ARGUMENT: bad shape"))
+    assert not memprof.is_oom("RESOURCE_EXHAUSTED")  # not an exception
+
+
+def test_oom_dump_contents(tmp_path, monkeypatch):
+    """A RESOURCE_EXHAUSTED through the serving dispatch path writes
+    ONE augmented dump: oom anomaly, program table, census — and
+    traceview --flight exits 1 on it."""
+    from mxnet_tpu import serving
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    dump_path = str(tmp_path / "oom_flight.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+    executor_cache.clear()
+    memprof.reset()
+    sym = _mlp("oom")
+    mod = _bind_module(sym, 4)
+    args, _ = mod.get_params()
+    server = serving.Server(max_batch_size=4)
+    try:
+        served = server.add_model("m", sym, dict(args),
+                                  input_shapes={"data": (8,)})
+        server.warmup()
+
+        def boom(bucket, inputs):
+            raise _FakeOOM("RESOURCE_EXHAUSTED: Out of memory allocating "
+                           "1234 bytes (simulated)")
+
+        served.run_batch = boom
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            server.submit("m", np.ones((2, 8), np.float32), timeout=30)
+    finally:
+        server.close(drain=True, timeout=30)
+    assert os.path.exists(dump_path)
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "oom"
+    oom = [a for a in doc["anomalies"] if a.get("rule") == "oom"]
+    assert oom and oom[0]["context"] == "serving:m"
+    mem = doc["memory"]
+    assert mem["census"]["array_count"] > 0
+    assert any(r.get("memory") for r in mem["programs"])
+    traceview = _load_traceview()
+    assert traceview.main(["--flight", dump_path]) == 1
+    assert traceview.main(["--memory", dump_path]) == 0
+
+
+def test_oom_dump_once_per_process(tmp_path, monkeypatch):
+    """Repeated distinct OOMs write one dump (dump_once) but each is
+    counted and recorded as an anomaly; the SAME exception seen by two
+    handlers (dispatch guard then fit loop) counts once."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH",
+                       str(tmp_path / "oom_once.json"))
+    exc = _FakeOOM("RESOURCE_EXHAUSTED: simulated")
+    first = memprof.maybe_record_oom("dispatch", exc)
+    assert first and os.path.exists(first)
+    # same exception object propagating to an outer handler: no-op
+    assert memprof.maybe_record_oom("fit", exc) is None
+    # a NEW OOM event: counted + noted, but no second dump
+    assert memprof.maybe_record_oom(
+        "dispatch", _FakeOOM("RESOURCE_EXHAUSTED: again")) is None
+    recorder = flight_recorder.get_recorder()
+    assert recorder.anomaly_count("oom") == 2
+    assert telemetry.snapshot()["memprof.oom_total"]["value"] == 2
+
+
+def test_oom_dump_not_overwritten_by_generic_dump(tmp_path, monkeypatch):
+    """With a fixed MXNET_TPU_FLIGHT_PATH and the health sentinel on,
+    the generic serving_exception dump must not overwrite the
+    augmented oom dump at the same path."""
+    from mxnet_tpu import serving
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    dump_path = str(tmp_path / "oom_keep.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+    executor_cache.clear()
+    sym = _mlp("keep")
+    mod = _bind_module(sym, 4)
+    args, _ = mod.get_params()
+    server = serving.Server(max_batch_size=2)
+    try:
+        served = server.add_model("m", sym, dict(args),
+                                  input_shapes={"data": (8,)})
+        server.warmup()
+
+        def boom(bucket, inputs):
+            raise _FakeOOM("RESOURCE_EXHAUSTED: simulated")
+
+        served.run_batch = boom
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            server.submit("m", np.ones((1, 8), np.float32), timeout=30)
+    finally:
+        server.close(drain=True, timeout=30)
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "oom", doc["reason"]
+    assert "memory" in doc
+
+
+def test_fit_loop_catches_sync_point_oom(tmp_path, monkeypatch):
+    """An OOM surfacing at a sync point (async backends raise at the
+    consuming read, not the guarded dispatch) is still routed through
+    the black box by the fit loop's handler."""
+    dump_path = str(tmp_path / "fit_oom.json")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH", dump_path)
+    executor_cache.clear()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 8).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    mod = mx.mod.Module(_mlp("sync"), context=mx.cpu())
+
+    def boom(*args, **kwargs):
+        raise _FakeOOM("RESOURCE_EXHAUSTED: surfaced at metric sync")
+
+    monkeypatch.setattr(mod, "update_metric", boom)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        mod.fit(mx.io.NDArrayIter(x, y, batch_size=8), num_epoch=1,
+                optimizer_params={"learning_rate": 0.1})
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "oom"
+    assert any(a.get("context") == "fit" for a in doc["anomalies"])
+
+
+def test_maybe_record_oom_ignores_other_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH",
+                       str(tmp_path / "not_oom.json"))
+    assert memprof.maybe_record_oom("x", ValueError("nope")) is None
+    assert not os.path.exists(str(tmp_path / "not_oom.json"))
+
+
+def test_executor_dispatch_oom_guard(monkeypatch, tmp_path):
+    """The executor dispatch path routes a RESOURCE_EXHAUSTED through
+    the black box before re-raising."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_PATH",
+                       str(tmp_path / "exec_oom.json"))
+    executor_cache.clear()
+    mod = _bind_module(_mlp("eoom"), 4)
+    exe = mod._exec_group.execs[0]
+
+    def boom(*args, **kwargs):
+        raise _FakeOOM("RESOURCE_EXHAUSTED: simulated executor OOM")
+
+    monkeypatch.setattr(exe, "_fwd_jit", boom)
+    x = mx.nd.array(np.zeros((4, 8), np.float32))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    assert os.path.exists(str(tmp_path / "exec_oom.json"))
+
+
+# -- satellite: memory sampling ----------------------------------------------
+
+def test_mem_sample_steps_env(monkeypatch, caplog):
+    assert instrument.mem_sample_steps() == 10
+    monkeypatch.setenv("MXNET_TPU_MEM_SAMPLE_STEPS", "3")
+    assert instrument.mem_sample_steps() == 3
+    monkeypatch.setenv("MXNET_TPU_MEM_SAMPLE_STEPS", "0")
+    assert instrument.mem_sample_steps() == 1  # clamped
+    monkeypatch.setattr(instrument, "_mem_env_warned", False)
+    monkeypatch.setenv("MXNET_TPU_MEM_SAMPLE_STEPS", "bogus")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        assert instrument.mem_sample_steps() == 10
+    assert any("MXNET_TPU_MEM_SAMPLE_STEPS" in r.message
+               for r in caplog.records)
+
+
+def test_sample_device_memory_peak_gauge(monkeypatch):
+    """Where the allocator reports peak_bytes_in_use, the second gauge
+    fills; the sample is stashed for the flight recorder."""
+    class _Dev:
+        def memory_stats(self):
+            return {"bytes_in_use": 1000, "peak_bytes_in_use": 2500}
+
+    import jax
+    monkeypatch.setattr(jax, "local_devices", lambda: [_Dev(), _Dev()])
+    total = instrument.sample_device_memory()
+    assert total == 2000
+    snap = telemetry.snapshot()
+    assert snap["device.live_bytes"]["value"] == 2000
+    assert snap["device.peak_bytes"]["value"] == 5000
+    sample = instrument.last_memory_sample()
+    assert sample["live_bytes"] == 2000 and sample["peak_bytes"] == 5000
+
+
+def test_exporter_roundtrip_of_new_series(monkeypatch):
+    """device.peak_bytes + exec_cache.compile_ms survive the JSON-lines
+    export/parse round trip losslessly."""
+    telemetry.gauge("device.peak_bytes").set(1 << 30)
+    telemetry.histogram("exec_cache.compile_ms").observe(42.5)
+    restored = telemetry.parse_json_lines(telemetry.to_json_lines())
+    assert restored["device.peak_bytes"]["value"] == float(1 << 30)
+    hist = restored["exec_cache.compile_ms"]
+    assert hist["count"] == 1 and hist["sum"] == 42.5
+    prom = telemetry.to_prometheus()
+    assert "mxnet_tpu_device_peak_bytes" in prom
+    assert "mxnet_tpu_exec_cache_compile_ms_count 1" in prom
+
+
+def test_flight_step_records_carry_memory(monkeypatch, tmp_path):
+    """Flight step records include the sampled gauges; traceview
+    --flight renders the memory sparkline row."""
+    class _Dev:
+        def memory_stats(self):
+            return {"bytes_in_use": 4096, "peak_bytes_in_use": 8192}
+
+    import jax
+    monkeypatch.setattr(jax, "local_devices", lambda: [_Dev()])
+    instrument.sample_device_memory()
+    recorder = flight_recorder.get_recorder()
+    for step in range(4):
+        recorder.record_step(step, health={"out_mean": 0.5,
+                                           "grad_norm": 1.0,
+                                           "update_ratio": 0.01,
+                                           "all_finite": 1.0},
+                             mem=instrument.last_memory_sample())
+    assert recorder.last_step() == 3
+    path = recorder.dump(path=str(tmp_path / "mem_flight.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["steps"][0]["mem"]["live_bytes"] == 4096
+    traceview = _load_traceview()
+    stats = traceview.flight_stats(doc)
+    assert stats["series"][0]["mem_bytes"] == 4096.0
+    text = traceview.summarize_flight(doc)
+    assert "mem:" in text and "4.00 KiB" in text
+
+
+# -- satellite: serving warmup footprint -------------------------------------
+
+def test_warmup_memory_footprint_report(monkeypatch):
+    from mxnet_tpu import serving
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    executor_cache.clear()
+    memprof.reset()
+    sym = _mlp("wm")
+    mod = _bind_module(sym, 4)
+    args, _ = mod.get_params()
+    server = serving.Server(max_batch_size=4)
+    try:
+        server.add_model("m", sym, dict(args), input_shapes={"data": (8,)})
+        report = server.warmup()
+        mem = report["memory"]
+        per_bucket = mem["per_model"]["m"]
+        assert set(per_bucket) == {"1", "2", "4"}
+        assert all(v["total_bytes"] > 0 for v in per_bucket.values())
+        assert mem["footprint_bytes"] > 0
+        # CPU backend reports no limit: no headroom, no warning
+        assert mem["device_limit_bytes"] is None
+        assert mem["headroom_frac"] is None
+    finally:
+        server.close(drain=True, timeout=30)
+
+
+def test_warmup_thin_margin_warns(monkeypatch, caplog):
+    from mxnet_tpu import serving
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    executor_cache.clear()
+    memprof.reset()
+    sym = _mlp("tm")
+    mod = _bind_module(sym, 4)
+    args, _ = mod.get_params()
+    server = serving.Server(max_batch_size=4)
+    try:
+        server.add_model("m", sym, dict(args), input_shapes={"data": (8,)})
+        server.warmup()
+        footprint = server.registry.get("m").bucket_memory
+        total = (max(v["argument_bytes"] for v in footprint.values())
+                 + sum(v["temp_bytes"] + v["output_bytes"]
+                       for v in footprint.values()))
+        # a "device" whose capacity leaves 5% headroom over the measured
+        # footprint must trigger the thin-margin warning
+        limit = int(total / 0.95) + 1
+        monkeypatch.setattr(
+            memprof, "device_memory",
+            lambda: [{"device": "faketpu:0", "bytes_in_use": 0,
+                      "peak_bytes_in_use": 0, "bytes_limit": limit}])
+        with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+            mem = server._warmup_memory_report(["m"])
+        assert mem["headroom_frac"] is not None
+        assert mem["headroom_frac"] < server.THIN_MEMORY_MARGIN
+        assert any("thin margin" in r.message for r in caplog.records)
+        snap = telemetry.snapshot()
+        assert snap["serving.warmup_thin_memory_margin"]["value"] >= 1
+    finally:
+        server.close(drain=True, timeout=30)
+
+
+# -- report + traceview ------------------------------------------------------
+
+def test_write_report_and_traceview_memory(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_MEMPROF", "1")
+    _fit_once(prefix="rep")
+    path = memprof.write_report(str(tmp_path / "mem_report.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["kind"] == "mxnet_tpu_memory"
+    assert doc["memprof_enabled"] is True
+    assert any(r.get("memory") for r in doc["programs"])
+    traceview = _load_traceview()
+    assert traceview.main(["--memory", path]) == 0
+    text = traceview.summarize_memory(doc)
+    assert "per-program table" in text and "fused_step" in text
+    assert "live-array census" in text

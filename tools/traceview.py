@@ -3,6 +3,7 @@
     python tools/traceview.py /tmp/mxnet_tpu_smoke_trace.json [--top N]
     python tools/traceview.py --serving /tmp/trace_or_telemetry.json
     python tools/traceview.py --flight /tmp/flight_dump.json
+    python tools/traceview.py --memory /tmp/memory_report_or_flight.json
 
 Three views over one trace:
 
@@ -27,9 +28,17 @@ quantile reports its bucket's upper bound).
 `--flight` reads a flight-recorder dump
 (`observability/flight_recorder.py`): first-anomaly step, per-rule
 anomaly counts, a grad/loss trend table with sparklines over the
-recorded step window, captured events and log-record count.  Exits 1
+recorded step window (plus a device-memory sparkline when the step
+records carry the sampled gauges), captured events and log-record
+count — and, for OOM dumps, the embedded memory report.  Exits 1
 when the dump contains a fired anomaly, 0 otherwise — CI can gate on
 "did the black box record a divergence" without parsing JSON.
+
+`--memory` renders a memory report (`observability/memprof.py
+write_report`, or a flight dump embedding one): the per-program table
+(label, kind, compile ms, argument/output/temp bytes from XLA's
+memory_analysis), the live-array census grouped by (shape, dtype), and
+per-device allocator stats where the backend reports them.
 
 Understands both the native "X" complete-event encoding and legacy
 "B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
@@ -257,7 +266,8 @@ def _sparkline(values):
 
 def flight_stats(doc):
     """The machine-readable summary `--flight` renders (and tests
-    assert on): first anomaly, per-rule counts, per-step trend series."""
+    assert on): first anomaly, per-rule counts, per-step trend series
+    (including the sampled device-memory gauges when recorded)."""
     steps = doc.get("steps") or []
     anomalies = doc.get("anomalies") or []
     by_rule = {}
@@ -266,12 +276,14 @@ def flight_stats(doc):
     series = []
     for s in steps:
         h = s.get("health") or {}
+        mem = s.get("mem") or {}
         series.append({
             "step": s.get("step"),
             "loss": _fnum(h.get("out_mean")),
             "grad_norm": _fnum(h.get("grad_norm")),
             "update_ratio": _fnum(h.get("update_ratio")),
             "finite": _fnum(h.get("all_finite"), 1.0) >= 1.0,
+            "mem_bytes": _fnum(mem.get("live_bytes")),
         })
     return {
         "reason": doc.get("reason"),
@@ -329,6 +341,14 @@ def summarize_flight(doc, trend_rows=12):
                      % _sparkline([r["grad_norm"] for r in series]))
         lines.append("loss:      %s"
                      % _sparkline([r["loss"] for r in series]))
+        mem_series = [r["mem_bytes"] for r in series]
+        if any(_isfinite(v) for v in mem_series):
+            # the sampled device-memory trend leading into the anomaly
+            lines.append("mem:       %s  (last %s)"
+                         % (_sparkline(mem_series),
+                            _fmt_bytes(next(
+                                (v for v in reversed(mem_series)
+                                 if _isfinite(v)), 0))))
         lines.append("%-8s %12s %12s %12s %7s"
                      % ("Step", "Loss", "GradNorm", "UpdRatio", "Finite"))
         for r in series[-trend_rows:]:
@@ -339,6 +359,94 @@ def summarize_flight(doc, trend_rows=12):
     lines.append("")
     lines.append("events: %d   captured log records: %d"
                  % (stats["events"], stats["logs"]))
+    if doc.get("memory"):
+        # an OOM dump embeds the full memory report — render it inline
+        lines.append("")
+        lines.append(summarize_memory(doc["memory"]))
+    return "\n".join(lines)
+
+
+# -- memory view -------------------------------------------------------------
+
+def _fmt_bytes(n):
+    """Human bytes: 4 significant-ish digits, binary units."""
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return ("%d %s" % (n, unit)) if unit == "B" \
+                else ("%.2f %s" % (n, unit))
+        n /= 1024.0
+    return "?"
+
+
+def summarize_memory(memdoc, top=20):
+    """The text report for one memory report document
+    (observability/memprof.py `report()` shape)."""
+    lines = []
+    lines.append("== memory: per-program table (XLA memory_analysis) ==")
+    programs = memdoc.get("programs") or []
+    with_mem = [p for p in programs if p.get("memory")]
+    if not with_mem:
+        lines.append("(no per-program memory captured — run with "
+                     "MXNET_TPU_MEMPROF=1)")
+    else:
+        lines.append("%-28s %-11s %10s %10s %10s %10s"
+                     % ("Program", "Kind", "Compile", "Args", "Temp",
+                        "Total"))
+        for p in sorted(with_mem,
+                        key=lambda p: -p["memory"].get("total_bytes",
+                                                       0))[:top]:
+            m = p["memory"]
+            lines.append("%-28s %-11s %8.1fms %10s %10s %10s"
+                         % (str(p.get("label", "?"))[:28],
+                            str(p.get("kind", "?"))[:11],
+                            _fnum(p.get("compile_ms"), 0.0),
+                            _fmt_bytes(m.get("argument_bytes", 0)),
+                            _fmt_bytes(m.get("temp_bytes", 0)),
+                            _fmt_bytes(m.get("total_bytes", 0))))
+    compiled = [p for p in programs if _fnum(p.get("compile_ms"), 0.0) > 0]
+    if compiled:
+        total_ms = sum(_fnum(p["compile_ms"], 0.0) for p in compiled)
+        lines.append("programs recorded: %d   backend compiles: %d   "
+                     "compile time: %.1f ms total"
+                     % (len(programs), len(compiled), total_ms))
+    lines.append("")
+    lines.append("== memory: live-array census (by shape/dtype) ==")
+    census = memdoc.get("census") or {}
+    groups = census.get("groups") or []
+    if not groups:
+        lines.append("(no live arrays)")
+    else:
+        lines.append("%-26s %-10s %7s %12s"
+                     % ("Shape", "Dtype", "Count", "Bytes"))
+        for g in groups[:top]:
+            lines.append("%-26s %-10s %7d %12s"
+                         % (str(tuple(g.get("shape") or ()))[:26],
+                            str(g.get("dtype", "?"))[:10],
+                            g.get("count", 0),
+                            _fmt_bytes(g.get("total_bytes", 0))))
+        lines.append("live arrays: %d in %d groups, %s total"
+                     % (census.get("array_count", 0),
+                        census.get("group_count", 0),
+                        _fmt_bytes(census.get("total_bytes", 0))))
+    devices = memdoc.get("device_memory") or []
+    reported = [d for d in devices if d.get("bytes_in_use") is not None
+                or d.get("bytes_limit") is not None]
+    lines.append("")
+    lines.append("== memory: device allocator ==")
+    if not reported:
+        lines.append("(backend reports no memory_stats — census above "
+                     "is the live view)")
+    else:
+        for d in reported:
+            lines.append("%-24s in_use %s   peak %s   limit %s"
+                         % (str(d.get("device", "?"))[:24],
+                            _fmt_bytes(d.get("bytes_in_use")),
+                            _fmt_bytes(d.get("peak_bytes_in_use")),
+                            _fmt_bytes(d.get("bytes_limit"))))
     return "\n".join(lines)
 
 
@@ -560,8 +668,13 @@ def main(argv=None):
                         "counts")
     parser.add_argument("--flight", action="store_true",
                         help="flight-recorder view: first-anomaly step, "
-                        "per-rule counts, grad/loss trend; exits 1 when "
-                        "the dump holds a fired anomaly")
+                        "per-rule counts, grad/loss/memory trend; exits 1 "
+                        "when the dump holds a fired anomaly")
+    parser.add_argument("--memory", action="store_true",
+                        help="memory view: per-program memory_analysis "
+                        "table, live-array census, device allocator "
+                        "stats (a memprof report JSON, or a flight dump "
+                        "embedding one)")
     args = parser.parse_args(argv)
     if args.flight:
         with open(args.trace) as f:
@@ -569,6 +682,18 @@ def main(argv=None):
         print(summarize_flight(doc))
         # CI contract: a dump holding a fired anomaly exits non-zero
         return 1 if (doc.get("anomalies") or []) else 0
+    if args.memory:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        if doc.get("kind") == "mxnet_tpu_flight" or "steps" in doc:
+            memdoc = doc.get("memory")
+            if not memdoc:
+                print("flight dump %s embeds no memory report (only OOM "
+                      "dumps carry one)" % args.trace)
+                return 2
+            doc = memdoc
+        print(summarize_memory(doc))
+        return 0
     if args.serving:
         kind, payload = load_any(args.trace)
         print(summarize_serving(kind, payload))
