@@ -26,13 +26,14 @@ chip:            ## serial accelerator tier (needs the real chip)
 bench:           ## throughput numbers of record (run on an IDLE host)
 	$(PY) bench.py
 
-bench-smoke:     ## exec-cache + observability + serving + health + io-pipeline + pallas-kernel + memprof smoke: dumps /tmp/mxnet_tpu_smoke_{trace,telemetry}.json + flight dumps + a memory report, fails on recompile regressions (incl. telemetry/health/pipeline/memprof on-vs-off, the serving warmup contract, pipeline starvation >=1%, the kernel-flag <=1-retrace/off-path-untouched contract, the recompile_cause explainer, and the OOM black box)
+bench-smoke:     ## exec-cache + observability + serving + health + io-pipeline + pallas-kernel + memprof + comm smoke: dumps /tmp/mxnet_tpu_smoke_{trace,telemetry}.json + flight dumps + a memory report, fails on recompile regressions (incl. telemetry/health/pipeline/memprof on-vs-off, the serving warmup contract, pipeline starvation >=1%, the kernel-flag <=1-retrace/off-path-untouched contract, the recompile_cause explainer, the OOM black box, and the comm contracts: bucketed-overlap parity + >=2 interleaved all-reduces + the 2-bit <=1/8-wire-bytes assert on the 8-device harness)
 	$(PY) bench.py --smoke
 	$(PY) bench.py --serve-smoke
 	$(PY) bench.py --health-smoke
 	$(PY) bench.py --io-smoke
 	$(PY) bench.py --kernel-smoke
 	$(PY) bench.py --mem-smoke
+	$(PY) bench.py --comm-smoke
 
 roofline:        ## kernel-class decomposition of the train step
 	$(PY) tools/roofline_probe.py

@@ -26,6 +26,8 @@ so `tools/launch.py`-style scripts still see rank/size.
 from __future__ import annotations
 
 import os
+import time
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -33,11 +35,28 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..observability.instrument import record_comm_exposed
 from . import KVStore, _key_value
 from .gradient_compression import GradientCompression
 
 _rendezvoused = False
 _barrier_seq = 0  # process-global so barrier names are never reused
+
+# LRU bound for the per-store jitted-collective cache (same discipline
+# as the executor program cache: move-to-end on hit, evict oldest past
+# the cap).  Each entry is one jitted psum/all-gather program family per
+# device topology; topologies are few, but a long-lived process cycling
+# exotic device subsets must not grow without bound.
+_PSUM_CACHE_SIZE_ENV = "MXNET_TPU_PSUM_CACHE_SIZE"
+_DEFAULT_PSUM_CACHE_SIZE = 64
+
+
+def _psum_cache_size():
+    try:
+        return max(1, int(os.environ.get(_PSUM_CACHE_SIZE_ENV,
+                                         _DEFAULT_PSUM_CACHE_SIZE)))
+    except ValueError:
+        return _DEFAULT_PSUM_CACHE_SIZE
 
 
 def _global_state():
@@ -75,7 +94,7 @@ class DistKVStore(KVStore):
         # bytes handed to cross-host collectives by push() — observable
         # evidence for the compression wire saving (tests assert on it)
         self.wire_bytes_pushed = 0
-        self._psum_cache = {}
+        self._psum_cache = OrderedDict()  # LRU, bounded
         self._devs = None
         self._devs_resolved = False
         # launcher env bridge (shared impl; usually already ran at import)
@@ -165,29 +184,51 @@ class DistKVStore(KVStore):
                     % gs.num_processes)
         return self._devs
 
-    def _psum_fn(self, devs):
-        key = tuple(d.id for d in devs)
+    def _cached_fn(self, key, build):
+        """LRU lookup in the jitted-collective cache (bounded; see
+        ``MXNET_TPU_PSUM_CACHE_SIZE``)."""
         cached = self._psum_cache.get(key)
         if cached is None:
+            cached = build()
+            self._psum_cache[key] = cached
+        else:
+            self._psum_cache.move_to_end(key)
+        while len(self._psum_cache) > _psum_cache_size():
+            self._psum_cache.popitem(last=False)
+        return cached
+
+    def _psum_fn(self, devs):
+        def build():
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
             mesh = Mesh(np.array(devs), ("host",))
             fn = jax.jit(lambda x: jnp.sum(x, axis=0),
                          out_shardings=NamedSharding(mesh, P()))
-            cached = (fn, mesh)
-            self._psum_cache[key] = cached
-        return cached
+            return fn, mesh
+        return self._cached_fn(tuple(d.id for d in devs), build)
+
+    def _psum_list_fn(self, devs, n):
+        """ONE jitted program summing a whole pytree of host-stacked
+        arrays — the batched push_pull_list collective (one dispatch for
+        every key instead of one program per key)."""
+        def build():
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            # graftlint: disable=GL003 — np over the static device list
+            mesh = Mesh(np.array(devs), ("host",))
+            repl = NamedSharding(mesh, P())
+            fn = jax.jit(lambda xs: [jnp.sum(x, axis=0) for x in xs],
+                         out_shardings=[repl] * n)
+            return fn, mesh
+        return self._cached_fn(("ptree", n) + tuple(d.id for d in devs),
+                               build)
 
     def _allgather_fn(self, devs):
-        key = ("ag",) + tuple(d.id for d in devs)
-        cached = self._psum_cache.get(key)
-        if cached is None:
+        def build():
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
             mesh = Mesh(np.array(devs), ("host",))
             fn = jax.jit(lambda x: x,
                          out_shardings=NamedSharding(mesh, P()))
-            cached = (fn, mesh)
-            self._psum_cache[key] = cached
-        return cached
+            return fn, mesh
+        return self._cached_fn(("ag",) + tuple(d.id for d in devs), build)
 
     def _allgather_across_hosts(self, arr):
         """Gather a host-local array from all processes: returns the
@@ -231,10 +272,48 @@ class DistKVStore(KVStore):
         res = np.asarray(out.addressable_shards[0].data)
         return jnp.asarray(res)
 
+    def _allreduce_list_across_hosts(self, arrs):
+        """Sum a LIST of host-local arrays across all processes in ONE
+        jitted pytree program (one dispatch for the whole key batch —
+        the batched analog of ``_allreduce_across_hosts``)."""
+        devs = self._spanning_devices()
+        if devs is None:
+            return list(arrs)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        client = devs[0].client
+        my_proc = client.process_index()
+        local = [d for d in devs if d.process_index == my_proc][0]
+        fn, mesh = self._psum_list_fn(devs, len(arrs))
+        sharding = NamedSharding(mesh, P("host"))
+        garrs = []
+        for arr in arrs:
+            # graftlint: disable=GL003 — deliberate host staging: each
+            # process contributes its shard of the cross-host global
+            # array (same contract as _allreduce_across_hosts above)
+            shard = jax.device_put(np.asarray(arr)[None], local)
+            garrs.append(jax.make_array_from_single_device_arrays(
+                (len(devs),) + tuple(np.shape(arr)), sharding, [shard]))
+        outs = fn(garrs)
+        # graftlint: disable=GL003 — read back the replicated result
+        return [jnp.asarray(np.asarray(o.addressable_shards[0].data))
+                for o in outs]
+
+    def _apply_reduced(self, k, merged):
+        """Post-collective per-key bookkeeping: optimizer or store."""
+        stored = self._stored.get(k)
+        if stored is None:
+            raise MXNetError("key %r has not been initialized" % (k,))
+        if self._updater is not None:
+            from . import _updater_key
+            self._updater(_updater_key(k), merged, stored)
+        else:
+            merged.copyto(stored)
+
     def push(self, key, value, priority=0):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             merged = self._reduce(v, key=k)  # local devices first
+            t0 = time.perf_counter()
             if self._gc is not None:
                 # the 2-bit codes ARE the wire payload: all-gather the
                 # packed uint8 (2 bits/element — the reference ps-lite
@@ -242,24 +321,58 @@ class DistKVStore(KVStore):
                 # locally; 16x fewer DCN bytes than a float32 allreduce,
                 # same result as summing dequantized gradients
                 packed = self._gc.quantize(k, merged._h.array)
-                self.wire_bytes_pushed += int(np.asarray(packed).nbytes)
+                nbytes = int(packed.nbytes)
+                self.wire_bytes_pushed += nbytes
                 gathered = self._allgather_across_hosts(packed)
-                merged = NDArray(self._gc.dequantize_sum(
-                    gathered, merged.shape, merged._h.array.dtype))
-                arr = merged._h.array
+                arr = self._gc.dequantize_sum(
+                    gathered, merged.shape, merged._h.array.dtype)
             else:
-                self.wire_bytes_pushed += int(
-                    np.asarray(merged._h.array).nbytes)
+                nbytes = int(merged._h.array.nbytes)
+                self.wire_bytes_pushed += nbytes
                 arr = self._allreduce_across_hosts(merged._h.array)
-            merged = NDArray(arr)
-            stored = self._stored.get(k)
-            if stored is None:
+            record_comm_exposed("push", nbytes,
+                                time.perf_counter() - t0, self._type)
+            self._apply_reduced(k, NDArray(arr))
+
+    def push_pull_list(self, keys, push_values, pull_outs, priority=0):
+        """Batched fused push+pull: ONE cross-host collective dispatch
+        for every key (a single jitted pytree psum — or, compressed, a
+        single all-gather of every key's concatenated 2-bit codes)
+        instead of one program per key.  Semantics per key are identical
+        to ``push`` + ``pull``: reduce across hosts, hand the reduced
+        value to the updater (or the store), fill ``pull_outs`` from the
+        stored state."""
+        merged = [self._reduce(v, key=k)
+                  for k, v in zip(keys, push_values)]
+        for k in keys:
+            if self._stored.get(k) is None:
                 raise MXNetError("key %r has not been initialized" % (k,))
-            if self._updater is not None:
-                from . import _updater_key
-                self._updater(_updater_key(k), merged, stored)
-            else:
-                merged.copyto(stored)
+        t0 = time.perf_counter()
+        if self._gc is not None:
+            packed = [self._gc.quantize(k, m._h.array)
+                      for k, m in zip(keys, merged)]
+            lens = [int(p.shape[0]) for p in packed]
+            concat = jnp.concatenate(packed) if len(packed) > 1 \
+                else packed[0]
+            nbytes = int(concat.nbytes)  # metadata; no device sync
+            self.wire_bytes_pushed += nbytes
+            gathered = self._allgather_across_hosts(concat)
+            reduced, off = [], 0
+            for m, n in zip(merged, lens):
+                rows = jnp.asarray(gathered)[:, off:off + n]
+                off += n
+                reduced.append(self._gc.dequantize_sum(
+                    rows, m.shape, m._h.array.dtype))
+        else:
+            arrs = [m._h.array for m in merged]
+            nbytes = sum(int(a.nbytes) for a in arrs)
+            self.wire_bytes_pushed += nbytes
+            reduced = self._allreduce_list_across_hosts(arrs)
+        record_comm_exposed("push_pull", nbytes,
+                            time.perf_counter() - t0, self._type)
+        for k, arr, out in zip(keys, reduced, pull_outs):
+            self._apply_reduced(k, NDArray(jnp.asarray(arr)))
+            self.pull(k, out=out, priority=priority)
 
     def barrier(self):
         """Named rendezvous barrier.

@@ -7,12 +7,32 @@ accumulates into a per-key residual added before the next quantization.
 Here the quantizer is a pure jitted function; the packed wire format is a
 uint8 array with 4 values/byte (the reference packs 16 per uint32 —
 same 2 bits/value density).
+
+Two consumers share the same wire format:
+
+- the ``GradientCompression`` class below — the kvstore's host-driven
+  mode (``set_gradient_compression``), residual keyed per parameter;
+- the in-program overlapped path (``parallel/comm.py``): the pure flat
+  functions ``quantize_flat`` / ``dequantize_flat`` /
+  ``dequantize_sum_flat`` run INSIDE the fused train-step program, with
+  the residual carried as extra (donated) optimizer state.
+
+Flat-length contract: the packed stream always covers ``ceil(n/4)``
+bytes.  ``_pack2`` owns the padding (codes for the pad lanes are 0 =
+"no update"), and every dequantizer slices back to the caller's ``n``
+— arbitrary gradient lengths round-trip (regression-tested in
+tests/test_comm_overlap.py).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def packed_nbytes(n):
+    """Wire bytes for n 2-bit values: 4 codes per byte, padded up."""
+    return (int(n) + 3) // 4
 
 
 class GradientCompression:
@@ -33,8 +53,8 @@ class GradientCompression:
         return codes
 
     def dequantize(self, codes, shape, dtype=jnp.float32):
-        return _dequantize_2bit(codes, int(np.prod(shape)),
-                                self.threshold).reshape(shape).astype(dtype)
+        return dequantize_flat(codes, int(np.prod(shape)),
+                               self.threshold).reshape(shape).astype(dtype)
 
     def dequantize_sum(self, gathered, shape, dtype=jnp.float32):
         """Sum of every participant's codes, dequantized: gathered is
@@ -43,46 +63,64 @@ class GradientCompression:
         the sum of the individually dequantized gradients, computed from
         the 2-bit wire payload instead of exchanged float32."""
         n = int(np.prod(shape))
-        return _dequantize_2bit_sum(jnp.asarray(gathered), n,
-                                    self.threshold) \
+        return dequantize_sum_flat(jnp.asarray(gathered), n,
+                                   self.threshold) \
             .reshape(shape).astype(dtype)
 
 
 @jax.jit
 def _pack2(q):
-    """q: int8 codes in {0,1,2} flat, length padded to multiple of 4 ->
-    uint8 with 4 codes/byte."""
-    q = q.astype(jnp.uint8).reshape(-1, 4)
+    """q: int8 codes in {0,1,2} flat, ANY length -> uint8 with 4
+    codes/byte (``packed_nbytes(len(q))`` of them).  Pad lanes get code
+    0 ("no update"), so the pack/unpack round trip is exact for every
+    length — the flat-length contract lives here, not in the callers."""
+    q = jnp.pad(q.astype(jnp.uint8), (0, (-q.shape[0]) % 4)).reshape(-1, 4)
     return (q[:, 0] | (q[:, 1] << 2) | (q[:, 2] << 4) | (q[:, 3] << 6))
 
 
-def _quantize_2bit(grad, residual, threshold):
-    g = (grad + residual).reshape(-1)
-    pad = (-g.shape[0]) % 4
-    gp = jnp.pad(g, (0, pad))
-    code = jnp.where(gp >= threshold, 1, jnp.where(gp <= -threshold, 2, 0))
+def quantize_flat(flat, residual, threshold):
+    """Pure 2-bit quantizer over a flat array (any length, any float
+    dtype).  Returns ``(packed uint8 [ceil(n/4)], new_residual)`` with
+    the error-feedback residual ``flat + residual - dequantized`` in the
+    input's dtype.  Usable inside jitted/shard_mapped programs."""
+    g = flat.reshape(-1) + residual.reshape(-1)
+    code = jnp.where(g >= threshold, 1, jnp.where(g <= -threshold, 2, 0))
     packed = _pack2(code.astype(jnp.int8))
     deq = jnp.where(code == 1, threshold,
-                    jnp.where(code == 2, -threshold, 0.0))
-    deq = deq[:g.shape[0]].reshape(grad.shape)
-    new_residual = grad + residual - deq
-    return packed, new_residual
+                    jnp.where(code == 2, -threshold, 0.0)).astype(g.dtype)
+    return packed, (g - deq).reshape(flat.shape)
 
 
-def _dequantize_2bit(packed, n, threshold):
+def _quantize_2bit(grad, residual, threshold):
+    packed, new_residual = quantize_flat(grad.reshape(-1),
+                                         residual.reshape(-1), threshold)
+    return packed, new_residual.reshape(grad.shape)
+
+
+def dequantize_flat(packed, n, threshold):
+    """packed uint8 [ceil(n/4)] -> float32 [n] of {-t, 0, +t}."""
     b = packed
     codes = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
                       axis=1).reshape(-1)[:n]
     return jnp.where(codes == 1, threshold,
-                     jnp.where(codes == 2, -threshold, 0.0))
+                     jnp.where(codes == 2, -threshold, 0.0)) \
+        .astype(jnp.float32)
 
 
-def _dequantize_2bit_sum(packed_rows, n, threshold):
-    """packed_rows: [w, n_packed] uint8 -> per-element sum over w of the
-    dequantized values, as float32 [n]."""
+# back-compat alias (pre-refactor private name)
+_dequantize_2bit = dequantize_flat
+
+
+def dequantize_sum_flat(packed_rows, n, threshold):
+    """packed_rows: [w, ceil(n/4)] uint8 -> per-element sum over w of the
+    dequantized values, as float32 [n] — bitwise equal to summing the
+    individually dequantized rows (integer count times threshold)."""
     b = packed_rows
     codes = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
                       axis=-1).reshape(b.shape[0], -1)[:, :n]
     signed = jnp.where(codes == 1, 1, jnp.where(codes == 2, -1, 0)) \
         .astype(jnp.int32)
     return threshold * jnp.sum(signed, axis=0).astype(jnp.float32)
+
+
+_dequantize_2bit_sum = dequantize_sum_flat

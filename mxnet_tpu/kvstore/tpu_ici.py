@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray import NDArray
-from ..observability.instrument import record_kv
+from ..observability.instrument import record_comm_exposed, record_kv
 from . import KVStore, _key_value, _updater_key
 
 
@@ -123,6 +123,7 @@ class TpuIciKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         t0 = time.perf_counter()
+        comm_bytes = 0
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             stored = self._stored.get(k)
@@ -136,6 +137,10 @@ class TpuIciKVStore(KVStore):
                 # below would silently drop the payload — use base semantics
                 super().push(k, v, priority)
                 continue
+            if len(vals) > 1:
+                # per-worker collective payload: one copy's bytes
+                # (metadata read — no device sync on the hot path)
+                comm_bytes += int(vals[0]._h.array.nbytes)
             merged = self._reduce(v)
             if self._updater is not None:
                 grad = merged
@@ -158,7 +163,13 @@ class TpuIciKVStore(KVStore):
                 self._stored[k] = merged
         # bytes of the sparse-fallback keys are also counted by the base
         # push they delegate to — a small overcount on an exotic path
-        record_kv("push", value, time.perf_counter() - t0, self._type)
+        dt = time.perf_counter() - t0
+        record_kv("push", value, dt, self._type)
+        if comm_bytes:
+            # the kvstore reduction is EXPOSED communication: the step
+            # waits on it (contrast: the fused step's in-program bucketed
+            # collectives, docs/distributed.md)
+            record_comm_exposed("push", comm_bytes, dt, self._type)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
@@ -218,12 +229,15 @@ class TpuIciKVStore(KVStore):
             groups.setdefault((arrays[0].dtype, devs), []).append(
                 (k, by_dev, o))
 
+        t0 = time.perf_counter()
+        comm_bytes = 0
         for (_, devs), items in groups.items():
             # one flattened concat per device (runs on that device), one
             # collective for the whole group
             flats = [jnp.concatenate(
                 [jnp.ravel(by_dev[d]) for _, by_dev, _ in items])
                 for d in devs]
+            comm_bytes += int(flats[0].nbytes)  # metadata; no sync
             merged_flat = allreduce_arrays(flats)
             offset = 0
             for k, by_dev, o in items:
@@ -234,6 +248,9 @@ class TpuIciKVStore(KVStore):
                 offset += n
                 self._stored[k] = NDArray(seg)
                 self.pull(k, out=o, priority=priority)
+        if comm_bytes:
+            record_comm_exposed("push_pull", comm_bytes,
+                                time.perf_counter() - t0, self._type)
         for k, v, o in fallback:
             self.push_pull(k, v, o, priority)
 

@@ -38,8 +38,10 @@ from .. import executor_cache as _exec_cache
 from .. import random as _random
 from ..ndarray import NDArray
 from ..observability import health as _health
+from ..observability import instrument as _instrument
 from ..observability import memprof as _memprof
 from ..optimizer import _is_low_precision
+from ..parallel import comm as _comm
 
 
 # create_state-shaped pytrees are None / array / tuple-of-those — exactly
@@ -98,7 +100,8 @@ class FusedTrainStep:
             return False
         return True
 
-    def __init__(self, module, _carry_states=None, _carry_masters=None):
+    def __init__(self, module, _carry_states=None, _carry_masters=None,
+                 _carry_residuals=None):
         self.module = module
         self.exe = module._exec_group.execs[0]
         self.opt = module._optimizer
@@ -136,9 +139,48 @@ class FusedTrainStep:
             self._mesh = Mesh(np.array(self.devices), ("dp",))
             self._sh_repl = NamedSharding(self._mesh, P())
             self._sh_dp = NamedSharding(self._mesh, P("dp"))
+            # batch bookkeeping, needed both by the step body (overlap
+            # mode shard_maps the batch args) and the sharding specs
+            self._full_batch = int(module._data_shapes[0].shape[0])
+            self._full_shape = {d.name: tuple(d.shape)
+                                for d in module._data_shapes}
+            if module._label_shapes:
+                self._full_shape.update((l.name, tuple(l.shape))
+                                        for l in module._label_shapes)
+            batch_names = set(self.data_names) | set(self.label_names)
+            self._other_is_batch = [n in batch_names
+                                    for n in self.other_names]
         else:
             self._mesh = None
             self._sh_repl = None
+
+        # -- overlapped gradient collectives (parallel/comm.py) ----------
+        # resolved at construction like the health flag: flipping either
+        # env knob takes effect on the next FusedTrainStep build, and the
+        # off path traces a program bit-identical to pre-flag builds.
+        self._comm_cfg = None
+        self._comm_plan = None
+        self._n_outs = None
+        self.overlap_off_reason = None
+        if self.n_dev == 1 and _comm.comm_config() is not None:
+            # nothing to overlap: there is no gradient collective
+            self.overlap_off_reason = "single-device"
+        if self.n_dev > 1:
+            cfg = _comm.comm_config()
+            if cfg is not None:
+                reason = self._overlap_gate(exe, prog)
+                if reason is None:
+                    self._comm_cfg = cfg
+                    self._comm_plan = _comm.CommPlan(
+                        [tuple(exe.arg_dict[n].shape)
+                         for n in self.param_names],
+                        self.param_dtypes, cfg)
+                else:
+                    self.overlap_off_reason = reason
+                    module.logger.warning(
+                        "gradient-collective overlap requested but "
+                        "unavailable for this program (%s); using the "
+                        "monolithic reduction", reason)
 
         def _to_global(arr):
             # never the default backend: the bound device (or dp mesh)
@@ -170,6 +212,32 @@ class FusedTrainStep:
         else:
             self.states = [self._init_state(j)
                            for j in range(len(self.param_names))]
+
+        # error-feedback residuals (2-bit compression only): one flat
+        # f32 vector per bucket PER SHARD (each data-parallel worker
+        # keeps its own quantization error — the reference kept one per
+        # key per worker, gradient_compression.h:52).  Stored dp-sharded
+        # and donated like momentum; dropped with a warning if a carried
+        # checkpoint no longer matches the bucket layout.
+        self._residuals = []
+        if self._comm_plan is not None and self._comm_plan.compress:
+            res_shapes = [(self.n_dev,) + s
+                          for s in self._comm_plan.residual_shapes()]
+            carried = None
+            if _carry_residuals is not None:
+                if [tuple(np.asarray(r).shape) for r in _carry_residuals] \
+                        == res_shapes:
+                    carried = _carry_residuals
+                else:
+                    module.logger.warning(
+                        "carried compression residuals do not match the "
+                        "current bucket layout; reinitializing to zero")
+            self._residuals = [
+                jax.device_put(np.asarray(carried[j], np.float32)
+                               if carried is not None
+                               else np.zeros(s, np.float32), self._sh_dp)
+                for j, s in enumerate(res_shapes)]
+
         # per-param extras width (bias-correction coefficients etc.) —
         # declared, not probed: fused_scalars needs _update_count to have
         # run and may be stateful (Nadam's m_schedule)
@@ -204,6 +272,10 @@ class FusedTrainStep:
         needs_rng = self._needs_rng
         health_on = self._health_on
         health_layout = self.health_layout
+        comm_plan = self._comm_plan
+        mesh_ref = self._mesh
+        other_is_batch = self._other_is_batch if self.n_dev > 1 else []
+        n_outs = self._n_outs
 
         # Buffer donation halves peak parameter memory, but on remote-
         # attached chips (tunneled runtimes) it forces per-step buffer
@@ -211,8 +283,8 @@ class FusedTrainStep:
         # off; flip on for memory-bound models on locally-attached chips.
         donate = os.environ.get("MXNET_TPU_FUSED_DONATE", "0") == "1"
 
-        def _step(masters, other_vals, states, aux_vals, keys, lrs, wds,
-                  extras, opt_key):
+        def _step(masters, other_vals, states, aux_vals, residuals, keys,
+                  lrs, wds, extras, opt_key):
             # body runs only when jax (re)traces: counts real recompiles
             # of the fused step alongside the executor-cache counters
             _exec_cache.note_trace("fused_step", memprof_label)
@@ -233,16 +305,59 @@ class FusedTrainStep:
             pvals = [m.astype(param_dtypes[j]) if mixed[j] else m
                      for j, m in enumerate(masters)]
 
-            def f(pv):
-                amap = dict(arg_map)
-                amap.update(zip(param_names, pv))
-                outs, new_aux = prog_ref.evaluate(amap, aux_map, keys, True)
-                return outs, [new_aux[n] for n in aux_names]
+            if comm_plan is None:
+                def f(pv):
+                    amap = dict(arg_map)
+                    amap.update(zip(param_names, pv))
+                    outs, new_aux = prog_ref.evaluate(amap, aux_map, keys,
+                                                      True)
+                    return outs, [new_aux[n] for n in aux_names]
 
-            (outs, new_aux), vjp_fn = jax.vjp(f, pvals)
-            heads = [jnp.ones_like(o) for o in outs]
-            zeros_aux = [jnp.zeros_like(a) for a in new_aux]
-            (grads,) = vjp_fn((heads, zeros_aux))
+                (outs, new_aux), vjp_fn = jax.vjp(f, pvals)
+                heads = [jnp.ones_like(o) for o in outs]
+                zeros_aux = [jnp.zeros_like(a) for a in new_aux]
+                (grads,) = vjp_fn((heads, zeros_aux))
+                new_residuals = list(residuals)
+            else:
+                # Overlapped path: the forward/backward runs PER SHARD
+                # under shard_map, so the gradients exist as explicit
+                # local partial sums and the cross-device reduction is
+                # OURS to schedule — one collective per reverse-autodiff
+                # bucket (optionally 2-bit compressed), barrier-chained
+                # so XLA cannot re-combine them into a tail all-reduce
+                # (parallel/comm.py).  Gated to aux-free, rng-free,
+                # batch-major-output programs, where per-shard evaluation
+                # is exactly the monolithic math up to reduction order.
+                from ..parallel._smap import shard_map, UNCHECKED
+                from jax.sharding import PartitionSpec as P
+
+                def _shard_fb(other_local, pvals_in, res_in):
+                    amap_l = dict(zip(other_names, other_local))
+
+                    def f(pv):
+                        amap = dict(amap_l)
+                        amap.update(zip(param_names, pv))
+                        outs, _ = prog_ref.evaluate(amap, {}, keys, True)
+                        return list(outs)
+
+                    outs, vjp_fn = jax.vjp(f, pvals_in)
+                    heads = [jnp.ones_like(o) for o in outs]
+                    (grads,) = vjp_fn(list(heads))
+                    red, new_res = _comm.reduce_buckets(
+                        list(grads), "dp", comm_plan,
+                        [r[0] for r in res_in])
+                    return outs, red, [r[None] for r in new_res]
+
+                n_res = len(comm_plan.residual_shapes())
+                outs, grads, new_residuals = shard_map(
+                    _shard_fb, mesh=mesh_ref,
+                    in_specs=([P("dp") if b else P()
+                               for b in other_is_batch],
+                              [P()] * n_params, [P("dp")] * n_res),
+                    out_specs=([P("dp")] * n_outs, [P()] * n_params,
+                               [P("dp")] * n_res),
+                    **UNCHECKED)(other_vals, pvals, residuals)
+                new_aux = []
 
             opt_keys = jax.random.split(opt_key, n_params) if needs_rng \
                 else [None] * n_params
@@ -275,13 +390,18 @@ class FusedTrainStep:
                                             list(grads),
                                             update_ratio=ratio)
                 return (outs, new_masters, new_states, new_aux, new_exec,
-                        hvec)
-            return outs, new_masters, new_states, new_aux, new_exec
+                        new_residuals, hvec)
+            return (outs, new_masters, new_states, new_aux, new_exec,
+                    new_residuals)
 
+        # donation: masters (0), optimizer states (2), and the
+        # compression residuals (4 — zero-length when not compressing)
+        donate_idx = (0, 2, 4) if donate else ()
+        self._last_abstract = None
         if self.n_dev == 1:
+            self._step_jit = jax.jit(_step, donate_argnums=donate_idx)
             self._step = _memprof.wrap_jit(
-                jax.jit(_step, donate_argnums=(0, 2) if donate else ()),
-                "fused_step", memprof_label)
+                self._step_jit, "fused_step", memprof_label)
             # identity of the arrays we last wrote into exec's dicts; a
             # mismatch means set_params/init_params replaced them and the
             # master state must refresh from the exec value
@@ -290,13 +410,8 @@ class FusedTrainStep:
 
         # -- multi-device DP: derive shardings, validate at full shapes --
         repl, dp = self._sh_repl, self._sh_dp
-        full_batch = int(module._data_shapes[0].shape[0])
-        full_shape = {d.name: tuple(d.shape) for d in module._data_shapes}
-        if module._label_shapes:
-            full_shape.update((l.name, tuple(l.shape))
-                              for l in module._label_shapes)
-        batch_names = set(self.data_names) | set(self.label_names)
-        self._other_is_batch = [n in batch_names for n in self.other_names]
+        full_batch = self._full_batch
+        full_shape = self._full_shape
         sds = jax.ShapeDtypeStruct
         others = [sds(full_shape.get(n, exe.arg_dict[n].shape),
                       exe.arg_dict[n]._h.array.dtype)
@@ -305,15 +420,17 @@ class FusedTrainStep:
         svals = [_map_state(lambda a: sds(a.shape, a.dtype), st)
                  for st in self.states]
         avals = [sds(a.shape, a.dtype) for a in self._gaux]
+        rvals = [sds(r.shape, r.dtype) for r in self._residuals]
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
         f32v = sds((n_params,), np.float32)
         exv = sds((n_params, max(n_extra, 1)), np.float32)
         kv = sds((2,), np.uint32)
         outs_sd = jax.eval_shape(
-            _step, mvals, others, svals, avals, keys, f32v, f32v, exv,
-            kv)[0]
+            _step, mvals, others, svals, avals, rvals, keys, f32v, f32v,
+            exv, kv)[0]
         # XLA derives the gradient all-reduce from these shardings — the
-        # kvstore collective collapsed into the step program
+        # kvstore collective collapsed into the step program (monolithic
+        # mode) or scheduled per bucket by the shard_map body (overlap)
         state_sh = [_map_state(lambda a: repl, st) for st in self.states]
         out_sh = (
             [dp if (len(o.shape) >= 1 and o.shape[0] == full_batch)
@@ -321,24 +438,79 @@ class FusedTrainStep:
             [repl] * n_params,
             state_sh,
             [repl] * len(aux_names),
-            [repl] * n_params)
+            [repl] * n_params,
+            [dp] * len(self._residuals))
         if health_on:
             # the packed health vector is a global reduction: replicated
             out_sh = out_sh + (repl,)
-        self._step = _memprof.wrap_jit(
-            jax.jit(
-                _step,
-                in_shardings=(
-                    [repl] * n_params,
-                    [dp if b else repl for b in self._other_is_batch],
-                    state_sh,
-                    [repl] * len(aux_names),
-                    (repl,) * exe._n_keys,
-                    repl, repl, repl, repl),
-                out_shardings=out_sh,
-                donate_argnums=(0, 2) if donate else ()),
-            "fused_step", memprof_label)
+        self._step_jit = jax.jit(
+            _step,
+            in_shardings=(
+                [repl] * n_params,
+                [dp if b else repl for b in self._other_is_batch],
+                state_sh,
+                [repl] * len(aux_names),
+                [dp] * len(self._residuals),
+                (repl,) * exe._n_keys,
+                repl, repl, repl, repl),
+            out_shardings=out_sh,
+            donate_argnums=donate_idx)
+        self._step = _memprof.wrap_jit(self._step_jit, "fused_step",
+                                       memprof_label)
         self._scattered = {}
+
+    def _overlap_gate(self, exe, prog):
+        """Why the bucketed-overlap path cannot serve this program (None
+        when it can).  The overlap body evaluates the graph PER SHARD, so
+        it must be exactly the global math up to reduction order:
+
+        - auxiliary state (BatchNorm moving stats) is updated from batch
+          statistics — per-shard stats would change the training math,
+          so such programs keep the monolithic reduction;
+        - in-graph rng (dropout) draws a global-batch-shaped mask; a
+          per-shard trace would draw a different (shard-correlated) one;
+        - loss heads with batch-size-dependent gradient scale
+          (SoftmaxOutput normalization='batch'/'valid') divide by the
+          TRACED batch — per shard that is the local batch / local valid
+          count, so the psum would come out dp-times too large;
+        - every output must be batch-major so the shards concatenate
+          back into the monolithic program's outputs."""
+        if prog.aux_names:
+            return "auxiliary state (batch statistics need global-batch " \
+                   "semantics)"
+        if exe._n_keys:
+            return "in-graph rng"
+        for node in prog.order:
+            if node.attrs.get("normalization") in ("batch", "valid"):
+                return "batch-normalized loss gradient (%s " \
+                       "normalization=%r divides by the per-shard " \
+                       "batch)" % (node.op_name,
+                                   node.attrs["normalization"])
+        sds = jax.ShapeDtypeStruct
+        amap = {n: sds(tuple(a._h.array.shape), a._h.array.dtype)
+                for n, a in exe.arg_dict.items()}
+        try:
+            outs = jax.eval_shape(
+                lambda am: list(prog.evaluate(am, {}, (), True)[0]), amap)
+        except Exception as e:
+            return "output shape probe failed (%s)" % (e,)
+        local_b = int(exe.arg_dict[self.data_names[0]].shape[0])
+        if not all(len(o.shape) >= 1 and int(o.shape[0]) == local_b
+                   for o in outs):
+            return "non-batch-major outputs"
+        self._n_outs = len(outs)
+        return None
+
+    def compiled_hlo(self):
+        """Compiled-HLO text of the step program (None before the first
+        run).  The overlap acceptance evidence reads off it:
+        ``parallel.comm.collective_counts`` shows one all-reduce (or
+        all-gather, compressed) PER BUCKET instead of a combined tail
+        collective."""
+        if self._last_abstract is None:
+            return None
+        return self._step_jit.lower(*self._last_abstract).compile() \
+            .as_text()
 
     def _init_state(self, j):
         """create_state-shaped optimizer state in the master dtype, with
@@ -363,11 +535,13 @@ class FusedTrainStep:
             # (same symbol, so the param list is unchanged)
             states = self.states
             masters = [np.asarray(m) for m in self._masters]
+            residuals = [np.asarray(r) for r in self._residuals] or None
             self.exe = module._exec_group.execs[0]
             self.__init__(module,
                           _carry_states=[_map_state(np.asarray, st)
                                          for st in states],
-                          _carry_masters=masters)
+                          _carry_masters=masters,
+                          _carry_residuals=residuals)
             # the carried masters are authoritative: stop the staleness
             # check below from re-deriving them off half-width storage
             for n in self.param_names:
@@ -414,22 +588,24 @@ class FusedTrainStep:
         aux_vals = list(self._gaux)
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
+        args = (self._masters, other_vals, self.states, aux_vals,
+                self._residuals, keys, lrs, wds, extras, opt_key)
+        self._note_abstract(args)
         try:
-            res = self._step(
-                self._masters, other_vals, self.states, aux_vals, keys,
-                lrs, wds, extras, opt_key)
+            res = self._step(*args)
         except Exception as exc:
             # OOM black box: RESOURCE_EXHAUSTED on the training step
             # leaves the augmented flight dump behind before it kills
             # the run (observability/memprof.py; no-op otherwise)
             _memprof.maybe_record_oom("fused_step", exc)
             raise
-        outs, new_masters, new_states, new_aux, new_exec = res[:5]
-        self.last_health = res[5] if self._health_on else None
+        outs, new_masters, new_states, new_aux, new_exec, new_res = res[:6]
+        self.last_health = res[6] if self._health_on else None
 
         self._masters = list(new_masters)
         self.states = list(new_states)
         self._gaux = list(new_aux)
+        self._residuals = list(new_res)
         for n, v in zip(self.param_names, new_exec):
             exe.arg_dict[n]._h.array = v
             self._scattered[n] = v
@@ -456,6 +632,14 @@ class FusedTrainStep:
             a, self._sh_repl if self.n_dev > 1 else self.devices[0])
         return (put(np.asarray(lrs, np.float32)),
                 put(np.asarray(wds, np.float32)), put(ex), put(opt_key))
+
+    def _note_abstract(self, args):
+        """Stash the step's abstract signature once (first dispatch) so
+        ``compiled_hlo`` can re-lower without holding real buffers."""
+        if self._last_abstract is not None:
+            return
+        self._last_abstract = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
 
     @staticmethod
     def _replica_shard(garr, dev):
@@ -495,19 +679,27 @@ class FusedTrainStep:
         lrs, wds, extras, opt_key = self._per_step_scalars()
         keys = tuple(_random.next_key() for _ in range(exe._n_keys))
 
+        args = (self._masters, other_vals, self.states, self._gaux,
+                self._residuals, keys, lrs, wds, extras, opt_key)
+        self._note_abstract(args)
         try:
-            res = self._step(
-                self._masters, other_vals, self.states, self._gaux, keys,
-                lrs, wds, extras, opt_key)
+            res = self._step(*args)
         except Exception as exc:
             _memprof.maybe_record_oom("fused_step_dp", exc)
             raise
-        outs, new_masters, new_states, new_aux, new_exec = res[:5]
-        self.last_health = res[5] if self._health_on else None
+        outs, new_masters, new_states, new_aux, new_exec, new_res = res[:6]
+        self.last_health = res[6] if self._health_on else None
+        if self._comm_plan is not None:
+            # per-step wire accounting for the in-program collectives —
+            # host-side, outside the traced body (the comm row in
+            # tools/traceview.py and the wire-bytes contract in
+            # bench.py --comm-smoke read these)
+            _instrument.note_comm_overlapped(self._comm_plan)
 
         self._masters = list(new_masters)
         self.states = list(new_states)
         self._gaux = list(new_aux)
+        self._residuals = list(new_res)
         # hand every exec its local replica shard so eval/save/get_params
         # see the updated state with zero cross-device traffic
         for k, exe_k in enumerate(self.module._exec_group.execs):
@@ -537,6 +729,11 @@ class FusedTrainStep:
         f32 masters, under multi_precision)."""
         if updater is None:
             return
+        if self._residuals:
+            self.module.logger.warning(
+                "retiring the fused step drops the 2-bit compression "
+                "error-feedback residuals; the general path reduces "
+                "uncompressed gradients")
         for j, name in enumerate(self.param_names):
             idx = self.param_idx[j]
             devs = self.devices if self.n_dev > 1 else [self.devices[0]]
@@ -551,6 +748,10 @@ class FusedTrainStep:
                 updater.states_synced[slot] = True
 
     # -- optimizer-state checkpoint interop ---------------------------------
+    # reserved key for the compression residuals inside the fused_v2
+    # states dict; older loaders skip it (not a parameter name)
+    _RESIDUAL_KEY = "__comm_residuals__"
+
     def export_states(self):
         out = {}
         for j, name in enumerate(self.param_names):
@@ -558,9 +759,29 @@ class FusedTrainStep:
             if self.mixed[j]:
                 entry["master"] = np.asarray(self._masters[j])
             out[name] = entry
+        if self._residuals:
+            out[self._RESIDUAL_KEY] = {
+                "signature": _comm.comm_signature(),
+                "buckets": [np.asarray(r) for r in self._residuals]}
         return out
 
     def load_states(self, states):
+        comm_st = states.get(self._RESIDUAL_KEY) \
+            if isinstance(states, dict) else None
+        if comm_st is not None and self._residuals:
+            buckets = comm_st.get("buckets", [])
+            if [tuple(np.asarray(b).shape) for b in buckets] \
+                    == [tuple(r.shape) for r in self._residuals]:
+                self._residuals = [
+                    jax.device_put(np.asarray(b, np.float32), self._sh_dp)
+                    for b in buckets]
+            else:
+                self.module.logger.warning(
+                    "checkpointed compression residuals do not match the "
+                    "current bucket layout (%s vs %s); keeping the "
+                    "in-memory residuals",
+                    [tuple(np.asarray(b).shape) for b in buckets],
+                    [tuple(r.shape) for r in self._residuals])
         for n, v in states.items():
             if n not in self.param_names:
                 continue

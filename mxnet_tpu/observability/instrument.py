@@ -412,6 +412,96 @@ def disarm_pipeline_gauges(token):
         arm_pipeline_gauges(lambda: 0, lambda: 0)
 
 
+# -- gradient-collective (comm) accounting -----------------------------------
+#
+# Two kinds of gradient communication exist after the overlap work
+# (parallel/comm.py, docs/distributed.md):
+#
+# - EXPOSED: host-driven kvstore collectives (dist push/pull, tpu_ici
+#   push_pull) — the step waits on them, so their wall time is real
+#   exposed comm; recorded with bytes + latency + a ``comm:<op>`` span.
+# - OVERLAPPED: in-program bucketed collectives inside the fused train
+#   step — no host-observable latency (they ride under the backward),
+#   so only their per-step wire bytes are recorded, from the static
+#   CommPlan.
+#
+# ``comm.bytes_total`` sums both; ``comm.exposed_ms`` only ever grows
+# from the exposed path — a training setup whose exposed_ms is ~0 while
+# overlapped_bytes grows is the overlap win, and tools/traceview.py's
+# comm row prints exactly that comparison.
+_comm_cache = (None, None)
+
+
+def _comm_handles():
+    global _comm_cache
+    key = (telemetry.registry_epoch(), telemetry.enabled())
+    cached_key, handles = _comm_cache
+    if cached_key != key:
+        handles = {
+            "bytes_total": telemetry.counter(
+                "comm.bytes_total",
+                help="gradient-collective payload bytes contributed by "
+                     "this worker (exposed + overlapped)"),
+            "exposed_bytes": telemetry.counter(
+                "comm.exposed_bytes",
+                help="bytes moved by host-driven (exposed) collectives"),
+            "exposed_ms": telemetry.histogram(
+                "comm.exposed_ms",
+                help="wall time the step spent blocked on exposed "
+                     "collectives"),
+            "overlapped_bytes": telemetry.counter(
+                "comm.overlapped_bytes",
+                help="bytes moved by in-program bucketed collectives "
+                     "(overlapped with backward)"),
+            "compressed_saved_bytes": telemetry.counter(
+                "comm.compressed_saved_bytes",
+                help="f32-equivalent bytes NOT moved thanks to 2-bit "
+                     "compression"),
+            "steps": telemetry.counter(
+                "comm.steps", help="training steps with in-program "
+                                   "bucketed collectives"),
+        }
+        _comm_cache = (key, handles)
+    return handles
+
+
+def note_comm_overlapped(plan):
+    """One fused-step dispatch with in-program bucketed collectives:
+    account the plan's wire bytes (host-side; zero traced-program
+    effect).  ``plan`` is a ``parallel.comm.CommPlan``.  The trace
+    counter carries the PER-STEP bytes (samples sum to the window's
+    total), so a trace window never inherits a prior session's
+    cumulative value."""
+    if not (telemetry.enabled() or tracing.is_recording()):
+        return
+    h = _comm_handles()
+    h["bytes_total"].inc(plan.wire_bytes)
+    h["overlapped_bytes"].inc(plan.wire_bytes)
+    h["steps"].inc()
+    if plan.compress:
+        h["compressed_saved_bytes"].inc(plan.grad_f32_bytes
+                                        - plan.wire_bytes)
+    if tracing.is_recording():
+        tracing.emit_counter("comm_overlapped_bytes", plan.wire_bytes,
+                             category="comm")
+
+
+def record_comm_exposed(op, nbytes, seconds, store_type):
+    """One host-driven (exposed) collective: bytes + blocked wall time
+    + a ``comm:<op>`` span on the trace timeline."""
+    if not (telemetry.enabled() or tracing.is_recording()):
+        return
+    h = _comm_handles()
+    h["bytes_total"].inc(nbytes)
+    h["exposed_bytes"].inc(nbytes)
+    h["exposed_ms"].observe(seconds * 1e3)
+    if tracing.is_recording():
+        t1 = tracing.now_us()
+        tracing.emit_complete("comm:" + op, t1 - seconds * 1e6,
+                              seconds * 1e6, category="comm",
+                              args={"bytes": nbytes, "store": store_type})
+
+
 # push/pull handles, memoized per op against the registry epoch +
 # enabled flag (kvstore traffic is per key-batch per step — same
 # registry-lock-avoidance as the io cache above)

@@ -33,7 +33,7 @@ _FAST_MODULES = {
     "test_health", "test_io_metric_kvstore", "test_io_pipeline",
     "test_kvstore_ici", "test_module", "test_ndarray",
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
-    "test_serving", "test_pallas_kernels",
+    "test_serving", "test_pallas_kernels", "test_comm_overlap",
 }
 
 
@@ -77,6 +77,7 @@ def pytest_configure(config):
 # in the full suite but out of the iteration tier
 _SLOW_WITHIN_FAST = {
     "test_fused_dp_step_multi_device", "test_module_fit_learns",
+    "test_fused_dp_compressed_converges_and_cuts_wire",
     "test_bf16_multi_precision_trains", "test_module_multi_device",
     "test_reshape_preserves_f32_masters",
     # spawn-pool workers re-import the package (~10s on a cold cache)

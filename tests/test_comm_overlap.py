@@ -1,0 +1,444 @@
+"""Overlapped gradient collectives: bucketed all-reduce inside the fused
+step + 2-bit error-feedback compression on the wire (parallel/comm.py,
+module/fused_step.py, parallel/train.py, kvstore/dist.py,
+docs/distributed.md)."""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache
+from mxnet_tpu.kvstore import gradient_compression as gc
+from mxnet_tpu.parallel import comm
+
+_KNOBS = ("MXNET_TPU_COMM_BUCKET_MB", "MXNET_TPU_GRAD_COMPRESS",
+          "MXNET_TPU_GRAD_COMPRESS_THRESHOLD")
+
+
+@pytest.fixture(autouse=True)
+def _clean_comm(monkeypatch):
+    """Overlap off unless the test opts in."""
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+# -- bucket partitioning -----------------------------------------------------
+
+def test_partition_buckets_exact_cover_reverse_order():
+    shapes = [(64, 32), (32,), (32, 16), (16,), (16, 4), (4,)]
+    dtypes = ["float32"] * 6
+    buckets = comm.partition_buckets(shapes, dtypes, 1024)
+    # exact cover, in reverse-autodiff (reverse index) order
+    assert [i for b in buckets for i in b] == list(reversed(range(6)))
+    # budget respected wherever a bucket holds more than one tensor
+    for b in buckets:
+        if len(b) > 1:
+            assert sum(int(np.prod(shapes[i])) * 4 for i in b) <= 1024
+
+
+def test_partition_oversized_tensor_gets_own_bucket():
+    shapes = [(4,), (1000,), (4,)]
+    buckets = comm.partition_buckets(shapes, ["float32"] * 3, 64)
+    assert buckets == [[2], [1], [0]]
+
+
+def test_partition_splits_on_dtype_change():
+    shapes = [(8,), (8,), (8,)]
+    dtypes = ["float32", "bfloat16", "bfloat16"]
+    buckets = comm.partition_buckets(shapes, dtypes, 1 << 20)
+    assert buckets == [[2, 1], [0]]
+
+
+# -- 2-bit wire format -------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8])
+def test_quantize_flat_non_multiple_of_4(n):
+    """Regression: the packed stream covers ceil(n/4) bytes for EVERY
+    length — the flat-length contract lives in _pack2, not the caller."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(n)
+    flat = jnp.asarray(rng.randn(n).astype(np.float32))
+    res = jnp.asarray(rng.randn(n).astype(np.float32) * 0.1)
+    packed, new_res = gc.quantize_flat(flat, res, 0.5)
+    assert packed.shape == (gc.packed_nbytes(n),)
+    deq = gc.dequantize_flat(packed, n, 0.5)
+    assert deq.shape == (n,)
+    # error feedback closes: dequantized + residual == input + old residual
+    np.testing.assert_allclose(np.asarray(deq) + np.asarray(new_res),
+                               np.asarray(flat) + np.asarray(res),
+                               rtol=1e-6)
+    # the reference coding: above +t -> +t, below -t -> -t, else 0
+    g = np.asarray(flat) + np.asarray(res)
+    expect = np.where(g >= 0.5, 0.5, np.where(g <= -0.5, -0.5, 0.0))
+    np.testing.assert_allclose(np.asarray(deq), expect, rtol=1e-6)
+
+
+def test_dequantize_sum_matches_sum_of_dequantized():
+    """The compressed-sum oracle: dequantize_sum over every worker's
+    packed rows == the sum of individually dequantized gradients."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    n, workers, t = 37, 5, 0.25
+    rows, expect = [], np.zeros(n, np.float32)
+    for w in range(workers):
+        flat = jnp.asarray(rng.randn(n).astype(np.float32))
+        packed, _ = gc.quantize_flat(flat, jnp.zeros(n, jnp.float32), t)
+        rows.append(np.asarray(packed))
+        expect += np.asarray(gc.dequantize_flat(packed, n, t))
+    got = gc.dequantize_sum_flat(jnp.asarray(np.stack(rows)), n, t)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_class_quantize_arbitrary_length_roundtrip():
+    """The kvstore GradientCompression path with a non-multiple-of-4
+    gradient (shape (3, 5) -> 15 values)."""
+    import jax.numpy as jnp
+    g = jnp.asarray(np.linspace(-1, 1, 15, dtype=np.float32).reshape(3, 5))
+    c = gc.GradientCompression(threshold=0.5)
+    packed = c.quantize("k", g)
+    assert packed.shape == (gc.packed_nbytes(15),)
+    deq = c.dequantize(packed, (3, 5))
+    assert deq.shape == (3, 5)
+    s = c.dequantize_sum(np.asarray(packed)[None], (3, 5))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(deq))
+
+
+# -- config / signature ------------------------------------------------------
+
+def test_comm_config_resolution(monkeypatch):
+    assert comm.comm_config() is None
+    assert comm.comm_signature() == ()
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "2")
+    cfg = comm.comm_config()
+    assert cfg.bucket_bytes == 2 * 1024 * 1024 and cfg.compress is None
+    assert comm.comm_signature() == (2 * 1024 * 1024, "psum", 0.0)
+    # compression alone implies overlap at the default bucket size
+    monkeypatch.delenv("MXNET_TPU_COMM_BUCKET_MB")
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS", "2bit")
+    cfg = comm.comm_config()
+    assert cfg.compress == "2bit"
+    assert cfg.bucket_bytes == int(comm.DEFAULT_BUCKET_MB * 1024 * 1024)
+    assert cfg.threshold == 0.5
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS_THRESHOLD", "0.125")
+    assert comm.comm_config().threshold == 0.125
+    # explicit off
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS", "off")
+    assert comm.comm_config() is None
+    # BUCKET_MB=0 is the kill switch: monolithic even with compress set
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS", "2bit")
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "0")
+    assert comm.comm_config() is None
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "off")
+    assert comm.comm_config() is None
+
+
+def test_diff_signatures_comm_flags(monkeypatch):
+    """The retrace explainer names a comm-flag flip — including against
+    7-tuple keys minted before the component existed."""
+    base = ("fp0", (("data", (8, 4), "float32"),), (), ("w",), "cpu",
+            False, ("auto",))
+    new = base + ((4194304, "psum", 0.0),)
+    primary, causes, detail = executor_cache.diff_signatures(base, new)
+    assert primary == "comm_flags" and causes == ["comm_flags"]
+    assert "psum" in detail
+
+
+# -- executor-cache flag contract --------------------------------------------
+
+def _mlp(hidden=8, classes=4):
+    h = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        h, num_hidden=classes, name="fc2"), name="softmax")
+
+
+def test_flag_cache_key_contract(monkeypatch):
+    """Enable = exactly 1 retrace, disable = 0 (cached), off-path
+    gradients bitwise identical across the round trip."""
+    sym = _mlp()
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 16).astype(np.float32)
+    y = (np.arange(8) % 4).astype(np.float32)
+
+    def fb_grads():
+        exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                              data=(8, 16), softmax_label=(8,))
+        exe.arg_dict["data"][:] = mx.nd.array(X)
+        exe.arg_dict["softmax_label"][:] = mx.nd.array(y)
+        with executor_cache.watch_traces() as w:
+            exe.forward_backward(is_train=True)
+        return ({k: v.asnumpy() for k, v in exe.grad_dict.items()
+                 if v is not None},
+                w.delta().get("traces_fwd_bwd", 0))
+
+    g_off1, _cold = fb_grads()          # may hit a prior test's program
+    _, warm = fb_grads()
+    assert warm == 0
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "4")
+    _, on = fb_grads()
+    assert on == 1
+    _, on2 = fb_grads()
+    assert on2 == 0
+    monkeypatch.delenv("MXNET_TPU_COMM_BUCKET_MB")
+    g_off2, off = fb_grads()
+    assert off == 0
+    for k in g_off1:
+        np.testing.assert_array_equal(g_off1[k], g_off2[k])
+
+
+# -- fused DP step: overlap + compression ------------------------------------
+
+_N_DEV = 8
+
+
+def _fit_dp(monkeypatch, bucket=None, compress=None, threshold=None,
+            epochs=2, lr=0.1, hidden=16):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    if bucket is not None:
+        monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", str(bucket))
+    if compress is not None:
+        monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS", compress)
+    if threshold is not None:
+        monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS_THRESHOLD",
+                           str(threshold))
+    rng = np.random.RandomState(0)
+    W = rng.randn(16, 4)
+    X = rng.randn(256, 16).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    mx.random.seed(0)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=False)
+    mod = mx.mod.Module(_mlp(hidden=hidden),
+                        context=[mx.cpu(i) for i in range(_N_DEV)])
+    mod.fit(it, num_epoch=epochs, kvstore="tpu_ici",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                              magnitude=2.0))
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    params = {n: mod._exec_group.execs[0].arg_dict[n].asnumpy()
+              for n in mod._exec_group.param_names}
+    return mod, acc, params
+
+
+def test_fused_dp_overlap_matches_monolithic(monkeypatch):
+    """Bucketed overlap == monolithic psum step (allclose; the compiled
+    HLO shows one all-reduce per bucket, not a tail collective)."""
+    mod0, acc0, p0 = _fit_dp(monkeypatch)
+    assert mod0._fused_step is not None
+    assert mod0._fused_step._comm_plan is None
+    mod1, acc1, p1 = _fit_dp(monkeypatch, bucket=0.001)
+    fs = mod1._fused_step
+    assert fs._comm_plan is not None, fs.overlap_off_reason
+    assert len(fs._comm_plan.buckets) >= 2
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-4, atol=1e-6)
+    assert acc1 == pytest.approx(acc0, abs=1e-6)
+    counts = comm.collective_counts(fs.compiled_hlo())
+    assert counts["all-reduce"] >= 2, counts
+
+
+def test_fused_dp_compressed_converges_and_cuts_wire(monkeypatch):
+    """2-bit mode still learns the smoke task and moves <= 1/8 (in fact
+    1/16 + padding) of the f32 gradient bytes per step."""
+    mod, acc, _ = _fit_dp(monkeypatch, bucket=0.001, compress="2bit",
+                          threshold=0.05, epochs=12, hidden=32)
+    fs = mod._fused_step
+    plan = fs._comm_plan
+    assert plan is not None and plan.compress == "2bit"
+    assert plan.wire_bytes <= plan.grad_f32_bytes / 8.0
+    assert acc >= 0.5, acc  # chance = 0.25
+    counts = comm.collective_counts(fs.compiled_hlo())
+    assert counts["all-gather"] >= 2, counts
+    # the error-feedback residual is live state
+    assert fs._residuals and any(float(np.abs(np.asarray(r)).sum()) > 0
+                                 for r in fs._residuals)
+
+
+def test_residual_survives_checkpoint(monkeypatch):
+    """The EF residual is optimizer state: it rides
+    save_optimizer_states / load_optimizer_states."""
+    mod, _, _ = _fit_dp(monkeypatch, bucket=0.001, compress="2bit",
+                        threshold=0.05, epochs=2)
+    fs = mod._fused_step
+    before = [np.asarray(r) for r in fs._residuals]
+    assert before and any(np.abs(b).sum() > 0 for b in before)
+    path = os.path.join(tempfile.mkdtemp(), "opt.states")
+    mod.save_optimizer_states(path)
+    fs._residuals = [np.zeros_like(b) for b in before]
+    mod.load_optimizer_states(path)
+    after = [np.asarray(r) for r in fs._residuals]
+    assert all(np.array_equal(a, b) for a, b in zip(after, before))
+
+
+def test_overlap_gate_reasons(monkeypatch):
+    """Documented gates: BN aux state keeps the monolithic path;
+    a single device has nothing to overlap."""
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "4")
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (np.arange(64) % 2).astype(np.float32)
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        net, num_hidden=2, name="fc2"), name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=1, kvstore="tpu_ici",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    fs = mod._fused_step
+    assert fs is not None and fs._comm_plan is None
+    assert "auxiliary state" in fs.overlap_off_reason
+
+    it2 = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod2 = mx.mod.Module(_mlp(classes=2), context=mx.cpu())
+    mod2.fit(it2, num_epoch=1,
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    fs2 = mod2._fused_step
+    assert fs2 is not None and fs2._comm_plan is None
+    assert fs2.overlap_off_reason == "single-device"
+
+
+def test_overlap_gate_batch_normalized_loss(monkeypatch):
+    """SoftmaxOutput(normalization='batch') divides the gradient by the
+    TRACED batch — per shard that would be the local batch, scaling the
+    psum dp-times too large.  The gate must keep such programs on the
+    monolithic path."""
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "4")
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (np.arange(64) % 2).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=2, name="fc"),
+        normalization="batch", name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=1, kvstore="tpu_ici",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    fs = mod._fused_step
+    assert fs is not None and fs._comm_plan is None
+    assert "batch-normalized loss gradient" in fs.overlap_off_reason
+
+
+# -- ShardedTrainStep --------------------------------------------------------
+
+def _sharded_setup():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import create_mesh
+    from mxnet_tpu.parallel.mesh import MeshSpec
+    mesh = create_mesh(MeshSpec(dp=_N_DEV))
+    rng = np.random.RandomState(0)
+    P0 = {"w%d" % i: rng.randn(16, 16).astype(np.float32) * 0.1
+          for i in range(4)}
+    X = rng.randn(32, 16).astype(np.float32)
+    Y = rng.randn(32, 16).astype(np.float32)
+
+    def loss_fn(p, batch):
+        h = batch["x"]
+        for i in range(4):
+            h = jnp.tanh(h @ p["w%d" % i])
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    bspec = {"x": NamedSharding(mesh, P("dp")),
+             "y": NamedSharding(mesh, P("dp"))}
+    return mesh, P0, loss_fn, bspec, {"x": X, "y": Y}
+
+
+def test_sharded_train_step_overlap_parity(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import ShardedTrainStep
+    mesh, P0, loss_fn, bspec, batch = _sharded_setup()
+
+    def run(n=4):
+        step = ShardedTrainStep(
+            loss_fn, {k: jnp.asarray(v) for k, v in P0.items()}, mesh,
+            lr=0.05, batch_spec=bspec)
+        losses = [float(step(batch)) for _ in range(n)]
+        return step, losses
+
+    s0, l0 = run()
+    assert s0.comm_plan is None and s0.overlap_off_reason is None
+    monkeypatch.setenv("MXNET_TPU_COMM_BUCKET_MB", "0.0005")
+    s1, l1 = run()
+    assert s1.comm_plan is not None and len(s1.comm_plan.buckets) >= 2
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    for k in P0:
+        np.testing.assert_allclose(np.asarray(s0.params[k]),
+                                   np.asarray(s1.params[k]),
+                                   rtol=1e-5, atol=1e-7)
+    import jax as _jax
+    hlo = s1.lower({k: _jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for k, v in batch.items()}).compile().as_text()
+    assert comm.collective_counts(hlo)["all-reduce"] >= \
+        len(s1.comm_plan.buckets)
+
+
+def test_sharded_train_step_compress_and_gates(monkeypatch):
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import ShardedTrainStep, create_mesh
+    from mxnet_tpu.parallel.mesh import MeshSpec
+    mesh, P0, loss_fn, bspec, batch = _sharded_setup()
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS", "2bit")
+    monkeypatch.setenv("MXNET_TPU_GRAD_COMPRESS_THRESHOLD", "0.001")
+    step = ShardedTrainStep(
+        loss_fn, {k: jnp.asarray(v) for k, v in P0.items()}, mesh,
+        lr=0.05, batch_spec=bspec)
+    assert step.comm_plan is not None and step.comm_plan.compress == "2bit"
+    assert step.residuals, "compression must carry residual state"
+    losses = [float(step(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    # model-parallel mesh: overlap declines with a reason
+    mesh2 = create_mesh(MeshSpec(dp=_N_DEV // 2, tp=2))
+    step2 = ShardedTrainStep(
+        loss_fn, {k: jnp.asarray(v) for k, v in P0.items()}, mesh2,
+        lr=0.05)
+    assert step2.comm_plan is None
+    assert "model-parallel" in step2.overlap_off_reason
+
+
+# -- dist kvstore satellites -------------------------------------------------
+
+def test_dist_push_pull_list_single_process(monkeypatch):
+    """Single-process degenerate path: batched push_pull_list applies
+    the same per-key semantics as push+pull (the cross-host collective
+    is a no-op without jax.distributed)."""
+    from mxnet_tpu.kvstore.dist import DistKVStore
+    kv = DistKVStore()
+    a0 = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b0 = mx.nd.array(np.ones((3,), np.float32))
+    kv.init("a", a0)
+    kv.init("b", b0)
+    ga = mx.nd.array(np.full((2, 3), 2.0, np.float32))
+    gb = mx.nd.array(np.full((3,), 3.0, np.float32))
+    oa = mx.nd.zeros((2, 3))
+    ob = mx.nd.zeros((3,))
+    kv.push_pull_list(["a", "b"], [ga, gb], [oa, ob])
+    # no updater: the pushed value replaces the stored one; pull reads it
+    np.testing.assert_array_equal(oa.asnumpy(), ga.asnumpy())
+    np.testing.assert_array_equal(ob.asnumpy(), gb.asnumpy())
+    assert kv.wire_bytes_pushed == ga.asnumpy().nbytes + \
+        gb.asnumpy().nbytes
+
+
+def test_dist_psum_cache_lru_bound(monkeypatch):
+    from mxnet_tpu.kvstore.dist import DistKVStore
+    monkeypatch.setenv("MXNET_TPU_PSUM_CACHE_SIZE", "2")
+    kv = DistKVStore()
+    for i in range(4):
+        kv._cached_fn(("t", i), lambda: i)
+    assert len(kv._psum_cache) == 2
+    assert ("t", 3) in kv._psum_cache and ("t", 2) in kv._psum_cache
+    # hit refreshes recency
+    kv._cached_fn(("t", 2), lambda: None)
+    kv._cached_fn(("t", 9), lambda: None)
+    assert ("t", 2) in kv._psum_cache and ("t", 3) not in kv._psum_cache

@@ -112,6 +112,41 @@ quant2 = np.where(residual >= 0.5, 0.5,
                   np.where(residual <= -0.5, -0.5, 0.0))
 assert np.allclose(out4.asnumpy(), quant2 * size), (rank, out4.asnumpy())
 
+# --- batched push_pull_list: ONE collective for every key ---
+# compressed form first (gc still armed): every key's codes concatenate
+# into a single all-gather; 5 elements exercises the non-multiple-of-4
+# flat-length contract on the wire
+kv.init("pa", mx.nd.zeros((3,)))
+kv.init("pb", mx.nd.zeros((5,)))
+ga = np.array([1.0, -1.0, 0.0], np.float32)
+gb = np.array([0.6, -0.6, 0.0, 2.0, -2.0], np.float32)
+oa = mx.nd.zeros((3,))
+ob = mx.nd.zeros((5,))
+before = kv.wire_bytes_pushed
+kv.push_pull_list(["pa", "pb"], [mx.nd.array(ga), mx.nd.array(gb)],
+                  [oa, ob])
+qa = np.where(ga >= 0.5, 0.5, np.where(ga <= -0.5, -0.5, 0.0))
+qb = np.where(gb >= 0.5, 0.5, np.where(gb <= -0.5, -0.5, 0.0))
+assert np.allclose(oa.asnumpy(), qa * size), (rank, oa.asnumpy())
+assert np.allclose(ob.asnumpy(), qb * size), (rank, ob.asnumpy())
+# ceil(3/4) + ceil(5/4) = 3 bytes of codes on the wire for 32 f32 bytes
+assert kv.wire_bytes_pushed - before == 3, kv.wire_bytes_pushed - before
+
+# uncompressed batched form: one jitted pytree psum for both keys
+kv._gc = None
+kv.init("qa", mx.nd.zeros((2, 2)))
+kv.init("qb", mx.nd.zeros((4,)))
+ga2 = np.full((2, 2), rank + 1.0, np.float32)
+gb2 = np.arange(4, dtype=np.float32) * (rank + 1)
+oa2 = mx.nd.zeros((2, 2))
+ob2 = mx.nd.zeros((4,))
+kv.push_pull_list(["qa", "qb"], [mx.nd.array(ga2), mx.nd.array(gb2)],
+                  [oa2, ob2])
+sum_factor = sum(r + 1 for r in range(size))
+assert np.allclose(oa2.asnumpy(), sum_factor), (rank, oa2.asnumpy())
+assert np.allclose(ob2.asnumpy(), np.arange(4) * sum_factor), \
+    (rank, ob2.asnumpy())
+
 print("WORKER_OK rank=%d size=%d pulled=%s" % (rank, size,
                                                out.asnumpy()[0]))
 """

@@ -214,6 +214,40 @@ def pipeline_breakdown(events):
     }
 
 
+def comm_breakdown(events):
+    """Gradient-communication view (docs/distributed.md): EXPOSED comm
+    is the ``comm:*`` spans (kvstore collectives the step waits on);
+    OVERLAPPED comm is the ``comm_overlapped_bytes`` counter track the
+    fused step emits for its in-program bucketed collectives.  Returns
+    None when the trace carries neither."""
+    durations = span_durations(events)
+    exposed = {"count": 0, "total_ms": 0.0, "bytes": 0}
+    for e in events:
+        if e.get("ph") == "X" and e.get("cat") == "comm":
+            exposed["count"] += 1
+            exposed["total_ms"] += e.get("dur", 0) / 1e3
+            exposed["bytes"] += int((e.get("args") or {}).get("bytes", 0))
+    overlapped_bytes = 0
+    overlapped_samples = 0
+    for e in events:
+        if e.get("ph") == "C" and e.get("name") == "comm_overlapped_bytes":
+            # per-step counter samples: they sum to the window's total
+            args = e.get("args") or {}
+            val = args.get("value", args.get("comm_overlapped_bytes", 0))
+            overlapped_bytes += _fnum(val, 0)
+            overlapped_samples += 1
+    if not exposed["count"] and not overlapped_samples:
+        return None
+    steps = sum(1 for cat, name, ms in durations
+                if cat == "step" and name == "step") or None
+    return {
+        "exposed": exposed,
+        "overlapped_bytes": int(overlapped_bytes),
+        "overlapped_steps": overlapped_samples,
+        "steps": steps,
+    }
+
+
 def instants(events):
     """{name: count} over instant ("i") markers — recompiles, evictions."""
     out = {}
@@ -644,6 +678,32 @@ def summarize(trace, top=15):
         if pb["starvation"] is not None:
             lines.append("pipeline starvation (queue_wait / step): "
                          "%.1f%%" % (pb["starvation"] * 100.0))
+
+    cb = comm_breakdown(events)
+    if cb is not None:
+        lines.append("")
+        lines.append("== gradient communication ==")
+        ex = cb["exposed"]
+        steps = cb["steps"]
+        if ex["count"]:
+            per_step = " (%.3f ms/step)" % (ex["total_ms"] / steps) \
+                if steps else ""
+            lines.append("exposed:    %d collectives, %.3f ms total%s, %s"
+                         % (ex["count"], ex["total_ms"], per_step,
+                            _fmt_bytes(ex["bytes"])))
+        else:
+            lines.append("exposed:    none (no host-driven kvstore "
+                         "collectives)")
+        if cb["overlapped_steps"]:
+            per_step = cb["overlapped_bytes"] / cb["overlapped_steps"]
+            lines.append("overlapped: %s over %d steps (%s/step, "
+                         "in-program bucketed collectives — no exposed "
+                         "wall time)"
+                         % (_fmt_bytes(cb["overlapped_bytes"]),
+                            cb["overlapped_steps"], _fmt_bytes(per_step)))
+        else:
+            lines.append("overlapped: none (monolithic reduction or "
+                         "single device)")
 
     inst = instants(events)
     if inst:
