@@ -1632,6 +1632,184 @@ def comm_smoke():
     }))
 
 
+def coldstart_smoke():
+    """Cold-start economics CI mode (`make bench-smoke` step 8,
+    `bench.py --coldstart-smoke`): proves the persistent compiled-
+    program cache's replica-boot contract end to end, in real
+    subprocesses (the unit of a cold start is a PROCESS — nothing
+    in-memory may carry over):
+
+    1. **cold**: a fresh subprocess stands up the serving stack on an
+       empty cache dir, populates it via `Server.prewarm()`, and serves
+       one request — time-to-serving measured, executables written;
+    2. **warm**: a SECOND fresh subprocess on the now-populated dir
+       boots through `warmup(expect_warm=True)` — ZERO executor
+       retraces and ZERO backend-compile records (the PR 9 compile-time
+       listener's build totals), every program restored from disk — and
+       serves the same request;
+    3. outputs and params must be BITWISE identical across the two
+       processes (a deserialized executable is the same XLA binary),
+       and warm time-to-serving must beat cold by >= 5x on the cpu
+       smoke.  Both measurements land in COLDSTART_r07.json.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    tmpd = tempfile.mkdtemp(prefix="coldstart_cache_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXNET_TPU_PROGRAM_CACHE_DIR"] = tmpd
+    for k in ("MXNET_TPU_EXEC_CACHE", "MXNET_TPU_MEMPROF",
+              "MXNET_TPU_PROGRAM_CACHE_RO", "MXNET_TPU_QUANTIZE"):
+        env.pop(k, None)
+
+    def run_child(role):
+        e = dict(env)
+        e["MXTPU_COLDSTART_ROLE"] = role
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--coldstart-child"],
+            capture_output=True, text=True, env=e, timeout=900)
+        assert r.returncode == 0, (
+            "coldstart %s child failed (rc %d):\n--- stdout ---\n%s\n"
+            "--- stderr ---\n%s" % (role, r.returncode,
+                                    r.stdout[-4000:], r.stderr[-4000:]))
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = run_child("cold")
+        warm = run_child("warm")
+        entries = [n for n in os.listdir(tmpd) if n.endswith(".mxprog")]
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
+
+    # the warm replica compiled NOTHING: no retraces, no backend
+    # compiles, every bucket program restored from disk
+    assert warm["builds"]["built"] == 0, warm["builds"]
+    assert warm["builds"]["backend_compiles"] == 0, warm["builds"]
+    assert warm["traces_total"] == 0, warm
+    assert warm["disk"]["hits"] >= len(warm["buckets"]), warm["disk"]
+    assert cold["disk"]["writes"] >= len(cold["buckets"]), cold["disk"]
+    assert len(entries) >= len(cold["buckets"]), entries
+    # bitwise: same params, same request, byte-identical responses
+    assert cold["param_sha"] == warm["param_sha"], "nondeterministic init"
+    assert cold["out_sha"] == warm["out_sha"], (
+        "restored executable answered differently from the freshly "
+        "compiled one: %s vs %s" % (cold["out_sha"], warm["out_sha"]))
+    speedup = cold["serving_ready_s"] / max(warm["serving_ready_s"], 1e-9)
+    assert speedup >= 5.0, (
+        "warm start %.2fs vs cold %.2fs — only %.1fx (need >= 5x)"
+        % (warm["serving_ready_s"], cold["serving_ready_s"], speedup))
+
+    record = {
+        "metric": "coldstart",
+        "source": "bench.py --coldstart-smoke (PR: persistent "
+                  "compiled-program cache)",
+        "created": time.time(),
+        "platform": env["JAX_PLATFORMS"],
+        "buckets": cold["buckets"],
+        "cold": cold,
+        "warm": warm,
+        "speedup_time_to_serving": round(speedup, 2),
+        "cache_entries": len(entries),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "COLDSTART_r07.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "metric": "bench_coldstart_smoke",
+        "cold_serving_ready_s": cold["serving_ready_s"],
+        "warm_serving_ready_s": warm["serving_ready_s"],
+        "speedup": round(speedup, 2),
+        "warm_backend_compiles": warm["builds"]["backend_compiles"],
+        "warm_retraces": warm["traces_total"],
+        "disk_restores": warm["builds"]["restored"],
+        "bitwise_outputs": True,
+        "record": out_path,
+    }))
+
+
+def coldstart_child():
+    """One replica boot, driven by `coldstart_smoke` in a fresh
+    subprocess (role via MXTPU_COLDSTART_ROLE): cold populates the
+    cache dir through prewarm, warm must restore everything.  Prints
+    ONE JSON line the parent asserts on.  Time-to-serving excludes
+    interpreter/framework import (identical in both roles and not what
+    the disk tier optimizes); the with-import number rides along."""
+    import hashlib
+    import os
+    import time as _time
+
+    role = os.environ["MXTPU_COLDSTART_ROLE"]
+    t_start = _time.time()
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, program_cache, serving
+    from mxnet_tpu.observability import memprof
+    t_import = _time.time()
+
+    rng = np.random.RandomState(7)
+    # deep enough that backend compile dominates cold time-to-serving
+    # (the fleet regime this cache exists for); tiny enough for CI
+    net = mx.sym.Variable("data")
+    for i in range(12):
+        net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=32, name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu", name="relu%d" % i)
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=16,
+                                name="head")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 3, 16, 16))
+    arg_params = {n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
+                  for n, s in zip(sym.list_arguments(), arg_shapes)
+                  if n not in ("data", "softmax_label")}
+    param_sha = hashlib.sha256()
+    for n in sorted(arg_params):
+        param_sha.update(arg_params[n].asnumpy().tobytes())
+
+    totals0 = memprof.build_totals()
+    with executor_cache.watch_traces() as watch:
+        server = serving.Server(max_batch_size=8, batch_window_ms=2.0)
+        server.add_model("mlp", sym, arg_params,
+                         input_shapes={"data": (3, 16, 16)})
+        if role == "cold":
+            report = server.prewarm()
+            buckets = report["models"]["mlp"]["buckets"]
+        else:
+            # expect_warm subsumes the verify sweep: zero retraces over
+            # the ENTIRE first pass is strictly stronger than "a second
+            # sweep adds none" — raises on any compile
+            report = server.warmup(verify=False, expect_warm=True)
+            buckets = report["mlp"]["buckets"]
+        payload = np.linspace(-1.0, 1.0, 5 * 3 * 16 * 16,
+                              dtype=np.float32).reshape(5, 3, 16, 16)
+        outs = server.submit("mlp", {"data": payload}, timeout=120)
+    t_ready = _time.time()
+    totals1 = memprof.build_totals()
+    out_sha = hashlib.sha256()
+    for o in outs:
+        out_sha.update(np.ascontiguousarray(o).tobytes())
+    server.close(drain=True, timeout=30)
+
+    print(json.dumps({
+        "role": role,
+        "buckets": list(buckets),
+        "serving_ready_s": round(t_ready - t_import, 4),
+        "with_import_s": round(t_ready - t_start, 4),
+        "traces_total": watch.total(),
+        "builds": {k: totals1[k] - totals0[k] for k in totals1},
+        "disk": {k: v for k, v in program_cache.stats().items()
+                 if isinstance(v, int) and not isinstance(v, bool)},
+        "param_sha": param_sha.hexdigest(),
+        "out_sha": out_sha.hexdigest(),
+    }))
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -1658,6 +1836,10 @@ if __name__ == "__main__":
         mem_smoke()
     elif "--comm-smoke" in sys.argv:
         comm_smoke()
+    elif "--coldstart-smoke" in sys.argv:
+        coldstart_smoke()
+    elif "--coldstart-child" in sys.argv:
+        coldstart_child()
     elif "--smoke" in sys.argv:
         smoke()
     else:

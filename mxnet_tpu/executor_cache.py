@@ -262,8 +262,12 @@ def _explain_miss(sibling_key, new_key):
         "(%s) — this dispatch will trace a new program", primary, detail)
 
 
-def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
-    # lazy import: executor.py imports this module at its top level
+def _build_entry(symbol, known_shapes, grad_names, platform, health=False,
+                 key=None):
+    # lazy imports: executor.py imports this module at its top level,
+    # and program_cache imports observability (keep import cost off the
+    # common path)
+    from . import program_cache as _program_cache
     from .executor import _Program
 
     prog = _Program(symbol)
@@ -277,6 +281,16 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     label = "%s@%s" % (getattr(symbol, "name", None) or "sym",
                        symbol.structural_hash()[:10])
 
+    # persistent disk tier (program_cache.py): the signature key IS the
+    # disk key material; `tag` keeps the donating fwd_bwd and its
+    # non-donating twin in distinct files (same args, different
+    # executables).  Tier off -> wrap_program == memprof.wrap_jit,
+    # today's behavior exactly.
+    def _wrap(jitted, kind, tag, static_argnums=()):
+        return _program_cache.wrap_program(
+            jitted, kind, label, key_material=key, platform=platform,
+            tag=tag, static_argnums=static_argnums)
+
     def _fwd_impl(arg_vals, aux_vals, keys, train):
         note_trace("fwd", label)
         arg_map = dict(zip(arg_names, arg_vals))
@@ -284,8 +298,8 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
         outs, new_aux = prog.evaluate(arg_map, aux_map, keys, train)
         return outs, [new_aux[n] for n in aux_names]
 
-    _fwd = _memprof.wrap_jit(jax.jit(_fwd_impl, static_argnums=(3,)),
-                             "fwd", label, static_argnums=(3,))
+    _fwd = _wrap(jax.jit(_fwd_impl, static_argnums=(3,)), "fwd", "fwd",
+                 static_argnums=(3,))
 
     # the sentinel layout is derived from the program's static structure
     # (output count, grad-name order), never from traced values
@@ -328,10 +342,10 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False):
     # non-donating twin because the buffers it feeds stay live in
     # aux_dict.
     donate = (1,) if platform == "tpu" else ()
-    _fwd_bwd = _memprof.wrap_jit(
-        jax.jit(_fwd_bwd_impl, donate_argnums=donate), "fwd_bwd", label)
-    _fwd_bwd_nd = _memprof.wrap_jit(jax.jit(_fwd_bwd_impl), "fwd_bwd",
-                                    label) if donate else _fwd_bwd
+    _fwd_bwd = _wrap(jax.jit(_fwd_bwd_impl, donate_argnums=donate),
+                     "fwd_bwd", "fwd_bwd")
+    _fwd_bwd_nd = _wrap(jax.jit(_fwd_bwd_impl), "fwd_bwd", "fwd_bwd_nd") \
+        if donate else _fwd_bwd
 
     return ProgramEntry(prog, _fwd, _fwd_bwd, _fwd_bwd_nd, bool(donate),
                         n_keys, health=bool(health),
@@ -356,9 +370,15 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
     known.update((n, tuple(int(d) for d in a.shape))
                  for n, a in aux_dict.items())
     if not _enabled():
+        from . import program_cache as _program_cache
         _note("misses")
+        # no in-process sharing, but the DISK tier (when configured)
+        # still wants the signature as its key material
+        key = _signature(symbol, arg_dict, aux_dict, grad_names,
+                         platform, health) \
+            if _program_cache.enabled() else None
         return _build_entry(symbol, known, grad_names, platform,
-                            health=health)
+                            health=health, key=key)
     key = _signature(symbol, arg_dict, aux_dict, grad_names, platform,
                      health)
     sibling_key = None
@@ -384,7 +404,7 @@ def get_entry(symbol, arg_dict, aux_dict, grad_names, platform="cpu",
         _explain_miss(sibling_key, key)
     _note("misses")
     entry = _build_entry(symbol, known, grad_names, platform,
-                         health=health)
+                         health=health, key=key)
     with _lock:
         # a concurrent bind may have built the same signature; first
         # insertion wins so every caller shares one traced program
@@ -452,7 +472,10 @@ def stats():
     ``MXNET_TPU_MEMPROF=1`` the compiled ``memory_analysis`` byte
     breakdown) plus the backend-compile-time summary ``compile_ms``
     (full distribution in the ``exec_cache.compile_ms`` telemetry
-    histogram)."""
+    histogram), and the persistent disk tier's counters (``disk``:
+    hits/misses/evictions/writes/bytes — program_cache.py, mirrored as
+    ``exec_cache.disk.*`` telemetry)."""
+    from . import program_cache as _program_cache
     with _lock:
         out = dict(_stats)
         out["entries"] = len(_entries)
@@ -460,6 +483,7 @@ def stats():
     out["enabled"] = _enabled()
     out["programs"] = _memprof.program_records()
     out["compile_ms"] = _memprof.compile_summary()
+    out["disk"] = _program_cache.stats()
     return out
 
 

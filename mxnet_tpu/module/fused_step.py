@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import executor_cache as _exec_cache
+from .. import program_cache as _program_cache
 from .. import random as _random
 from ..ndarray import NDArray
 from ..observability import health as _health
@@ -398,10 +399,69 @@ class FusedTrainStep:
         # compression residuals (4 — zero-length when not compressing)
         donate_idx = (0, 2, 4) if donate else ()
         self._last_abstract = None
+
+        # persistent disk tier (program_cache.py): the step has no
+        # executor-cache signature, so its key material is assembled
+        # here — everything the trace bakes in beyond the argument
+        # shapes the per-call fingerprint already covers: the graph,
+        # name/dtype layout, donation, the optimizer's traced constants,
+        # and the same health/kernel/comm flags that key entry programs.
+        def _disk_key():
+            if not _program_cache.enabled():
+                return None
+            from ..ops import pallas_kernels as _pk
+            opt_fp, unkeyable = _program_cache.optimizer_fingerprint(opt)
+            if unkeyable:
+                # an optimizer attribute the trace could bake in but the
+                # fingerprint cannot represent: caching would risk
+                # restoring an executable with the WRONG constants —
+                # decline (this step compiles; everything else persists)
+                module.logger.warning(
+                    "persistent program cache: fused step not persisted "
+                    "— optimizer %s attribute(s) %s cannot key the disk "
+                    "entry faithfully", type(opt).__name__,
+                    list(unkeyable))
+                return None
+            return (
+                "fused_step", exe._symbol.structural_hash(),
+                tuple(param_names), tuple(other_names), tuple(aux_names),
+                tuple(str(np.dtype(d)) for d in self.param_dtypes),
+                tuple(str(np.dtype(d)) for d in self.master_dtypes),
+                tuple(bool(m) for m in mixed),
+                bool(donate), bool(health_on), int(n_extra),
+                bool(needs_rng), int(self.n_dev),
+                tuple(str(d) for d in self.devices),
+                opt_fp, _pk.kernel_signature(), _comm.comm_signature(),
+                tuple(self._other_is_batch) if self.n_dev > 1 else ())
+
+        def _wrap_step(jitted):
+            if not _program_cache.enabled():
+                # tier off: today's dispatchable, no indirection
+                return _memprof.wrap_jit(jitted, "fused_step",
+                                         memprof_label)
+            # disk tier on: the wrapper is built LAZILY, at first
+            # dispatch — jit bakes the optimizer's constants at
+            # first-trace time, so a hyperparameter mutated between
+            # init_optimizer and the first step must be fingerprinted
+            # as the value the trace will actually read; a
+            # construction-time key could save the executable under a
+            # stale identity and a later process would restore wrong
+            # constants
+            box = []
+
+            def _dispatch(*args):
+                if not box:
+                    box.append(_program_cache.wrap_program(
+                        jitted, "fused_step", memprof_label,
+                        key_material=_disk_key(),
+                        platform=self.devices[0].platform))
+                return box[0](*args)
+
+            return _dispatch
+
         if self.n_dev == 1:
             self._step_jit = jax.jit(_step, donate_argnums=donate_idx)
-            self._step = _memprof.wrap_jit(
-                self._step_jit, "fused_step", memprof_label)
+            self._step = _wrap_step(self._step_jit)
             # identity of the arrays we last wrote into exec's dicts; a
             # mismatch means set_params/init_params replaced them and the
             # master state must refresh from the exec value
@@ -455,8 +515,7 @@ class FusedTrainStep:
                 repl, repl, repl, repl),
             out_shardings=out_sh,
             donate_argnums=donate_idx)
-        self._step = _memprof.wrap_jit(self._step_jit, "fused_step",
-                                       memprof_label)
+        self._step = _wrap_step(self._step_jit)
         self._scattered = {}
 
     def _overlap_gate(self, exe, prog):

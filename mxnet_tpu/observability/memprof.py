@@ -66,6 +66,14 @@ _lock = threading.Lock()
 _records = []          # program records, build order, bounded
 _tls = threading.local()
 _listener_installed = False
+# monotonic totals (never reset by the ring bound): how many program
+# records were opened for real builds vs disk restores, and how many
+# backend-compile events landed on an armed record.  The persistent
+# program cache's warm-start verification (serving warmup, bench.py
+# --coldstart-smoke) asserts the "built"/"backend_compiles" deltas are
+# ZERO across a warm window — the listener-verified form of "nothing
+# compiled".
+_totals = {"built": 0, "restored": 0, "backend_compiles": 0}
 
 # jax.monitoring event names -> record fields (the three phases of one
 # program build: python trace, jaxpr->MLIR lowering, XLA backend
@@ -131,6 +139,8 @@ def _on_event(name, duration_secs, **_kwargs):
         if field == "compile_ms":
             # backend compile is the last phase: close the record
             _tls.armed = None
+            with _lock:
+                _totals["backend_compiles"] += 1
             _finalize(rec)
     except Exception:
         pass
@@ -170,8 +180,38 @@ def note_build(kind, label=None):
         _records.append(rec)
         while len(_records) > MAX_RECORDS:
             _records.pop(0)
+        _totals["built"] += 1
     _tls.armed = rec
     return rec
+
+
+def note_restore(label, nbytes=0):
+    """Open a program record for an executable DESERIALIZED from the
+    persistent disk tier (mxnet_tpu/program_cache.py): kind ``disk``, no
+    compile phases, and — deliberately — no listener arming, so a later
+    real compile on this thread can never be attributed to the restore.
+    The ``disk`` kind is what keeps memory/compile attribution honest on
+    warm-started replicas, and it is NOT a recompile: no retrace counter
+    moves and no ``recompile_cause:*`` fires."""
+    _install_listener()
+    rec = {"kind": "disk", "label": label or "?", "t": time.time(),
+           "trace_ms": 0.0, "lower_ms": 0.0, "compile_ms": 0.0,
+           "memory": None, "restored_bytes": int(nbytes)}
+    with _lock:
+        _records.append(rec)
+        while len(_records) > MAX_RECORDS:
+            _records.pop(0)
+        _totals["restored"] += 1
+    return rec
+
+
+def build_totals():
+    """Monotonic {built, restored, backend_compiles} counters.  Deltas
+    over a window prove what happened in it: a warm start from a
+    populated program-cache dir must show built == backend_compiles == 0
+    while restored covers every program dispatched."""
+    with _lock:
+        return dict(_totals)
 
 
 def program_records():
@@ -230,6 +270,59 @@ def _memory_analysis_dict(compiled):
     return out
 
 
+def dispatch_signature(args, static_argnums=()):
+    """(hashable dispatch key, dynamic leaves, dynamic args) for an AOT
+    dispatch wrapper: pytree structure, per-leaf shapes/dtypes/weak
+    types/committed devices, and static values — the same information
+    ``jax.jit``'s own cache keys on.  THE single definition, shared by
+    :class:`ProfiledJit` and the persistent program cache's
+    ``DiskCachedJit`` so the two tiers can never disagree on what
+    counts as the same program.  Raises on an unhashable non-array
+    leaf when the key is later hashed — callers treat that as a
+    permanent fallback to the plain jit path."""
+    import jax
+    statics = tuple((i, args[i]) for i in static_argnums)
+    dyn = tuple(a for i, a in enumerate(args) if i not in static_argnums)
+    leaves, treedef = jax.tree_util.tree_flatten(dyn)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            # non-array leaf: hashable value participates directly
+            sig.append(("py", type(leaf).__name__, leaf))
+            continue
+        devices = getattr(leaf, "devices", None)
+        sig.append((tuple(int(d) for d in shape), np.dtype(dtype).str,
+                    bool(getattr(leaf, "weak_type", False)),
+                    frozenset(devices()) if callable(devices) else None))
+    return (treedef, tuple(sig), statics), leaves, dyn
+
+
+def aot_compile(jitted, args, kind, label, capture_memory=None):
+    """Explicit ``lower() -> compile()`` on the SAME jit object, with
+    the program-record bookkeeping of the AOT dispatch twin: the jaxpr
+    cache and the in-body retrace counters behave exactly like the
+    plain call path, the armed record captures the compile phases, and
+    a jaxpr-cache hit (body did not re-run) still opens a record so the
+    table stays complete.  ``capture_memory`` defaults to the memprof
+    flag; the persistent program cache compiles through here so its
+    write-back always holds a ``jax.stages.Compiled``."""
+    _tls.armed = None
+    lowered = jitted.lower(*args)
+    rec = getattr(_tls, "armed", None)
+    compiled = lowered.compile()
+    if rec is None:
+        # jaxpr-cache hit: the body did not re-run (the plain jit path
+        # would not have counted a retrace either) — open a record for
+        # the new executable so the memory table is complete
+        rec = note_build(kind, label)
+        _tls.armed = None
+    if enabled() if capture_memory is None else capture_memory:
+        rec["memory"] = _memory_analysis_dict(compiled)
+    return compiled
+
+
 class ProfiledJit:
     """AOT-managed twin of a ``jax.jit`` callable.
 
@@ -260,42 +353,12 @@ class ProfiledJit:
         self._fallback = False
 
     def _arg_key(self, args):
-        import jax
-        statics = tuple((i, args[i]) for i in self._static)
-        dynamic = tuple(a for i, a in enumerate(args)
-                        if i not in self._static)
-        leaves, treedef = jax.tree_util.tree_flatten(dynamic)
-        sig = []
-        for leaf in leaves:
-            shape = getattr(leaf, "shape", None)
-            dtype = getattr(leaf, "dtype", None)
-            if shape is None or dtype is None:
-                # non-array leaf: hashable value participates directly
-                sig.append(("py", type(leaf).__name__, leaf))
-                continue
-            devices = getattr(leaf, "devices", None)
-            sig.append((tuple(int(d) for d in shape), np.dtype(dtype).str,
-                        bool(getattr(leaf, "weak_type", False)),
-                        frozenset(devices()) if devices is not None
-                        else None))
-        return (treedef, tuple(sig), statics)
+        return dispatch_signature(args, self._static)[0]
 
     def _compile(self, args):
-        # clear any stale armed record so the one our lower() arms (via
-        # note_trace inside the body) is unambiguously ours
-        _tls.armed = None
-        lowered = self._jitted.lower(*args)
-        rec = getattr(_tls, "armed", None)
-        compiled = lowered.compile()
-        if rec is None:
-            # jaxpr-cache hit: the body did not re-run (the plain jit
-            # path would not have counted a retrace either) — open a
-            # record for the new executable so the memory table is
-            # complete
-            rec = note_build(self._kind, self._label)
-            _tls.armed = None
-        rec["memory"] = _memory_analysis_dict(compiled)
-        return compiled
+        # ProfiledJit exists only under the flag: always capture
+        return aot_compile(self._jitted, args, self._kind, self._label,
+                           capture_memory=True)
 
     def __call__(self, *args):
         if self._fallback:
@@ -384,10 +447,17 @@ def report():
     """The full memory report: program table + live-array census +
     per-device allocator stats.  This is the document
     ``tools/traceview.py --memory`` renders and the OOM dump embeds."""
+    try:
+        # lazy: program_cache imports this module at its top level
+        from .. import program_cache as _program_cache
+        disk = _program_cache.stats()
+    except Exception:
+        disk = None
     return {"kind": "mxnet_tpu_memory", "version": 1,
             "created": time.time(), "memprof_enabled": enabled(),
             "programs": program_records(),
             "compile": compile_summary(),
+            "disk": disk,
             "census": live_array_census(),
             "device_memory": device_memory()}
 

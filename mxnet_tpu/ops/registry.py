@@ -279,8 +279,29 @@ def apply_op(op, inputs, attrs):
     return tuple(out)
 
 
+# shape-inference memo: eval_shape_op is a pure function of
+# (op, input shapes, input dtypes, attrs), and binding runs it for every
+# node at least twice per executor (symbol-level infer_shape + the
+# program's finalize_shapes) — jax.eval_shape's ~1ms of tracing per node
+# is the dominant host cost of a warm replica boot once the persistent
+# program cache has eliminated compiles.  Bounded; process-wide.
+_SHAPE_MEMO = {}
+_SHAPE_MEMO_MAX = 8192
+
+
 def eval_shape_op(op, in_shapes, in_dtypes, attrs):
     """Forward shape/dtype inference via jax.eval_shape (all inputs known)."""
+    # keyed by the op OBJECT (identity), not just its name: register()
+    # silently replaces _REGISTRY entries, and a re-registered op with a
+    # different impl must not be served the old impl's shapes (the memo
+    # holds the old op alive, so identity cannot be recycled).  Attrs
+    # are keyed by _freeze — the ONE definition of "same attrs", shared
+    # with the imperative _jitted cache.
+    key = (op, tuple(tuple(s) for s in in_shapes),
+           tuple(str(np_dtype(d)) for d in in_dtypes), _freeze(attrs))
+    hit = _SHAPE_MEMO.get(key)
+    if hit is not None:
+        return list(hit[0]), list(hit[1])
     structs = [jax.ShapeDtypeStruct(s, np_dtype(d)) for s, d in zip(in_shapes, in_dtypes)]
     if op.needs_rng:
         structs = [jax.ShapeDtypeStruct((2,), np.uint32)] + structs
@@ -291,4 +312,12 @@ def eval_shape_op(op, in_shapes, in_dtypes, attrs):
     out = jax.eval_shape(call, *structs)
     if not isinstance(out, (tuple, list)):
         out = (out,)
-    return [tuple(o.shape) for o in out], [o.dtype for o in out]
+    shapes = [tuple(o.shape) for o in out]
+    dtypes = [o.dtype for o in out]
+    if len(_SHAPE_MEMO) >= _SHAPE_MEMO_MAX:
+        # drop the oldest-inserted half: no full-wipe cliff for a
+        # process whose working set sits near the bound
+        for stale in list(_SHAPE_MEMO)[:_SHAPE_MEMO_MAX // 2]:
+            _SHAPE_MEMO.pop(stale, None)
+    _SHAPE_MEMO[key] = (shapes, dtypes)
+    return list(shapes), list(dtypes)

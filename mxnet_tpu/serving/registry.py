@@ -165,6 +165,38 @@ class ServedModel:
                                        for m in mems)}
         return traced
 
+    def prewarm(self):
+        """Deploy-time population of the persistent program-cache dir
+        (``MXNET_TPU_PROGRAM_CACHE_DIR`` — mxnet_tpu/program_cache.py):
+        compiles every bucket program (a plain :meth:`warmup` sweep) and
+        reports what the sweep wrote to disk, so the deploy pipeline can
+        ship a cache volume and a fresh replica serves in seconds
+        instead of recompiling (docs/serving.md §prewarm).  Raises
+        ``MXNetError`` when the disk tier is off: a prewarm that
+        silently persists nothing is a broken deploy."""
+        from .. import program_cache
+        from ..base import MXNetError
+        if not program_cache.enabled():
+            raise MXNetError(
+                "ServedModel.prewarm() needs the persistent program "
+                "cache: set MXNET_TPU_PROGRAM_CACHE_DIR to the cache "
+                "volume the replicas will mount")
+        if program_cache.read_only():
+            raise MXNetError(
+                "ServedModel.prewarm() under MXNET_TPU_PROGRAM_CACHE_RO"
+                "=1 would persist nothing (the read-only mode is for "
+                "replicas CONSUMING a prewarmed volume) — unset it in "
+                "the deploy pipeline that populates the cache")
+        before = program_cache.stats()
+        traced = self.warmup()
+        after = program_cache.stats()
+        return {"buckets": list(self.buckets),
+                "traces": sum(traced.values()),
+                "disk_writes": after["writes"] - before["writes"],
+                "disk_hits": after["hits"] - before["hits"],
+                "disk_bytes_written": (after["bytes_written"]
+                                       - before["bytes_written"])}
+
 
 class ModelRegistry:
     """Name -> :class:`ServedModel` map shared by a :class:`Server`."""

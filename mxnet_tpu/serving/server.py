@@ -114,12 +114,26 @@ class Server:
     # is "thin": one more replica, bucket, or model likely OOMs
     THIN_MEMORY_MARGIN = 0.10
 
-    def warmup(self, verify=True):
+    def warmup(self, verify=True, expect_warm=False):
         """Pre-trace every bucket of every registered model.  With
         ``verify=True`` (default) a second sweep must add zero executor
         retraces, or MXNetError — a failing verify means some dispatch
         path escapes the program cache and steady-state serving would
         recompile under load.  Returns the per-model report.
+
+        ``expect_warm=True`` is the warm-start contract of the
+        persistent program cache (mxnet_tpu/program_cache.py): the
+        ENTIRE warmup — first sweep included — must add zero executor
+        retraces AND zero backend compiles (verified via the memprof
+        compile-time listener's build totals), i.e. every program
+        restores from the cache dir.  A replica booted onto a populated
+        shared volume asserts this instead of hoping; a violation names
+        the retrace/compile counts and raises MXNetError.  The report
+        gains a ``warm_start`` section with the disk-restore count.
+        The counters are deliberately PROCESS-GLOBAL — the guarantee is
+        "nothing compiled during boot", not "serving compiled nothing"
+        — so assert the warm boot before starting any concurrent
+        training/binding work in the same process.
 
         Under ``MXNET_TPU_MEMPROF=1`` the report gains a ``memory``
         section: per-model per-bucket byte footprints (XLA's
@@ -129,21 +143,51 @@ class Server:
         and — where the backend reports ``bytes_limit`` — the headroom
         against device capacity, warning when the margin is under
         ``THIN_MEMORY_MARGIN``."""
+        from .. import executor_cache, program_cache
         report = {}
         names = self.registry.names()
+        totals_before = _memprof.build_totals()
+        disk_before = program_cache.stats()
         # two phases: warm EVERY model, then verify every model — the
         # trace counters are process-global, so verifying model A while
         # model B still has untraced buckets (or live traffic is tracing
         # them) would blame A for B's compilations
-        for name in names:
-            model = self.registry.get(name)
-            first = model.warmup()
-            report[name] = {"buckets": list(model.buckets),
-                            "traces_first_pass": sum(first.values())}
-            telemetry.counter(
-                "serving.warmup_traces",
-                help="programs traced during warmup").inc(
-                report[name]["traces_first_pass"])
+        with executor_cache.watch_traces() as first_sweep:
+            for name in names:
+                model = self.registry.get(name)
+                first = model.warmup()
+                report[name] = {"buckets": list(model.buckets),
+                                "traces_first_pass": sum(first.values())}
+                telemetry.counter(
+                    "serving.warmup_traces",
+                    help="programs traced during warmup").inc(
+                    report[name]["traces_first_pass"])
+        if expect_warm:
+            totals = _memprof.build_totals()
+            built = totals["built"] - totals_before["built"]
+            compiles = (totals["backend_compiles"]
+                        - totals_before["backend_compiles"])
+            restored = totals["restored"] - totals_before["restored"]
+            if first_sweep.total() or built or compiles:
+                raise MXNetError(
+                    "serving warm-start verification failed: warmup on "
+                    "cache dir %r added %d retraces and %d backend "
+                    "compiles (%d programs built) — a warm replica must "
+                    "restore everything from disk; run prewarm() at "
+                    "deploy time or check tools/cachectl.py verify"
+                    % (program_cache.cache_dir(), first_sweep.total(),
+                       compiles, built))
+            if "warm_start" in report:
+                _module_logger(__name__).warning(
+                    'a served model is named "warm_start": the report\'s '
+                    "warm-start section is omitted (rename the model to "
+                    "get it)")
+            else:
+                report["warm_start"] = {
+                    "traces": 0, "backend_compiles": 0,
+                    "disk_restores": restored,
+                    "disk_hits": (program_cache.stats()["hits"]
+                                  - disk_before["hits"])}
         if verify:
             for name in names:
                 second = self.registry.get(name).warmup()
@@ -168,6 +212,33 @@ class Server:
             else:
                 report["memory"] = memory
         return report
+
+    def prewarm(self):
+        """Deploy-time cache population: run every registered model's
+        :meth:`ServedModel.prewarm` so the persistent program-cache dir
+        holds every bucket executable, and return the per-model report
+        plus totals.  The deploy pipeline runs this once (CI, or the
+        first replica); every later replica mounts the dir and boots
+        through ``warmup(expect_warm=True)`` in seconds — the
+        cold-start economics story (docs/serving.md §prewarm,
+        ``bench.py --coldstart-smoke``)."""
+        from .. import program_cache
+        names = self.registry.names()
+        if not names:
+            # the per-model guards (tier off / read-only) live in
+            # ServedModel.prewarm; an empty registry would skip them
+            # all and ship an empty volume as "success"
+            raise MXNetError(
+                "Server.prewarm() with no registered models would "
+                "persist nothing — add_model()/load_model() first")
+        per_model = {name: self.registry.get(name).prewarm()
+                     for name in names}
+        return {"cache_dir": program_cache.cache_dir(),
+                "models": per_model,
+                "disk_writes": sum(m["disk_writes"]
+                                   for m in per_model.values()),
+                "disk_bytes_written": sum(m["disk_bytes_written"]
+                                          for m in per_model.values())}
 
     def _warmup_memory_report(self, names):
         """The summed-footprint-vs-capacity section of the warmup

@@ -34,6 +34,7 @@ _FAST_MODULES = {
     "test_kvstore_ici", "test_module", "test_ndarray",
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
     "test_serving", "test_pallas_kernels", "test_comm_overlap",
+    "test_program_cache",
 }
 
 
@@ -82,6 +83,8 @@ _SLOW_WITHIN_FAST = {
     "test_reshape_preserves_f32_masters",
     # spawn-pool workers re-import the package (~10s on a cold cache)
     "test_process_mode_matches_thread_mode",
+    # three cachectl subprocesses, each a full framework import
+    "test_cachectl_ls_verify_prune",
 }
 
 

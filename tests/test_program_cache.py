@@ -1,0 +1,630 @@
+"""Persistent compiled-program cache (mxnet_tpu/program_cache.py): the
+disk tier of the executor program cache.
+
+The contract under test (ISSUE 11 / docs/executor.md §persistent-cache):
+
+- round-trip bitwise parity: a program restored from disk produces
+  byte-identical outputs/grads/params to the freshly-compiled one, for
+  all three program constructors (entry fwd, fwd_bwd, the fused train
+  step), with ZERO retraces on the restore path;
+- a version-fingerprint mismatch, a corrupt file, and a device mismatch
+  are each evicted-with-warning and fall back to a fresh compile;
+- `MXNET_TPU_PROGRAM_CACHE_DIR` unset is bit-identical to today (the
+  wrapper IS the pre-PR dispatchable);
+- serving `warmup(expect_warm=True)` asserts zero-retrace AND
+  zero-backend-compile on a warm dir, and raises on a cold one;
+- concurrent replicas warming one dir never read a torn executable
+  (temp-file + os.replace with a per-process counter suffix);
+- `executor_cache.stats()["disk"]` + `exec_cache.disk.*` telemetry and
+  the tools/cachectl.py admin surface (ls / verify / prune).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache, program_cache
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.observability import memprof, telemetry
+
+rng = np.random.RandomState(7)
+
+_CACHECTL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "cachectl.py")
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    """A fresh disk tier: env set, every in-memory layer cleared before
+    AND after (entries built during the test hold wrappers bound to the
+    tmp dir — they must not leak into later tests)."""
+    d = str(tmp_path / "progcache")
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_DIR", d)
+    monkeypatch.delenv("MXNET_TPU_PROGRAM_CACHE_RO", raising=False)
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    program_cache.reset_stats()
+    yield d
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    program_cache.reset_stats()
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _bind(sym, seed=3):
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", data=(8, 6),
+                          softmax_label=(8,))
+    r = np.random.RandomState(seed)
+    for n, arr in exe.arg_dict.items():
+        arr[:] = r.randint(0, 4, arr.shape).astype(np.float32) \
+            if n == "softmax_label" else \
+            r.normal(0, 1, arr.shape).astype(np.float32)
+    return exe
+
+
+def _entry_files(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".mxprog"))
+
+
+# -- round-trip parity --------------------------------------------------------
+
+def test_fwd_roundtrip_bitwise_zero_retrace(cache_dir):
+    """forward restored from disk: zero retraces, bitwise outputs."""
+    sym = _mlp()
+    exe = _bind(sym)
+    out_cold = exe.forward(is_train=False)[0].asnumpy()
+    assert program_cache.stats()["writes"] >= 1
+    assert _entry_files(cache_dir)
+
+    executor_cache.clear()  # drop the in-memory tier, keep the disk one
+    with executor_cache.watch_traces() as w:
+        exe2 = _bind(sym)
+        out_warm = exe2.forward(is_train=False)[0].asnumpy()
+    assert w.total() == 0, w.delta()
+    s = program_cache.stats()
+    assert s["hits"] >= 1 and s["evictions"] == 0, s
+    assert np.array_equal(out_cold, out_warm)
+
+
+def test_fwd_bwd_roundtrip_bitwise_zero_retrace(cache_dir):
+    """fused forward-backward restored from disk: bitwise grads."""
+    sym = _mlp()
+    exe = _bind(sym)
+    exe.forward_backward()
+    grads_cold = {n: exe.grad_dict[n].asnumpy() for n in exe._grad_names}
+
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w:
+        exe2 = _bind(sym)
+        exe2.forward_backward()
+    assert w.total() == 0, w.delta()
+    for n in exe._grad_names:
+        assert np.array_equal(grads_cold[n],
+                              exe2.grad_dict[n].asnumpy()), n
+
+
+def _fit_params(steps=3):
+    """A tiny deterministic fused-step fit; returns trained params."""
+    mx.random.seed(11)  # init_params draws from the global stream
+    r = np.random.RandomState(0)
+    X = r.randn(32, 6).astype(np.float32)
+    Y = r.randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._fused_step is not None
+    for _ in range(steps):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    params, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in params.items()}
+
+
+def test_fused_step_roundtrip_bitwise(cache_dir, monkeypatch):
+    """The fused train step round-trips through disk: a warm fit (zero
+    fused-step retraces) trains bitwise-identically to the cold one,
+    which itself is bitwise-identical to a disk-tier-off fit."""
+    monkeypatch.delenv("MXNET_TPU_PROGRAM_CACHE_DIR", raising=False)
+    executor_cache.clear()
+    p_off = _fit_params()
+
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_DIR", cache_dir)
+    executor_cache.clear()
+    p_cold = _fit_params()
+    assert any(".fused_step." in f for f in _entry_files(cache_dir))
+
+    executor_cache.clear()
+    t0 = executor_cache.trace_counts()["traces_fused_step"]
+    p_warm = _fit_params()
+    t1 = executor_cache.trace_counts()["traces_fused_step"]
+    assert t1 == t0, "fused step retraced on a warm dir"
+    for k in p_off:
+        assert np.array_equal(p_off[k], p_cold[k]), k
+        assert np.array_equal(p_cold[k], p_warm[k]), k
+
+
+def test_memprof_records_disk_kind_no_recompile_cause(cache_dir):
+    """A restore is attributable (program record kind `disk`) but is
+    NOT a recompile: no recompile_cause fires for it."""
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    n_restored0 = memprof.build_totals()["restored"]
+    _bind(sym).forward(is_train=False)
+    assert memprof.build_totals()["restored"] == n_restored0 + 1
+    recs = [r for r in memprof.program_records() if r["kind"] == "disk"]
+    assert recs and recs[-1]["restored_bytes"] > 0
+    assert executor_cache.stats()["recompile_causes"] == {}
+
+
+# -- invalidation: never trust a bad entry ------------------------------------
+
+def test_version_mismatch_entries_coexist_per_toolchain(cache_dir,
+                                                        monkeypatch):
+    """The version fingerprint is part of the FILENAME: two toolchains
+    sharing one RW volume (rolling deploy) write DISTINCT entries
+    instead of mutually evicting each other's — and each restores its
+    own."""
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    (old_entry,) = _entry_files(cache_dir)
+
+    real = program_cache.version_fingerprint()
+    monkeypatch.setattr(program_cache, "version_fingerprint",
+                        lambda: dict(real, jax="99.99.99"))
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w:
+        _bind(sym).forward(is_train=False)  # "new toolchain": recompiles
+    assert w.total() == 1
+    files = _entry_files(cache_dir)
+    assert len(files) == 2 and old_entry in files, \
+        "the other toolchain's healthy entry must survive"
+    assert program_cache.stats()["evictions"] == 0
+    # and the "new toolchain" restores its own entry
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w2:
+        _bind(sym).forward(is_train=False)
+    assert w2.total() == 0
+
+
+def test_version_skew_header_evicts(cache_dir, caplog):
+    """A file whose HEADER fingerprint disagrees with this process
+    (tampering, or a filename collision) is never trusted: evicted with
+    a warning, recompiled."""
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    (entry,) = _entry_files(cache_dir)
+    path = os.path.join(cache_dir, entry)
+    header, blob = program_cache.ProgramStore.split(open(path, "rb").read())
+    header["fingerprint"] = dict(header["fingerprint"], jax="99.99.99")
+    with open(path, "wb") as f:
+        f.write(program_cache.ProgramStore.encode(header, blob))
+
+    executor_cache.clear()
+    ev0 = program_cache.stats()["evictions"]
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        out = _bind(sym).forward(is_train=False)[0].asnumpy()
+    assert program_cache.stats()["evictions"] == ev0 + 1
+    assert "version-skew" in caplog.text
+    assert np.isfinite(out).all()
+    # the fresh compile replaced it; a further bind restores cleanly
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w:
+        _bind(sym).forward(is_train=False)
+    assert w.total() == 0
+
+
+def test_corrupt_file_evicts_and_recompiles(cache_dir, caplog):
+    sym = _mlp()
+    out_cold = _bind(sym).forward(is_train=False)[0].asnumpy()
+    (entry,) = _entry_files(cache_dir)
+    path = os.path.join(cache_dir, entry)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # torn write, no atomic rename
+
+    executor_cache.clear()
+    ev0 = program_cache.stats()["evictions"]
+    w0 = program_cache.stats()["writes"]
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        with executor_cache.watch_traces() as w:
+            out = _bind(sym).forward(is_train=False)[0].asnumpy()
+    assert program_cache.stats()["evictions"] == ev0 + 1
+    assert "corrupt" in caplog.text
+    assert w.total() == 1, "must fall back to a fresh compile"
+    assert np.array_equal(out, out_cold)
+    # the fresh compile overwrote the evicted entry with a trusted one
+    assert program_cache.stats()["writes"] == w0 + 1
+    store = program_cache.get_store()
+    status, _, _ = store.decode(open(path, "rb").read())
+    assert status == "ok"
+
+
+def test_device_mismatch_evicts(cache_dir, caplog):
+    """An entry whose header names a different device kind is never
+    trusted (a shared volume written by a different chip generation)."""
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    (entry,) = _entry_files(cache_dir)
+    path = os.path.join(cache_dir, entry)
+    data = open(path, "rb").read()
+    header, blob = program_cache.ProgramStore.split(data)
+    header["device_kind"] = "TPU v99"
+    with open(path, "wb") as f:
+        f.write(program_cache.ProgramStore.encode(header, blob))
+
+    executor_cache.clear()
+    ev0 = program_cache.stats()["evictions"]
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        _bind(sym).forward(is_train=False)
+    assert program_cache.stats()["evictions"] == ev0 + 1
+    assert "device-mismatch" in caplog.text
+
+
+def test_renamed_entry_never_answers_for_another_program(cache_dir,
+                                                         caplog):
+    """A file copied/renamed onto another entry's path (same toolchain,
+    compatible avals) is an identity mismatch: evicted, recompiled —
+    never served as the wrong program."""
+    sym = _mlp()
+    exe = _bind(sym)
+    out_false = exe.forward(is_train=False)[0].asnumpy()
+    exe.forward(is_train=True)  # a second entry with identical avals
+    files = _entry_files(cache_dir)
+    assert len(files) == 2
+    # swap the two entries' bytes (an operator mixup): whichever file
+    # the next bind reads now claims the OTHER program's identity
+    a, b = (os.path.join(cache_dir, f) for f in files)
+    data_a, data_b = open(a, "rb").read(), open(b, "rb").read()
+    with open(a, "wb") as f:
+        f.write(data_b)
+    with open(b, "wb") as f:
+        f.write(data_a)
+
+    executor_cache.clear()
+    ev0 = program_cache.stats()["evictions"]
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        out = _bind(sym).forward(is_train=False)[0].asnumpy()
+    assert program_cache.stats()["evictions"] == ev0 + 1
+    assert "identity-mismatch" in caplog.text
+    assert np.array_equal(out, out_false)
+
+
+def test_read_only_mode_restores_but_never_writes(cache_dir, monkeypatch):
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)  # populate (writable)
+    files = _entry_files(cache_dir)
+
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_RO", "1")
+    executor_cache.clear()
+    w0 = program_cache.stats()["writes"]
+    with executor_cache.watch_traces() as w:
+        _bind(sym).forward(is_train=True)  # train=True: a NEW program
+    assert w.total() == 1  # is_train variant was never persisted
+    assert program_cache.stats()["writes"] == w0, "RO store wrote"
+    assert _entry_files(cache_dir) == files
+    # and the persisted is_train=False variant still restores
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w2:
+        _bind(sym).forward(is_train=False)
+    assert w2.total() == 0
+
+
+# -- off = today --------------------------------------------------------------
+
+def test_unset_env_is_todays_dispatchable(monkeypatch):
+    """Dir unset: the entry's fwd IS the pre-PR dispatchable (plain jit
+    here, memprof off) — not a disk wrapper."""
+    monkeypatch.delenv("MXNET_TPU_PROGRAM_CACHE_DIR", raising=False)
+    executor_cache.clear()
+    exe = _bind(_mlp())
+    assert not isinstance(exe._fwd_jit, program_cache.DiskCachedJit)
+    assert not program_cache.enabled()
+    assert executor_cache.stats()["disk"]["enabled"] is False
+
+
+# -- serving ------------------------------------------------------------------
+
+def _serve_model():
+    sym = _mlp()
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 6))
+    r = np.random.RandomState(1)
+    params = {n: mx.nd.array(r.normal(0, 0.1, s).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n not in ("data", "softmax_label")}
+    return sym, params
+
+
+def test_serving_warm_dir_zero_compile_and_prewarm(cache_dir):
+    from mxnet_tpu import serving
+    sym, params = _serve_model()
+    cold = serving.Server(max_batch_size=4)
+    cold.add_model("m", sym, params, input_shapes={"data": (6,)})
+    rep = cold.prewarm()
+    assert rep["cache_dir"] == cache_dir
+    assert rep["disk_writes"] >= len(rep["models"]["m"]["buckets"])
+    x = np.linspace(0, 1, 2 * 6, dtype=np.float32).reshape(2, 6)
+    out_cold = cold.submit("m", {"data": x})
+    cold.close()
+
+    executor_cache.clear()
+    warm = serving.Server(max_batch_size=4)
+    warm.add_model("m", sym, params, input_shapes={"data": (6,)})
+    totals0 = memprof.build_totals()
+    with executor_cache.watch_traces() as w:
+        report = warm.warmup(expect_warm=True)
+    totals = memprof.build_totals()
+    assert w.total() == 0
+    assert totals["built"] == totals0["built"]
+    assert totals["backend_compiles"] == totals0["backend_compiles"]
+    assert report["warm_start"]["disk_restores"] >= 3
+    out_warm = warm.submit("m", {"data": x})
+    warm.close()
+    assert all(np.array_equal(a, b) for a, b in zip(out_cold, out_warm))
+
+
+def test_serving_expect_warm_on_cold_dir_raises(cache_dir):
+    from mxnet_tpu import serving
+    sym, params = _serve_model()
+    srv = serving.Server(max_batch_size=4)
+    srv.add_model("m", sym, params, input_shapes={"data": (6,)})
+    with pytest.raises(MXNetError, match="warm-start verification"):
+        srv.warmup(expect_warm=True)
+    srv.close()
+
+
+def test_served_model_prewarm_requires_dir(monkeypatch):
+    from mxnet_tpu import serving
+    monkeypatch.delenv("MXNET_TPU_PROGRAM_CACHE_DIR", raising=False)
+    executor_cache.clear()
+    sym, params = _serve_model()
+    srv = serving.Server(max_batch_size=4)
+    srv.add_model("m", sym, params, input_shapes={"data": (6,)})
+    with pytest.raises(MXNetError, match="MXNET_TPU_PROGRAM_CACHE_DIR"):
+        srv.prewarm()
+    srv.close()
+
+
+# -- concurrency: the atomic-rename contract ----------------------------------
+
+def test_interleaved_writers_never_publish_a_torn_entry(cache_dir):
+    """N threads re-saving the SAME entry while a reader validates every
+    published byte: os.replace publishes whole files only.  (The
+    regression this pins: writing in place would interleave and the
+    reader would observe a corrupt container.)"""
+    sym = _mlp()
+    exe = _bind(sym)
+    exe.forward(is_train=False)
+    store = program_cache.get_store()
+    (entry,) = _entry_files(cache_dir)
+    path = os.path.join(cache_dir, entry)
+    good = open(path, "rb").read()
+    header, blob = program_cache.ProgramStore.split(good)
+
+    stop = threading.Event()
+    bad = []
+
+    def writer(base):
+        i = 0
+        while not stop.is_set():
+            # full save path: temp file with a unique-per-writer counter
+            # suffix (the store uses a process-global itertools.count),
+            # then the atomic publish
+            data = program_cache.ProgramStore.encode(header, blob)
+            tmp = "%s.tmp.%d.%d" % (path, os.getpid(), base + i)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                data = open(path, "rb").read()
+            except FileNotFoundError:
+                continue
+            h, b = program_cache.ProgramStore.split(data)
+            if h is None or len(b) != h["blob_bytes"]:
+                bad.append(len(data))
+
+    threads = [threading.Thread(target=writer, args=(10_000,)),
+               threading.Thread(target=writer, args=(20_000,))] + \
+              [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not bad, "reader observed torn entries: %s" % bad[:5]
+    # and the survivor still restores
+    executor_cache.clear()
+    with executor_cache.watch_traces() as w:
+        _bind(sym).forward(is_train=False)
+    assert w.total() == 0
+
+
+# -- observability ------------------------------------------------------------
+
+def test_stats_and_telemetry_counters(cache_dir):
+    telemetry.reset()
+    program_cache.reset_stats()
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    executor_cache.clear()
+    _bind(sym).forward(is_train=False)
+
+    disk = executor_cache.stats()["disk"]
+    assert disk["enabled"] and disk["dir"] == cache_dir
+    assert disk["writes"] == 1 and disk["hits"] == 1
+    assert disk["misses"] == 1  # the cold lookup before the compile
+    assert disk["bytes_written"] > 0 and disk["bytes_read"] > 0
+    snap = telemetry.snapshot()
+    assert snap["exec_cache.disk.writes"]["value"] == 1
+    assert snap["exec_cache.disk.hits"]["value"] == 1
+    assert snap["exec_cache.disk.bytes_read"]["value"] > 0
+    # memprof.report() carries the disk section traceview renders
+    assert memprof.report()["disk"]["hits"] == 1
+
+
+# -- cachectl -----------------------------------------------------------------
+
+def _cachectl(*args):
+    return subprocess.run(
+        [sys.executable, _CACHECTL] + list(args),
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cachectl_ls_verify_prune(cache_dir):
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    _bind(sym).forward(is_train=True)
+    files = _entry_files(cache_dir)
+    assert len(files) == 2
+
+    r = _cachectl("ls", "--dir", cache_dir, "--json")
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert len(doc["entries"]) == 2
+    assert all(e["label"].startswith("softmax@") for e in doc["entries"])
+    assert all(e["jax"] != "?" for e in doc["entries"])
+
+    r = _cachectl("verify", "--dir", cache_dir, "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["bad"] == 0
+
+    # a mixed-toolchain volume (rolling deploy) verifies CLEAN: re-key
+    # one entry to a fake toolchain, header and filename consistent
+    path = os.path.join(cache_dir, files[1])
+    header, blob = program_cache.ProgramStore.split(
+        open(path, "rb").read())
+    fake = dict(header["fingerprint"], jax="99.99.99")
+    header["fingerprint"] = fake
+    stem, _vfp, ext = files[1].rsplit(".", 2)
+    other = os.path.join(cache_dir, "%s.%s.%s"
+                         % (stem, program_cache.fingerprint(fake)[:10],
+                            ext))
+    with open(other, "wb") as f:
+        f.write(program_cache.ProgramStore.encode(header, blob))
+    os.remove(path)
+    r = _cachectl("verify", "--dir", cache_dir, "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    statuses = sorted(e["status"] for e in doc["entries"])
+    assert statuses == ["ok", "other-toolchain"], statuses
+
+    # corrupt the native entry: verify must exit 1 naming it
+    path = os.path.join(cache_dir, files[0])
+    with open(path, "r+b") as f:
+        f.truncate(100)
+    r = _cachectl("verify", "--dir", cache_dir, "--json")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["bad"] == 1
+
+    # prune: corrupt entries always go; then the budget applies
+    r = _cachectl("prune", "--dir", cache_dir, "--max-bytes", "0",
+                  "--json")
+    assert r.returncode == 0, r.stderr
+    assert len(json.loads(r.stdout)["removed"]) == 2
+    assert _entry_files(cache_dir) == []
+
+
+def test_prewarm_read_only_raises(cache_dir, monkeypatch):
+    """A deploy pipeline that inherits the replicas' RO env must fail
+    loudly at prewarm time, not ship an empty volume."""
+    from mxnet_tpu import serving
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_RO", "1")
+    sym, params = _serve_model()
+    srv = serving.Server(max_batch_size=4)
+    srv.add_model("m", sym, params, input_shapes={"data": (6,)})
+    with pytest.raises(MXNetError, match="MXNET_TPU_PROGRAM_CACHE_RO"):
+        srv.prewarm()
+    srv.close()
+
+
+def test_optimizer_fingerprint_exact_or_declines():
+    """Traced optimizer constants key the entry EXACTLY: numpy tables
+    are content-hashed (different table -> different key), and an
+    attribute that cannot be keyed faithfully is reported so the caller
+    declines to cache instead of aliasing two programs."""
+    a = mx.optimizer.create("sgd", learning_rate=0.1)
+    b = mx.optimizer.create("sgd", learning_rate=0.1)
+    a.table = np.array([1.0, 2.0], np.float32)
+    b.table = np.array([1.0, 3.0], np.float32)
+    fp_a, un_a = program_cache.optimizer_fingerprint(a)
+    fp_b, un_b = program_cache.optimizer_fingerprint(b)
+    assert un_a == () and un_b == ()
+    assert fp_a != fp_b, "different baked tables must not alias"
+    b.table = np.array([1.0, 2.0], np.float32)
+    assert program_cache.optimizer_fingerprint(b)[0] == fp_a
+
+    c = mx.optimizer.create("sgd", learning_rate=0.1)
+    c.schedule = object()  # opaque: could be baked, cannot be keyed
+    _, unkeyable = program_cache.optimizer_fingerprint(c)
+    assert "schedule" in unkeyable
+    # arg-fed framework attrs never poison the key
+    d = mx.optimizer.create("sgd", learning_rate=0.1,
+                            lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                                step=10, factor=0.9))
+    assert program_cache.optimizer_fingerprint(d)[1] == ()
+
+
+def test_unkeyable_optimizer_disables_fused_step_disk(cache_dir, caplog):
+    """An optimizer carrying an opaque attribute trains fine but its
+    fused step is NOT persisted (warning names the attribute); entry
+    programs still persist."""
+    mx.random.seed(11)
+    r = np.random.RandomState(0)
+    X = r.randn(32, 6).astype(np.float32)
+    Y = r.randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    opt.schedule = object()
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        mod.init_optimizer(optimizer=opt)
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    assert "cannot key the disk entry" in caplog.text
+    assert not any(".fused_step." in f for f in _entry_files(cache_dir))
+
+
+def test_exec_cache_disabled_still_uses_disk(cache_dir, monkeypatch):
+    """MXNET_TPU_EXEC_CACHE=0 (no in-process sharing) still restores
+    from the disk tier — each private build checks disk first."""
+    monkeypatch.setenv("MXNET_TPU_EXEC_CACHE", "0")
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    assert program_cache.stats()["writes"] == 1
+    with executor_cache.watch_traces() as w:
+        _bind(sym).forward(is_train=False)  # private entry, disk hit
+    assert w.total() == 0
+    assert program_cache.stats()["hits"] == 1

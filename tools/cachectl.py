@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""cachectl: operate a persistent compiled-program cache volume.
+
+The disk tier (mxnet_tpu/program_cache.py, MXNET_TPU_PROGRAM_CACHE_DIR)
+stores one file per compiled executable.  Operators managing a shared
+cache volume — pruning a deploy pipeline's output, debugging a replica
+that recompiles when it should restore — should never have to read
+pickle innards; this CLI is the admin surface:
+
+    python tools/cachectl.py ls       [--dir D] [--json]
+    python tools/cachectl.py verify   [--dir D] [--json]
+    python tools/cachectl.py prune    [--dir D] [--max-bytes N] [--stale]
+                                      [--dry-run]
+
+`ls` lists every entry from its header alone (symbol label, program
+kind, signature fingerprint, bytes, age, jax fingerprint) — no pickle
+is touched.  `verify` RELOADS every entry through the same validation
+the restore path uses (magic, sha256, version fingerprint, device kind,
+full deserialization) and reports ok/corrupt/version-skew/
+device-mismatch per entry, exit 1 when any entry is untrusted.  `prune`
+deletes: `--stale` drops entries whose version fingerprint no longer
+matches this process's toolchain, `--max-bytes N` then drops
+oldest-first until the directory fits.  Neither mode ever deletes a
+trusted, in-budget entry.
+
+The directory comes from `--dir` or the env var.  Verification runs on
+the OPERATOR'S toolchain: run it with the same jax/jaxlib/libtpu the
+replicas ship, or healthy entries will read as version-skew.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _store(args):
+    from mxnet_tpu import program_cache
+    root = args.dir or program_cache.cache_dir()
+    if not root:
+        sys.stderr.write(
+            "cachectl: no cache directory (pass --dir or set %s)\n"
+            % program_cache.ENV_DIR)
+        sys.exit(2)
+    if not os.path.isdir(root):
+        sys.stderr.write("cachectl: %s is not a directory\n" % root)
+        sys.exit(2)
+    # never evict from the CLI's read path: verify reports, prune deletes
+    return program_cache.ProgramStore(root, ro=True)
+
+
+def _entry_rows(store):
+    """One row per entry file: header fields + file stat.  A file whose
+    container framing is unreadable still gets a row (status corrupt) —
+    an operator must see it to prune it."""
+    rows = []
+    for path in store.entries():
+        try:
+            header, size = store.read_header_file(path)  # bounded read
+            mtime = os.path.getmtime(path)
+        except OSError as exc:
+            rows.append({"file": os.path.basename(path), "path": path,
+                         "status": "unreadable", "error": str(exc)})
+            continue
+        header = header or {}
+        fp = header.get("fingerprint") or {}
+        rows.append({
+            "file": os.path.basename(path), "path": path,
+            "bytes": size, "mtime": mtime,
+            "label": header.get("label", "?"),
+            "kind": header.get("kind", "?"),
+            "entry_fp": header.get("entry_fp", "?"),
+            "arg_fp": header.get("arg_fp", "?"),
+            "platform": header.get("platform", "?"),
+            "device_kind": header.get("device_kind", ""),
+            "jax": fp.get("jax", "?"), "jaxlib": fp.get("jaxlib", "?"),
+            "libtpu": fp.get("libtpu", ""),
+            "mxnet_tpu": fp.get("mxnet_tpu", "?"),
+            "fingerprint": fp,
+            "status": "header-ok" if header else "corrupt",
+        })
+    return rows
+
+
+_TRACEVIEW = None
+
+
+def _fmt_bytes(n):
+    """traceview's byte formatter, loaded by path once (one definition
+    for every operator-facing byte count; traceview is stdlib-only)."""
+    global _TRACEVIEW
+    if _TRACEVIEW is None:
+        import importlib.util
+        tv_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "traceview.py")
+        spec = importlib.util.spec_from_file_location(
+            "_cachectl_traceview", tv_path)
+        _TRACEVIEW = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_TRACEVIEW)
+    return _TRACEVIEW._fmt_bytes(n)
+
+
+def cmd_ls(args):
+    store = _store(args)
+    rows = _entry_rows(store)
+    if args.json:
+        print(json.dumps({"dir": store.root, "entries": rows}))
+        return 0
+    if not rows:
+        print("(empty cache dir %s)" % store.root)
+        return 0
+    print("%-34s %-12s %-12s %10s %8s  %s"
+          % ("Program", "Kind", "Signature", "Bytes", "Age", "Toolchain"))
+    now = time.time()
+    total = 0
+    for r in rows:
+        total += r.get("bytes", 0)
+        age_s = now - r.get("mtime", now)
+        age = "%dd" % (age_s // 86400) if age_s >= 86400 \
+            else "%dh" % (age_s // 3600) if age_s >= 3600 \
+            else "%dm" % (age_s // 60)
+        tool = "jax %s/%s%s" % (r.get("jax", "?"), r.get("jaxlib", "?"),
+                                " libtpu " + r["libtpu"]
+                                if r.get("libtpu") else "")
+        print("%-34s %-12s %-12s %10s %8s  %s"
+              % (str(r.get("label", "?"))[:34],
+                 str(r.get("kind", "?"))[:12],
+                 str(r.get("entry_fp", "?"))[:12],
+                 _fmt_bytes(r.get("bytes", 0)), age, tool))
+    print("%d entries, %s total in %s"
+          % (len(rows), _fmt_bytes(total), store.root))
+    return 0
+
+
+def cmd_verify(args):
+    from mxnet_tpu import program_cache
+    store = _store(args)
+    results = []
+    bad = 0
+    for path in store.entries():
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as exc:
+            results.append({"file": os.path.basename(path),
+                            "status": "unreadable", "error": str(exc)})
+            bad += 1
+            continue
+        status, header, _loaded = store.decode(data)
+        if status == "version-skew" and header:
+            # mixed-toolchain volumes are the DOCUMENTED rolling-deploy
+            # state (version_fp is part of the filename): an entry whose
+            # header fingerprint is self-consistent with its filename
+            # segment belongs to another toolchain and is healthy —
+            # informational, not untrusted.  A disagreement between the
+            # two IS suspect.
+            vfp = program_cache.fingerprint(
+                header.get("fingerprint", {}))[:10]
+            name_vfp = os.path.basename(path).rsplit(".", 2)[-2]
+            if vfp == name_vfp:
+                status = "other-toolchain"
+        results.append({"file": os.path.basename(path), "status": status,
+                        "label": (header or {}).get("label", "?"),
+                        "kind": (header or {}).get("kind", "?"),
+                        "bytes": len(data)})
+        if status not in ("ok", "other-toolchain"):
+            bad += 1
+    if args.json:
+        print(json.dumps({"dir": store.root, "entries": results,
+                          "bad": bad}))
+    else:
+        for r in results:
+            marker = "ok " if r["status"] in ("ok", "other-toolchain") \
+                else "BAD"
+            print("%s %-15s %-34s %s"
+                  % (marker, r["status"], str(r.get("label", "?"))[:34],
+                     r["file"]))
+        print("%d entries verified, %d untrusted"
+              % (len(results), bad))
+    return 1 if bad else 0
+
+
+def cmd_prune(args):
+    if args.max_bytes is None and not args.stale:
+        sys.stderr.write("cachectl prune: nothing to do "
+                         "(pass --max-bytes and/or --stale)\n")
+        return 2
+    from mxnet_tpu import program_cache
+    store = _store(args)
+    doomed = []
+    rows = _entry_rows(store)
+    current = program_cache.version_fingerprint()
+    keep = []
+    for r in rows:
+        if r["status"] in ("unreadable", "corrupt"):
+            doomed.append((r, "corrupt"))
+        elif args.stale and r.get("fingerprint") != current:
+            # the FULL fingerprint: toolchain versions AND the compile
+            # environment (XLA_FLAGS, precision/prng config)
+            doomed.append((r, "stale"))
+        else:
+            keep.append(r)
+    if args.max_bytes is not None:
+        # oldest-first until the surviving set fits the budget
+        keep.sort(key=lambda r: r.get("mtime", 0))
+        total = sum(r.get("bytes", 0) for r in keep)
+        while keep and total > args.max_bytes:
+            r = keep.pop(0)
+            total -= r.get("bytes", 0)
+            doomed.append((r, "over-budget"))
+    removed = []
+    for r, why in doomed:
+        if not args.dry_run:
+            try:
+                os.remove(r["path"])
+            except OSError as exc:
+                print("could not remove %s: %s" % (r["file"], exc))
+                continue
+        removed.append({"file": r["file"], "reason": why,
+                        "bytes": r.get("bytes", 0)})
+    if args.json:
+        print(json.dumps({"dir": store.root, "removed": removed,
+                          "dry_run": bool(args.dry_run)}))
+    else:
+        for r in removed:
+            print("%s %-12s %s (%s)"
+                  % ("would remove" if args.dry_run else "removed",
+                     r["reason"], r["file"], _fmt_bytes(r["bytes"])))
+        print("%d entries %s" % (len(removed),
+                                 "matched" if args.dry_run else "removed"))
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="cachectl",
+        description="manage a persistent compiled-program cache volume")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("ls", cmd_ls), ("verify", cmd_verify),
+                     ("prune", cmd_prune)):
+        p = sub.add_parser(name)
+        p.add_argument("--dir", default=None,
+                       help="cache directory (default: "
+                            "MXNET_TPU_PROGRAM_CACHE_DIR)")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+        p.set_defaults(fn=fn)
+        if name == "prune":
+            p.add_argument("--max-bytes", type=int, default=None,
+                           help="delete oldest entries until the dir "
+                                "fits this budget")
+            p.add_argument("--stale", action="store_true",
+                           help="delete entries whose toolchain "
+                                "fingerprint no longer matches")
+            p.add_argument("--dry-run", action="store_true",
+                           help="report what would be deleted")
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

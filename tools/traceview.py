@@ -442,11 +442,29 @@ def summarize_memory(memdoc, top=20):
                             _fmt_bytes(m.get("temp_bytes", 0)),
                             _fmt_bytes(m.get("total_bytes", 0))))
     compiled = [p for p in programs if _fnum(p.get("compile_ms"), 0.0) > 0]
-    if compiled:
+    restored = [p for p in programs if p.get("kind") == "disk"]
+    if compiled or restored:
         total_ms = sum(_fnum(p["compile_ms"], 0.0) for p in compiled)
         lines.append("programs recorded: %d   backend compiles: %d   "
-                     "compile time: %.1f ms total"
-                     % (len(programs), len(compiled), total_ms))
+                     "compile time: %.1f ms total   disk restores: %d"
+                     % (len(programs), len(compiled), total_ms,
+                        len(restored)))
+    disk = memdoc.get("disk")
+    lines.append("")
+    lines.append("== memory: persistent program cache (disk tier) ==")
+    if not disk or not disk.get("enabled"):
+        lines.append("(disabled — set MXNET_TPU_PROGRAM_CACHE_DIR to "
+                     "persist compiled executables across processes)")
+    else:
+        lines.append("dir %s%s" % (disk.get("dir"),
+                                   "   [read-only]"
+                                   if disk.get("read_only") else ""))
+        lines.append("hits %d   misses %d   evictions %d   writes %d   "
+                     "written %s   read %s"
+                     % (disk.get("hits", 0), disk.get("misses", 0),
+                        disk.get("evictions", 0), disk.get("writes", 0),
+                        _fmt_bytes(disk.get("bytes_written", 0)),
+                        _fmt_bytes(disk.get("bytes_read", 0))))
     lines.append("")
     lines.append("== memory: live-array census (by shape/dtype) ==")
     census = memdoc.get("census") or {}
