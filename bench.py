@@ -1632,6 +1632,204 @@ def comm_smoke():
     }))
 
 
+def tune_smoke():
+    """Autotune CI mode (`make bench-smoke` step 9, `bench.py
+    --tune-smoke`): closes the observability loop into control
+    (observability/autotune.py, docs/autotune.md) on the 8-virtual-
+    device cpu harness:
+
+    1. **ServingBucketTuner**: skewed synthetic request sizes through
+       the power-of-two default, then the tuner derives a
+       traffic-shaped bucket set from the recorded
+       ``serving.request_rows`` histogram, stages it, and a re-warmup
+       adopts it — the SAME traffic replayed must cut
+       ``serving.padded_rows_total`` by >= 30% with ZERO steady-state
+       retraces after the re-warmup;
+    2. **CommBucketTuner**: hill-climbs ``MXNET_TPU_COMM_BUCKET_MB``
+       over short DP-8 training windows, each candidate costing exactly
+       one fused-step retrace (the PR 10 cache-key contract), and
+       converges within its <= 4-retrace budget;
+    3. **decision log**: every decision rides the flight recorder —
+       a flight dump's ``tuning`` section parses through
+       ``tools/traceview.py --tuning``, and the APPLIED serving change
+       has a matching record recoverable from the dump.
+    """
+    import os
+    import sys as _sys
+    import time as _time
+
+    assert "jax" not in _sys.modules, \
+        "--tune-smoke must run in a fresh process (it shapes XLA_FLAGS)"
+    xla = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        os.environ["XLA_FLAGS"] = \
+            (xla + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    for knob in ("MXNET_TPU_COMM_BUCKET_MB", "MXNET_TPU_GRAD_COMPRESS",
+                 "MXNET_TPU_AUTOTUNE",
+                 "MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS",
+                 "MXNET_TPU_SERVING_QUEUE_DEPTH"):
+        os.environ.pop(knob, None)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, serving
+    from mxnet_tpu.observability import (autotune, flight_recorder,
+                                         telemetry)
+    from mxnet_tpu.parallel import comm
+
+    rng = np.random.RandomState(0)
+    telemetry.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    autotune.clear_decisions()
+
+    # -- 1. serving: traffic-shaped buckets beat power-of-two ----------
+    FEAT = 8
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, FEAT))
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+
+    # window 0 + serial blocking submits: one request per batch, so the
+    # padded-rows comparison is deterministic traffic arithmetic
+    server = serving.Server(max_batch_size=16, batch_window_ms=0.0)
+    model = server.add_model("mlp", sym, arg_params,
+                             input_shapes={"data": (FEAT,)})
+    server.warmup()
+    buckets_po2 = list(model.buckets)
+
+    # skewed sizes: a 5-row mode the power-of-two table pads 3 rows each
+    sizes = [5] * 40 + [3] * 12 + [16] * 4
+    traffic_rng = np.random.RandomState(3)
+
+    def serve_traffic():
+        for n in sizes:
+            server.submit("mlp", {"data": traffic_rng.normal(
+                0, 1, (n, FEAT)).astype(np.float32)})
+
+    padded = telemetry.counter("serving.padded_rows_total")
+    p0 = padded.value
+    serve_traffic()
+    padded_po2 = padded.value - p0
+    assert padded_po2 > 0, "skewed traffic must pad under power-of-two"
+
+    os.environ["MXNET_TPU_AUTOTUNE"] = "apply"
+    serving_rec = autotune.ServingBucketTuner().run(model)
+    assert serving_rec["action"] == "apply", serving_rec
+    assert model.pending_buckets() == serving_rec["decision"]["buckets"]
+    server.warmup()  # adopts the staged set, traces it, verifies
+    buckets_shaped = list(model.buckets)
+    assert buckets_shaped == serving_rec["decision"]["buckets"]
+
+    p1 = padded.value
+    with executor_cache.watch_traces() as w:
+        serve_traffic()
+    assert w.total() == 0, (
+        "steady-state retraces after re-warmup: %s" % w.delta())
+    padded_shaped = padded.value - p1
+    reduction = 1.0 - padded_shaped / padded_po2
+    assert reduction >= 0.30, (
+        "traffic-shaped buckets must cut padded rows >= 30%%: "
+        "%d -> %d (%.1f%%)" % (padded_po2, padded_shaped,
+                               reduction * 100.0))
+    server.close()
+
+    # -- 2. comm tuner: hill-climb within the retrace budget -----------
+    n_dev = 8
+    W = rng.randn(16, 4)
+    X = rng.randn(512, 16).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    def mlp_train():
+        h = mx.sym.Activation(mx.sym.FullyConnected(
+            mx.sym.var("data"), num_hidden=32, name="fc1"),
+            act_type="relu")
+        return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            h, num_hidden=4, name="fc2"), name="softmax")
+
+    def measure(bucket_mb):
+        """Cost of one candidate: a fresh DP-8 fit whose FIRST epoch
+        compiles the re-keyed fused step (the retrace the tuner
+        budgets) and whose steady epochs are timed — the median keeps
+        cpu-harness noise out of the climb."""
+        mx.random.seed(0)
+        it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=False)
+        mod = mx.mod.Module(mlp_train(),
+                            context=[mx.cpu(i) for i in range(n_dev)])
+        marks = []
+        mod.fit(it, num_epoch=4, kvstore="tpu_ici",
+                optimizer_params={"learning_rate": 0.1,
+                                  "momentum": 0.9},
+                initializer=mx.initializer.Xavier(
+                    rnd_type="uniform", magnitude=2.0),
+                epoch_end_callback=lambda *a: marks.append(
+                    _time.monotonic()))
+        warm = sorted(b - a for a, b in zip(marks[1:], marks[2:]))
+        return warm[len(warm) // 2] * 1e3  # median warm epoch, ms
+
+    budget = 4
+    comm_tuner = autotune.CommBucketTuner(measure, budget=budget,
+                                          mode="recommend",
+                                          start_mb=0.002,
+                                          min_mb=0.0005, max_mb=64.0)
+    comm_rec = comm_tuner.run()
+    assert comm_rec is not None
+    assert comm_rec["action"] in ("recommend", "stop"), comm_rec
+    spent = comm_rec["cost"]["retraces"]
+    assert spent <= budget, comm_rec["cost"]
+    assert len(comm_rec["candidates"]) >= 2, comm_rec["candidates"]
+    # the PR 10 cache-key contract, observed: every candidate (a fresh
+    # module per measurement window) costs exactly one fused-step
+    # retrace — the budget buys bucket sizes, nothing hidden
+    for trial in comm_rec["candidates"]:
+        assert trial["retraces"] == 1, comm_rec["candidates"]
+    # recommend mode leaves the knob exactly as found (unset here)
+    assert comm.BUCKET_ENV not in os.environ
+
+    # -- 3. the decision log rides the flight recorder -----------------
+    dump_path = "/tmp/mxnet_tpu_tune_smoke_flight.json"
+    assert flight_recorder.dump(path=dump_path,
+                                reason="tune_smoke") == dump_path
+    doc = json.load(open(dump_path))
+    tv = _load_traceview()
+    records = tv.tuning_records(doc)
+    stats = tv.tuning_stats(records)
+    assert stats["by_controller"].get("serving_buckets") == 1, stats
+    assert stats["by_controller"].get("comm_bucket") == 1, stats
+    # the applied change is recoverable from the dump alone
+    applied = [r for r in records if r["action"] == "apply"]
+    assert applied and applied[0]["controller"] == "serving_buckets"
+    assert applied[0]["decision"]["buckets"] == buckets_shaped
+    assert tv.main(["--tuning", dump_path]) == 0
+
+    print(json.dumps({
+        "metric": "bench_tune_smoke",
+        "buckets_po2": buckets_po2,
+        "buckets_shaped": buckets_shaped,
+        "padded_rows_po2": padded_po2,
+        "padded_rows_shaped": padded_shaped,
+        "padded_reduction_frac": round(reduction, 4),
+        "steady_state_retraces": 0,
+        "comm": {"decision_mb": comm_rec["decision"]["bucket_mb"],
+                 "candidates": [t["bucket_mb"]
+                                for t in comm_rec["candidates"]],
+                 "retraces_spent": spent,
+                 "retrace_budget": budget,
+                 "budget_exhausted":
+                     comm_rec["decision"]["budget_exhausted"]},
+        "flight_dump": dump_path,
+        "decisions_in_dump": stats["decisions"],
+    }))
+
+
 def coldstart_smoke():
     """Cold-start economics CI mode (`make bench-smoke` step 8,
     `bench.py --coldstart-smoke`): proves the persistent compiled-
@@ -1836,6 +2034,8 @@ if __name__ == "__main__":
         mem_smoke()
     elif "--comm-smoke" in sys.argv:
         comm_smoke()
+    elif "--tune-smoke" in sys.argv:
+        tune_smoke()
     elif "--coldstart-smoke" in sys.argv:
         coldstart_smoke()
     elif "--coldstart-child" in sys.argv:

@@ -28,6 +28,12 @@ Three layers, lowest first:
   ``memory_analysis`` byte attribution (``MXNET_TPU_MEMPROF=1``), the
   live-array census, and the OOM black box
   (docs/observability.md §memory).
+- ``autotune`` — the CONTROL half of the loop: controllers that turn
+  the recorded signals above into bounded, auditable configuration
+  changes (comm bucket size, traffic-shaped serving buckets, io worker
+  counts) behind ``MXNET_TPU_AUTOTUNE=recommend|apply|0``, every
+  decision a structured record riding the flight recorder
+  (docs/autotune.md).
 
 Every callsite stays OUTSIDE jitted bodies: instrumentation must never
 change a traced program (the exec-cache trace counters prove it adds
@@ -41,11 +47,12 @@ from . import instrument
 from . import flight_recorder
 from . import health
 from . import memprof
+from . import autotune
 from .tracing import span, emit_instant
 from .telemetry import counter, gauge, histogram, snapshot
 from .health import HealthMonitor, TrainingDivergedError
 
 __all__ = ["tracing", "telemetry", "instrument", "flight_recorder",
-           "health", "memprof", "span", "emit_instant", "counter",
-           "gauge", "histogram", "snapshot", "HealthMonitor",
+           "health", "memprof", "autotune", "span", "emit_instant",
+           "counter", "gauge", "histogram", "snapshot", "HealthMonitor",
            "TrainingDivergedError"]

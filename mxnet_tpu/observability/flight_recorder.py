@@ -6,8 +6,9 @@ the last ``MXNET_TPU_FLIGHT_STEPS`` (default 512) per-step records
 (health summary, step-breakdown timings, exec-cache trace counters),
 the last 200 ``mxnet_tpu.*`` log records (via a handler on the package
 root logger), recent discrete events (anomalies, serving failures,
-exceptions) — plus an env/config fingerprint, and dumps them all as ONE
-strict-JSON file:
+exceptions), the last 128 autotune decision records
+(observability/autotune.py; rendered by ``traceview --tuning``) — plus
+an env/config fingerprint, and dumps them all as ONE strict-JSON file:
 
 - on anomaly (``HealthMonitor`` actions ``dump``/``raise``),
 - on unhandled exception in ``fit`` / the serving dispatch thread
@@ -41,6 +42,7 @@ _PATH_ENV = "MXNET_TPU_FLIGHT_PATH"
 DEFAULT_STEPS = 512
 LOG_CAPACITY = 200
 EVENT_CAPACITY = 64
+DECISION_CAPACITY = 128
 
 # env fingerprint: every knob that could explain a divergence later
 _FINGERPRINT_PREFIXES = ("MXNET_TPU_", "JAX_", "XLA_", "DMLC_")
@@ -118,6 +120,7 @@ class FlightRecorder:
         self._steps = deque(maxlen=self.capacity)
         self._events = deque(maxlen=EVENT_CAPACITY)
         self._logs = deque(maxlen=LOG_CAPACITY)
+        self._decisions = deque(maxlen=DECISION_CAPACITY)
         self._anomalies = []
         self._handler = None
         self._dumped_reasons = set()
@@ -171,6 +174,20 @@ class FlightRecorder:
             event["payload"] = payload
         with self._lock:
             self._events.append(event)
+
+    def note_decision(self, record):
+        """One autotune decision record (observability/autotune.py) —
+        kept in its own bounded ring (not the 64-slot event ring, which
+        anomalies and serving failures share) so every applied
+        configuration change is recoverable from a flight dump
+        (``tools/traceview.py --tuning`` renders the ``tuning``
+        section)."""
+        with self._lock:
+            self._decisions.append(dict(record))
+
+    def decisions_recorded(self):
+        with self._lock:
+            return len(self._decisions)
 
     def note_anomaly(self, record):
         """A fired health anomaly (called by ``HealthMonitor``)."""
@@ -272,6 +289,7 @@ class FlightRecorder:
                 "first_anomaly_step": (self._anomalies[0]["step"]
                                        if self._anomalies else None),
                 "logs": list(self._logs),
+                "tuning": list(self._decisions),
             }
         doc["telemetry"] = telemetry_snap
         if sections:

@@ -157,6 +157,13 @@ class Histogram:
     def mean(self):
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q):
+        """Quantile estimate over the live histogram — the shared
+        estimator every autotune controller uses (one definition, not
+        three ad-hoc percentile snippets).  See
+        :func:`quantile_from_snapshot` for the interpolation contract."""
+        return quantile_from_snapshot(self._snapshot(), q)
+
     def _snapshot(self):
         with self._lock:
             return {"type": self.kind, "count": self.count,
@@ -192,6 +199,72 @@ class _Noop:
 
 
 NOOP = _Noop()
+
+
+def _snap_bound(snap, key):
+    """The recorded min/max of a snapshot as a finite float, or None
+    (empty histograms and JSON-lines string tokens both end up None)."""
+    v = snap.get(key)
+    return float(v) if isinstance(v, (int, float)) \
+        and math.isfinite(v) else None
+
+
+def iter_bucket_ranges(snap):
+    """Yield ``(lo, hi, count)`` for every non-empty bucket of a
+    histogram snapshot — the ONE place the fixed log2 geometry is
+    decoded (bucket ``i`` covers ``(BUCKET_BOUNDS[i-1],
+    BUCKET_BOUNDS[i]]``, bucket 0 everything below the first bound, the
+    overflow bucket ``(BUCKET_BOUNDS[-1], recorded max]``).  Both the
+    quantile estimator below and the autotune padding estimator build
+    on this instead of re-deriving the bounds."""
+    buckets = snap.get("buckets") or []
+    mx = _snap_bound(snap, "max")
+    for i, n in enumerate(buckets):
+        if not n:
+            continue
+        if i < len(BUCKET_BOUNDS):
+            lo = 0.0 if i == 0 else BUCKET_BOUNDS[i - 1]
+            hi = BUCKET_BOUNDS[i]
+        else:  # overflow: the recorded max is the only upper bound
+            lo = BUCKET_BOUNDS[-1]
+            hi = mx if mx is not None else BUCKET_BOUNDS[-1] * 2
+        yield lo, hi, n
+
+
+def quantile_from_snapshot(snap, q):
+    """Quantile estimate from a log2-bucket histogram snapshot (the
+    ``_snapshot()``/``snapshot()``/``parse_json_lines`` dict shape).
+
+    The fixed buckets only bound each observation by a power of two, so
+    the estimator interpolates LINEARLY inside the bucket holding the
+    q-th observation — ``(lo, hi]`` with ``lo = hi/2`` — and clamps the
+    result to the recorded ``min``/``max``.  The clamp makes the edges
+    exact: a histogram holding one distinct value returns that value
+    for every q, and ``q=0``/``q=1`` return min/max.  The +Inf overflow
+    bucket interpolates toward the recorded max (the only upper bound
+    it has).  Returns 0.0 for an empty histogram.
+    """
+    count = snap.get("count", 0) or 0
+    if count <= 0:
+        return 0.0
+    mn = _snap_bound(snap, "min")
+    mx = _snap_bound(snap, "max")
+    q = min(1.0, max(0.0, float(q)))
+    # rank of the target observation, 1-based; q=0 -> the first
+    target = max(1.0, q * count)
+    cumulative = 0
+    est = 0.0
+    for lo, hi, n in iter_bucket_ranges(snap):
+        cumulative += n
+        if cumulative >= target:
+            frac = (target - (cumulative - n)) / n
+            est = lo + frac * (hi - lo)
+            break
+    if mn is not None:
+        est = max(est, mn)
+    if mx is not None:
+        est = min(est, mx)
+    return est
 
 
 def _get(name, cls, help):

@@ -46,6 +46,9 @@ _logger = logging.getLogger("mxnet_tpu")
 
 DEFAULT_BUCKET_MB = 4.0
 _BUCKET_ENV = "MXNET_TPU_COMM_BUCKET_MB"
+# public spelling of the knob's env name for the layers that SET it
+# (observability/autotune.py CommBucketTuner, bench.py --tune-smoke)
+BUCKET_ENV = _BUCKET_ENV
 _COMPRESS_ENV = "MXNET_TPU_GRAD_COMPRESS"
 _THRESHOLD_ENV = "MXNET_TPU_GRAD_COMPRESS_THRESHOLD"
 _warned = set()

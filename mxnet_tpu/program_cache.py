@@ -62,6 +62,7 @@ from .observability import telemetry as _telemetry
 
 ENV_DIR = "MXNET_TPU_PROGRAM_CACHE_DIR"
 ENV_RO = "MXNET_TPU_PROGRAM_CACHE_RO"
+ENV_MAX_MB = "MXNET_TPU_PROGRAM_CACHE_MAX_MB"
 
 # container format: magic + u32be header length + JSON header + pickled
 # (payload, in_tree, out_tree).  The header is readable without touching
@@ -71,7 +72,9 @@ SUFFIX = ".mxprog"
 
 _lock = threading.Lock()
 _stats = {"hits": 0, "misses": 0, "evictions": 0, "writes": 0,
-          "bytes_written": 0, "bytes_read": 0}
+          "bytes_written": 0, "bytes_read": 0, "pruned": 0,
+          "pruned_bytes": 0}
+_max_mb_warned = False
 # tmp names carry pid AND this counter: two threads of one process
 # saving the same entry must not collide on the temp file either
 _TMP_COUNTER = itertools.count()
@@ -91,6 +94,32 @@ def read_only():
     """Read-only replicas restore but never write or evict — the mode
     for N replicas sharing one immutable prewarmed volume."""
     return os.environ.get(ENV_RO, "0") == "1"
+
+
+def max_cache_bytes():
+    """``MXNET_TPU_PROGRAM_CACHE_MAX_MB`` as bytes, or None (no cap —
+    the default).  With a cap set, every successful ``save`` prunes the
+    directory back under budget OLDEST-FIRST (the cachectl prune core,
+    protecting the entry just written), so an unattended RW volume —
+    CI, a long-lived deploy pipeline — cannot grow without bound;
+    ``tools/cachectl.py prune`` stays for manual, classified pruning.
+    Malformed or non-positive values warn once and read as uncapped."""
+    global _max_mb_warned
+    raw = os.environ.get(ENV_MAX_MB, "").strip()
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        mb = -1.0
+    if mb <= 0:
+        if not _max_mb_warned:
+            _max_mb_warned = True
+            _module_logger(__name__).warning(
+                "ignoring %s=%r (want a positive size in MB); cache "
+                "uncapped", ENV_MAX_MB, raw)
+        return None
+    return int(mb * 1024 * 1024)
 
 
 def _bump(event, n=1):
@@ -467,6 +496,11 @@ class ProgramStore:
             return None
         _bump("writes")
         _bump("bytes_written", len(data))
+        limit = max_cache_bytes()
+        if limit is not None:
+            # size-capped auto-prune on write: the freshly published
+            # entry is protected; everything else ages out oldest-first
+            self.prune(max_bytes=limit, protect=(path,))
         return path
 
     @staticmethod
@@ -535,6 +569,111 @@ class ProgramStore:
                 os.remove(path)
             except OSError:
                 pass
+
+    # -- pruning -------------------------------------------------------------
+
+    def prune(self, max_bytes=None, stale=False, drop_corrupt=False,
+              dry_run=False, protect=()):
+        """The prune core shared by ``tools/cachectl.py prune`` and the
+        on-write auto-prune (``MXNET_TPU_PROGRAM_CACHE_MAX_MB``).
+
+        Classification happens first: with ``drop_corrupt`` entries
+        whose container framing is unreadable are doomed, with
+        ``stale`` entries whose FULL version fingerprint (toolchain +
+        compile environment) no longer matches this process's are
+        doomed.  Then, with ``max_bytes``, surviving entries are
+        dropped OLDEST-FIRST (mtime) until the directory fits the
+        budget.  ``protect`` paths are never removed (the auto-prune
+        shields the entry it just wrote).  A trusted, in-budget entry
+        is never deleted.  Runs regardless of the store's ``ro`` flag —
+        pruning is an explicit capacity/admin action, distinct from the
+        load path's never-evict-when-ro contract.
+
+        Returns ``[{file, path, reason, bytes, mtime}]`` of the removed
+        (or, with ``dry_run``, matched) entries, and mirrors actual
+        removals into the ``pruned``/``pruned_bytes`` stats counters.
+        """
+        protect = {os.path.abspath(p) for p in protect}
+        current = version_fingerprint()
+        classify = stale or drop_corrupt
+        rows = []
+        doomed = []
+        for path in self.entries():
+            row = {"file": os.path.basename(path), "path": path,
+                   "protected": os.path.abspath(path) in protect}
+            try:
+                row["bytes"] = os.path.getsize(path)
+                row["mtime"] = os.path.getmtime(path)
+            except FileNotFoundError:
+                continue  # vanished mid-walk (a concurrent prune/evict)
+            except OSError:
+                # present but unstat-able (permissions, stale NFS
+                # handle): the CLI removes it as untrusted; budget
+                # pruning treats it as oldest so it can be reclaimed
+                row["bytes"] = 0
+                row["mtime"] = 0
+                if drop_corrupt and not row["protected"]:
+                    row["reason"] = "unreadable"
+                    doomed.append(row)
+                    continue
+            if row["protected"]:
+                rows.append(row)
+                continue
+            if classify:
+                # the header is only opened when a classification mode
+                # needs it — a budget-only auto-prune on every save must
+                # cost one stat per entry, not one read per entry
+                try:
+                    header, _ = self.read_header_file(path)
+                except FileNotFoundError:
+                    continue
+                except OSError:
+                    header = None
+                if header is None:
+                    if drop_corrupt:
+                        row["reason"] = "corrupt"
+                        doomed.append(row)
+                        continue
+                    # still budget-accountable: oldest-first claims it
+                elif stale and header.get("fingerprint") != current:
+                    row["reason"] = "stale"
+                    doomed.append(row)
+                    continue
+            rows.append(row)
+        if max_bytes is not None:
+            # protected entries COUNT toward the budget (the directory
+            # must fit) but are never the ones removed
+            rows.sort(key=lambda r: r.get("mtime", 0))
+            total = sum(r.get("bytes", 0) for r in rows)
+            for row in list(rows):
+                if total <= max_bytes:
+                    break
+                if row["protected"]:
+                    continue
+                total -= row.get("bytes", 0)
+                row["reason"] = "over-budget"
+                doomed.append(row)
+        removed = []
+        for row in doomed:
+            row.pop("protected", None)
+            if not dry_run:
+                try:
+                    os.remove(row["path"])
+                except OSError as exc:
+                    self._log.warning(
+                        "persistent program cache: could not prune %s "
+                        "(%s)", row["path"], exc)
+                    continue
+            removed.append(row)
+        if removed and not dry_run:
+            _bump("pruned", len(removed))
+            _bump("pruned_bytes", sum(r.get("bytes", 0) for r in removed))
+            self._log.info(
+                "persistent program cache: pruned %d entr%s (%d bytes) "
+                "from %s", len(removed),
+                "y" if len(removed) == 1 else "ies",
+                sum(r.get("bytes", 0) for r in removed), self.root)
+        return removed
 
 
 def get_store(root=None):

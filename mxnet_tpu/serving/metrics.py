@@ -14,6 +14,12 @@ parses these):
 - ``serving.queue_ms``            histogram, submit -> batch dispatch
 - ``serving.dispatch_ms``         histogram, executor run per batch
 - ``serving.batch_size``          histogram, real (unpadded) rows
+- ``serving.request_rows``        histogram, rows per ADMITTED request
+  (admission-time, pre-batching — the traffic-shape signal the
+  ServingBucketTuner consumes; ``batch_size`` only exists
+  post-dispatch and mixes co-batched requests)
+- ``serving.request_rows.<model>``  the same, per model (the tuner's
+  preferred input — a shared server mixes traffic shapes)
 - ``serving.padded_rows_total``   counter, padding rows added
 - ``serving.batches``             counter, dispatched batches
 - ``serving.requests_total``      counter, admitted requests
@@ -45,9 +51,27 @@ def record_rejection(reason, model=None):
                              category="serving", args=args)
 
 
-def record_admitted():
+def record_admitted(n_rows=None, model=None):
     telemetry.counter("serving.requests_total",
                       help="requests admitted to the queue").inc()
+    if n_rows is not None:
+        # per-request row count at ADMISSION: the observed traffic
+        # shape (observability/autotune.py ServingBucketTuner derives
+        # traffic-shaped bucket sets from its quantiles).  Recorded
+        # process-wide AND per model — different models see different
+        # traffic, and shaping model A's buckets from model B's rows
+        # would tune against the wrong distribution (cardinality is one
+        # series per registered model, the rejected_total.<reason>
+        # pattern).
+        telemetry.histogram(
+            "serving.request_rows",
+            help="rows per admitted request (pre-batching)"
+        ).observe(n_rows)
+        if model:
+            telemetry.histogram(
+                "serving.request_rows." + model,
+                help="rows per admitted request for one model"
+            ).observe(n_rows)
     # re-arm the function gauge: set_function state does NOT survive
     # telemetry.reset() the way the counter/histogram factories above do
     # (they re-create per call site; the gauge callback was installed

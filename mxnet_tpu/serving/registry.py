@@ -89,6 +89,11 @@ class ServedModel:
         # filled by warmup() under MXNET_TPU_MEMPROF=1: per-bucket
         # program byte footprints from XLA's memory_analysis
         self.bucket_memory = {}
+        # a bucket set staged by the ServingBucketTuner (or an
+        # operator) for adoption at the next warmup()/prewarm()
+        # boundary — never swapped mid-traffic, where an untraced
+        # bucket would retrace in the dispatch thread
+        self._pending_buckets = None
         self._by_bucket = {self.buckets[0]: self._base}
         self._lock = threading.Lock()
         # serializes run_batch: predictors are forward()+get_output()
@@ -108,6 +113,47 @@ class ServedModel:
                 p = self._base.reshaped(self._bind_shapes(bucket))
                 self._by_bucket[bucket] = p
             return p
+
+    def stage_buckets(self, buckets):
+        """Stage a replacement bucket set, adopted at the START of the
+        next :meth:`warmup` (which `Server.warmup`/`prewarm` drive), so
+        every new bucket is traced inside the warmup sweep and
+        steady-state serving never retraces.  The set is normalized —
+        ints, deduped, clamped to [1, max_batch_size], and always
+        topped by ``max_batch_size`` so ``bucket_for`` can place every
+        admissible request.  Returns the normalized set.
+
+        Run the warmup at a low-traffic moment: from the swap until the
+        sweep finishes, a request routed to a not-yet-traced bucket
+        would compile in the dispatch thread (the same window any cold
+        model has)."""
+        norm = sorted({min(self.max_batch_size, max(1, int(b)))
+                       for b in buckets})
+        if not norm:
+            raise ValueError("bucket set must be non-empty")
+        if norm[-1] != self.max_batch_size:
+            norm.append(self.max_batch_size)
+        with self._lock:
+            self._pending_buckets = norm
+        return list(norm)
+
+    def pending_buckets(self):
+        """The staged-but-not-yet-adopted bucket set, or None."""
+        with self._lock:
+            return list(self._pending_buckets) \
+                if self._pending_buckets else None
+
+    def _adopt_pending_buckets(self):
+        """Swap in a staged bucket set (warmup-boundary only).  Old
+        buckets' predictors stay in ``_by_bucket`` — their programs are
+        already cached and shared weights make them cheap — but routing
+        (``self.buckets``) moves to the new set atomically."""
+        with self._lock:
+            if not self._pending_buckets:
+                return False
+            self.buckets = self._pending_buckets
+            self._pending_buckets = None
+        return True
 
     def run_batch(self, bucket, inputs):
         """Run one padded batch: ``inputs`` maps input name -> np array
@@ -131,7 +177,13 @@ class ServedModel:
         total_bytes}}), which ``Server.warmup`` sums against device
         capacity.  A bucket whose program was already cached (a second
         model over the same graph) traces nothing and so attributes
-        nothing — only measured programs are reported."""
+        nothing — only measured programs are reported.
+
+        A bucket set staged by :meth:`stage_buckets` (the
+        ServingBucketTuner's apply path) is adopted HERE, before the
+        sweep — the warmup that follows traces every new bucket, so the
+        applied change never retraces in steady state."""
+        self._adopt_pending_buckets()
         traced = {}
         # bucket_memory accumulates rather than resets: the verify
         # sweep (and any later warm re-warmup) traces nothing and must

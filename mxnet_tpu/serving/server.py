@@ -335,7 +335,7 @@ class Server:
         except ServingError as exc:
             metrics.record_rejection(exc.reason, model=model)
             raise
-        metrics.record_admitted()
+        metrics.record_admitted(request.n_rows, model=model)
         # debug/verification handle: the queued Request (rows, deadline,
         # and — once dispatched — dispatch_bucket, the program shape the
         # response came from; the serve-smoke bitwise oracle needs it)

@@ -628,3 +628,108 @@ def test_exec_cache_disabled_still_uses_disk(cache_dir, monkeypatch):
         _bind(sym).forward(is_train=False)  # private entry, disk hit
     assert w.total() == 0
     assert program_cache.stats()["hits"] == 1
+
+
+# -- size-capped auto-prune (MXNET_TPU_PROGRAM_CACHE_MAX_MB) ------------------
+
+def _fake_entry(d, stem, nbytes, mtime, fingerprint=None):
+    """A header-valid entry file of a chosen size and age: the prune
+    core reads only the bounded header + file stat, never the pickle."""
+    header = {"version": 1, "kind": "fwd", "label": stem,
+              "entry_fp": "e" * 24, "arg_fp": "a" * 16,
+              "platform": "cpu",
+              "fingerprint": fingerprint
+              or program_cache.version_fingerprint()}
+    data = program_cache.ProgramStore.encode(header, b"z" * nbytes)
+    path = os.path.join(d, "%s.fwd.aaaa.vvvv.mxprog" % stem)
+    with open(path, "wb") as f:
+        f.write(data)
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+def test_prune_core_oldest_first_and_protect(tmp_path):
+    d = str(tmp_path / "vol")
+    os.makedirs(d)
+    store = program_cache.ProgramStore(d, ro=False)
+    old = _fake_entry(d, "old", 1000, 1_000_000)
+    mid = _fake_entry(d, "mid", 1000, 1_000_100)
+    new = _fake_entry(d, "new", 1000, 1_000_200)
+    sizes = {p: os.path.getsize(p) for p in (old, mid, new)}
+
+    # dry run matches the oldest without deleting
+    matched = store.prune(max_bytes=sizes[mid] + sizes[new],
+                          dry_run=True)
+    assert [m["file"] for m in matched] == [os.path.basename(old)]
+    assert all(os.path.exists(p) for p in (old, mid, new))
+
+    # real prune: oldest-first until the dir fits
+    removed = store.prune(max_bytes=sizes[mid] + sizes[new])
+    assert [m["reason"] for m in removed] == ["over-budget"]
+    assert not os.path.exists(old) and os.path.exists(mid) \
+        and os.path.exists(new)
+    assert program_cache.stats()["pruned"] >= 1
+
+    # a protected entry counts toward the budget but is never removed:
+    # fitting the budget requires dropping mid (oldest unprotected)
+    removed = store.prune(max_bytes=sizes[new], protect=(mid,))
+    assert [m["file"] for m in removed] == [os.path.basename(new)]
+    assert os.path.exists(mid)
+
+
+def test_prune_core_stale_and_corrupt_classes(tmp_path):
+    d = str(tmp_path / "vol")
+    os.makedirs(d)
+    store = program_cache.ProgramStore(d, ro=False)
+    good = _fake_entry(d, "good", 100, 1_000_000)
+    foreign = _fake_entry(d, "foreign", 100, 1_000_100,
+                          fingerprint={"jax": "99.99"})
+    corrupt = os.path.join(d, "corrupt.fwd.aaaa.vvvv.mxprog")
+    with open(corrupt, "wb") as f:
+        f.write(b"not an entry")
+
+    # stale prune alone keeps corrupt files (the CLI passes
+    # drop_corrupt; the auto-prune does not — load evicts them anyway)
+    removed = store.prune(stale=True)
+    assert [m["reason"] for m in removed] == ["stale"]
+    assert not os.path.exists(foreign)
+    assert os.path.exists(corrupt) and os.path.exists(good)
+
+    removed = store.prune(stale=True, drop_corrupt=True)
+    assert [m["reason"] for m in removed] == ["corrupt"]
+    assert os.path.exists(good)
+
+
+def test_autoprune_on_write_keeps_newest(cache_dir, monkeypatch):
+    """With MXNET_TPU_PROGRAM_CACHE_MAX_MB set, a save that pushes the
+    volume over budget prunes oldest-first — protecting the entry just
+    written — so an unattended RW volume stays capped (the ROADMAP
+    cold-start remainder; cachectl prune stays for manual use)."""
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    files = _entry_files(cache_dir)
+    assert len(files) == 1
+    first = os.path.join(cache_dir, files[0])
+    # cap below two entries but above one: the second write must evict
+    # the first and keep itself
+    cap_mb = os.path.getsize(first) * 1.5 / (1024.0 * 1024.0)
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_MAX_MB",
+                       "%.6f" % cap_mb)
+    _bind(sym).forward(is_train=True)  # a second, distinct program
+    files = _entry_files(cache_dir)
+    assert len(files) == 1 and os.path.basename(first) not in files
+    assert program_cache.stats()["pruned"] == 1
+    assert program_cache.stats()["pruned_bytes"] > 0
+
+
+def test_autoprune_env_malformed_or_unset_is_uncapped(cache_dir,
+                                                      monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_MAX_MB", "banana")
+    assert program_cache.max_cache_bytes() is None
+    sym = _mlp()
+    _bind(sym).forward(is_train=False)
+    _bind(sym).forward(is_train=True)
+    assert len(_entry_files(cache_dir)) == 2
+    assert program_cache.stats()["pruned"] == 0
+    monkeypatch.setenv("MXNET_TPU_PROGRAM_CACHE_MAX_MB", "0")
+    assert program_cache.max_cache_bytes() is None

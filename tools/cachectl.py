@@ -191,39 +191,14 @@ def cmd_prune(args):
         sys.stderr.write("cachectl prune: nothing to do "
                          "(pass --max-bytes and/or --stale)\n")
         return 2
-    from mxnet_tpu import program_cache
     store = _store(args)
-    doomed = []
-    rows = _entry_rows(store)
-    current = program_cache.version_fingerprint()
-    keep = []
-    for r in rows:
-        if r["status"] in ("unreadable", "corrupt"):
-            doomed.append((r, "corrupt"))
-        elif args.stale and r.get("fingerprint") != current:
-            # the FULL fingerprint: toolchain versions AND the compile
-            # environment (XLA_FLAGS, precision/prng config)
-            doomed.append((r, "stale"))
-        else:
-            keep.append(r)
-    if args.max_bytes is not None:
-        # oldest-first until the surviving set fits the budget
-        keep.sort(key=lambda r: r.get("mtime", 0))
-        total = sum(r.get("bytes", 0) for r in keep)
-        while keep and total > args.max_bytes:
-            r = keep.pop(0)
-            total -= r.get("bytes", 0)
-            doomed.append((r, "over-budget"))
-    removed = []
-    for r, why in doomed:
-        if not args.dry_run:
-            try:
-                os.remove(r["path"])
-            except OSError as exc:
-                print("could not remove %s: %s" % (r["file"], exc))
-                continue
-        removed.append({"file": r["file"], "reason": why,
-                        "bytes": r.get("bytes", 0)})
+    # one prune core (ProgramStore.prune) serves both this CLI and the
+    # on-write auto-prune (MXNET_TPU_PROGRAM_CACHE_MAX_MB): corrupt
+    # entries are always doomed from the CLI, --stale compares the FULL
+    # fingerprint (toolchain versions AND the compile environment:
+    # XLA_FLAGS, precision/prng config), --max-bytes drops oldest-first
+    removed = store.prune(max_bytes=args.max_bytes, stale=args.stale,
+                          drop_corrupt=True, dry_run=args.dry_run)
     if args.json:
         print(json.dumps({"dir": store.root, "removed": removed,
                           "dry_run": bool(args.dry_run)}))

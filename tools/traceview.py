@@ -40,6 +40,14 @@ write_report`, or a flight dump embedding one): the per-program table
 memory_analysis), the live-array census grouped by (shape, dtype), and
 per-device allocator stats where the backend reports them.
 
+`--tuning` renders the autotune decision log
+(`observability/autotune.py`): per-controller/action counts plus one
+block per decision — action, reason, candidates considered, and the
+cost paid (retraces spent vs budget).  Accepts a flight dump (the
+`tuning` ring every dump carries), a bare JSON list of decision
+records, or a `{"decisions": [...]}` document.  Exits 2 when the input
+holds no decisions (the autotune layer never ran).
+
 Understands both the native "X" complete-event encoding and legacy
 "B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
 from __future__ import annotations
@@ -393,6 +401,10 @@ def summarize_flight(doc, trend_rows=12):
     lines.append("")
     lines.append("events: %d   captured log records: %d"
                  % (stats["events"], stats["logs"]))
+    decisions = doc.get("tuning") or []
+    if decisions:
+        lines.append("autotune decisions: %d (render with --tuning)"
+                     % len(decisions))
     if doc.get("memory"):
         # an OOM dump embeds the full memory report — render it inline
         lines.append("")
@@ -465,6 +477,11 @@ def summarize_memory(memdoc, top=20):
                         disk.get("evictions", 0), disk.get("writes", 0),
                         _fmt_bytes(disk.get("bytes_written", 0)),
                         _fmt_bytes(disk.get("bytes_read", 0))))
+        if disk.get("pruned"):
+            lines.append("auto-pruned %d entries (%s) — "
+                         "MXNET_TPU_PROGRAM_CACHE_MAX_MB"
+                         % (disk["pruned"],
+                            _fmt_bytes(disk.get("pruned_bytes", 0))))
     lines.append("")
     lines.append("== memory: live-array census (by shape/dtype) ==")
     census = memdoc.get("census") or {}
@@ -499,6 +516,84 @@ def summarize_memory(memdoc, top=20):
                             _fmt_bytes(d.get("bytes_in_use")),
                             _fmt_bytes(d.get("peak_bytes_in_use")),
                             _fmt_bytes(d.get("bytes_limit"))))
+    return "\n".join(lines)
+
+
+# -- tuning view -------------------------------------------------------------
+
+def tuning_records(doc):
+    """Extract the autotune decision list from any accepted input form:
+    a flight dump (its ``tuning`` ring), a ``{"decisions": [...]}``
+    document, or a bare JSON list of records."""
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict):
+        if isinstance(doc.get("tuning"), list):
+            return doc["tuning"]
+        if isinstance(doc.get("decisions"), list):
+            return doc["decisions"]
+    return []
+
+
+def tuning_stats(records):
+    """The machine-readable summary `--tuning` renders (and tests +
+    bench assert on): counts by controller and action, applied
+    changes, total retraces spent."""
+    by_controller = {}
+    by_action = {}
+    applied = []
+    retraces = 0
+    for r in records:
+        c = r.get("controller", "?")
+        a = r.get("action", "?")
+        by_controller[c] = by_controller.get(c, 0) + 1
+        by_action[a] = by_action.get(a, 0) + 1
+        retraces += int(_fnum((r.get("cost") or {}).get("retraces", 0),
+                              0))
+        if a == "apply":
+            applied.append({"controller": c,
+                            "decision": r.get("decision") or {}})
+    return {"decisions": len(records), "by_controller": by_controller,
+            "by_action": by_action, "applied": applied,
+            "retraces_spent": retraces}
+
+
+def summarize_tuning(records, top=20):
+    """The text report for one decision log."""
+    stats = tuning_stats(records)
+    lines = []
+    lines.append("== autotune: decision log ==")
+    if not records:
+        lines.append("(no decisions recorded — were the controllers "
+                     "run?  MXNET_TPU_AUTOTUNE=0 disables them)")
+        return "\n".join(lines)
+    lines.append("decisions: %d   applied: %d   retraces spent: %d"
+                 % (stats["decisions"], len(stats["applied"]),
+                    stats["retraces_spent"]))
+    lines.append("%-18s %s" % ("Controller", "Decisions"))
+    for c in sorted(stats["by_controller"]):
+        lines.append("%-18s %9d" % (c, stats["by_controller"][c]))
+    lines.append("%-18s %s" % ("Action", "Count"))
+    for a in sorted(stats["by_action"]):
+        lines.append("%-18s %9d" % (a, stats["by_action"][a]))
+    lines.append("")
+    for r in records[-top:]:
+        cost = r.get("cost") or {}
+        head = "%-16s %-10s mode=%-9s" % (r.get("controller", "?"),
+                                          r.get("action", "?"),
+                                          r.get("mode", "?"))
+        budget = cost.get("retrace_budget")
+        if budget is not None:
+            head += " retraces %s/%s" % (cost.get("retraces", 0), budget)
+        lines.append(head)
+        lines.append("  %s" % r.get("reason", ""))
+        for cand in (r.get("candidates") or [])[:6]:
+            lines.append("  candidate: %s" % json.dumps(cand,
+                                                        sort_keys=True))
+        decision = r.get("decision")
+        if decision:
+            lines.append("  decision:  %s" % json.dumps(decision,
+                                                        sort_keys=True))
     return "\n".join(lines)
 
 
@@ -753,7 +848,19 @@ def main(argv=None):
                         "table, live-array census, device allocator "
                         "stats (a memprof report JSON, or a flight dump "
                         "embedding one)")
+    parser.add_argument("--tuning", action="store_true",
+                        help="autotune view: the decision log "
+                        "(controllers, actions, candidates, retrace "
+                        "cost) from a flight dump or a bare decision-"
+                        "log JSON; exits 2 when no decisions are "
+                        "recorded")
     args = parser.parse_args(argv)
+    if args.tuning:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        records = tuning_records(doc)
+        print(summarize_tuning(records))
+        return 0 if records else 2
     if args.flight:
         with open(args.trace) as f:
             doc = json.load(f)
