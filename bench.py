@@ -1076,14 +1076,21 @@ def io_smoke():
        by a plain NDArrayIter (the pipeline is invisible to the
        compiler), and a second pipeline-fed fit over the warm cache
        retraces nothing (`executor_cache.watch_traces`);
-    3. **starvation < 1% + overlap contract** — over a warm-cache fit
-       fed by the PROCESS-pool pipeline (this smoke's decode is pure
-       Python, i.e. GIL-bound — exactly the case the process pool
-       exists for; thread-mode python decode convoys on GIL handoffs
-       with the driving thread), the fit loop's `data_wait` stays
-       under 1% of measured step time, and the uploads were issued
-       AHEAD of consumption (`io_pipeline.h2d_ahead_total`) — batch
-       N's H2D rides under step N-1's compute.
+    3. **starvation vs measured baseline + overlap contract** — over
+       warm-cache fits fed by the PROCESS-pool pipeline (this smoke's
+       decode is pure Python, i.e. GIL-bound — exactly the case the
+       process pool exists for; thread-mode python decode convoys on
+       GIL handoffs with the driving thread), the fit loop's
+       `data_wait` share of step time — median of 3 runs — stays
+       within 2x (+0.2pp) of the same-module, same-host floor measured
+       by a median-of-3 IN-MEMORY NDArrayIter sweep (zero decode, zero
+       prefetch: whatever data_wait that shows is host noise — queue
+       take, GIL reacquisition — not pipeline behavior), never worse
+       than an absolute 2%; and the uploads were issued AHEAD of
+       consumption (`io_pipeline.h2d_ahead_total`) — batch N's H2D
+       rides under step N-1's compute.  (The old absolute <1% bar was
+       verified flaky at BASELINE on this shared box: 3/4 plain
+       NDArrayIter runs measured 1.04-1.28%.)
 
     Environment shaping, applied before jax loads: XLA's cpu eigen
     pool is pinned to one thread so the 2-core CI host keeps a core of
@@ -1204,10 +1211,11 @@ def io_smoke():
         # worker interpreter starts — close everything at the end
         measured_iters = []
 
-        def measured_fit():
+        def measured_fit(make_it):
             telemetry.reset()
-            it = proc_pipe.as_dataiter()
-            measured_iters.append(it)
+            it = make_it()
+            if hasattr(it, "close"):
+                measured_iters.append(it)
             with executor_cache.watch_traces() as watch:
                 mod.fit(it, num_epoch=2,
                         optimizer_params={"learning_rate": 0.1})
@@ -1216,20 +1224,35 @@ def io_smoke():
             snap = telemetry.snapshot()
             step_ms = snap["module.step.total_ms"]["sum"]
             wait_ms = snap["module.step.data_wait_ms"]["sum"]
-            ahead = snap["io_pipeline.h2d_ahead_total"]["value"]
+            ahead = snap.get("io_pipeline.h2d_ahead_total",
+                             {}).get("value", 0)
             steps = snap["module.steps"]["value"]
             assert steps == 2 * (n_rec // batch), steps
             return (wait_ms / step_ms if step_ms else 0.0, step_ms,
                     steps, ahead)
 
-        starvation, step_ms, steps, h2d_ahead = measured_fit()
-        if starvation >= 0.01:  # one retry: wall-clock on a shared host
-            starvation, step_ms, steps, h2d_ahead = measured_fit()
+        # starvation is a wall-clock measurement on a shared host: the
+        # absolute <1% bar was flaky at BASELINE (an in-memory iterator
+        # measured 1.04-1.28% in 3/4 runs on this box).  Measure the
+        # host's data_wait floor with the same module over a plain
+        # NDArrayIter (median of 3), then hold the pipeline's median of
+        # 3 to a ratio of that floor, never worse than an absolute 2%.
+        baseline_runs = sorted(
+            measured_fit(lambda: NDArrayIter(feats, labels,
+                                             batch_size=batch))[0]
+            for _ in range(3))
+        pipe_runs = sorted((measured_fit(proc_pipe.as_dataiter)
+                            for _ in range(3)), key=lambda r: r[0])
+        baseline = baseline_runs[1]
+        starvation, step_ms, steps, h2d_ahead = pipe_runs[1]
         for it in measured_iters:
             it.close()
         warm_it.close()
-        assert starvation < 0.01, (
-            "fit data_wait is %.2f%% of step time" % (100 * starvation))
+        bar = min(max(2.0 * baseline + 0.002, 0.01), 0.02)
+        assert starvation < bar, (
+            "fit data_wait is %.2f%% of step time (bar %.2f%%; measured "
+            "in-memory baseline %.2f%%)"
+            % (100 * starvation, 100 * bar, 100 * baseline))
         # overlap contract: all but the primed pulls of each epoch were
         # taken AHEAD of consumption (their H2D issued under compute)
         assert h2d_ahead >= 2 * (n_rec // batch - 2), h2d_ahead
@@ -1242,6 +1265,8 @@ def io_smoke():
             "trace_counters_off": counts_off,
             "trace_counters_on": counts_on,
             "starvation_data_wait": round(starvation, 5),
+            "starvation_baseline": round(baseline, 5),
+            "starvation_bar": round(bar, 5),
             "step_ms_avg": round(step_ms / steps, 2) if steps else None,
             "h2d_ahead": int(h2d_ahead),
             "recompiles_after_warm": 0,
@@ -2008,6 +2033,235 @@ def coldstart_child():
     }))
 
 
+def elastic_smoke():
+    """Preemption-safe elastic-training CI mode (`make bench-smoke`
+    step 10, `bench.py --elastic-smoke`): proves the checkpoint/resume
+    contracts of docs/elastic.md end to end on the 8-virtual-device
+    MULTICHIP harness, in real subprocesses (a preemption kills a
+    PROCESS — nothing in-memory may carry over), under a declarative
+    chaos plan (`mxnet_tpu/elastic/chaos.py`):
+
+    1. **straight**: an uninterrupted dp=8 run records the reference
+       final params (and populates the shared program-cache volume);
+    2. **victim**: the same run with a `Checkpointer` on a 5-step
+       schedule and a `kill_at_step: 22` fault — the process dies
+       mid-epoch with snapshots 10/15/20 retained (keep=3);
+    3. the parent CORRUPTS the newest snapshot (flipped bytes, intact
+       manifest — `chaos.corrupt_snapshot`);
+    4. **resume8**: `elastic.resume_fit` on the same dp=8 factorization
+       must reject the corrupt snapshot at manifest verify, fall back
+       to step 15, fast-forward the iterator, finish the run with final
+       params BITWISE-equal to the uninterrupted ones, and boot WARM:
+       zero backend compiles in the whole resumed process (every
+       program restores from the `MXNET_TPU_PROGRAM_CACHE_DIR` volume
+       the earlier runs populated);
+    5. **resume4**: the same resume onto a RE-factorized dp=4 mesh
+       (half the workers survived) must train to final params allclose
+       to the uninterrupted dp=8 run (reduction-order differences
+       only);
+    6. the resumed flight dump's `elastic` ring parses through
+       `tools/traceview.py --elastic` (rc 0, shows the rejected
+       snapshot + the resume), and `--flight` notes the last
+       checkpoint step.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="elastic_cache_")
+    ckpt_dir = tempfile.mkdtemp(prefix="elastic_ckpt_")
+    out_dir = tempfile.mkdtemp(prefix="elastic_out_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = \
+            (xla + " --xla_force_host_platform_device_count=8").strip()
+    env["MXNET_TPU_PROGRAM_CACHE_DIR"] = cache_dir
+    env["MXNET_TPU_CKPT_DIR"] = ckpt_dir
+    env["MXNET_TPU_CKPT_STEPS"] = "5"
+    env["MXNET_TPU_CKPT_KEEP"] = "3"
+    env["MXTPU_ELASTIC_OUT"] = out_dir
+    for k in ("MXNET_TPU_CHAOS_PLAN", "MXNET_TPU_COMM_BUCKET_MB",
+              "MXNET_TPU_GRAD_COMPRESS", "MXNET_TPU_EXEC_CACHE",
+              "MXNET_TPU_PROGRAM_CACHE_RO", "MXNET_TPU_FLIGHT_PATH",
+              "MXNET_TPU_HEALTH", "MXNET_TPU_QUANTIZE"):
+        env.pop(k, None)
+
+    def run_child(role, extra=None, expect_rc=0):
+        e = dict(env)
+        e["MXTPU_ELASTIC_ROLE"] = role
+        e["MXNET_TPU_FLIGHT_PATH"] = os.path.join(
+            out_dir, "flight_%s.json" % role)
+        if extra:
+            e.update(extra)
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--elastic-child"],
+            capture_output=True, text=True, env=e, timeout=900)
+        assert r.returncode == expect_rc, (
+            "elastic %s child exited %d (wanted %d):\n--- stdout ---\n"
+            "%s\n--- stderr ---\n%s" % (role, r.returncode, expect_rc,
+                                        r.stdout[-4000:],
+                                        r.stderr[-4000:]))
+        if expect_rc != 0:
+            return None
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    from mxnet_tpu.elastic import chaos
+    kill_step = 22
+    try:
+        straight = run_child("straight")
+        run_child("victim", extra={
+            "MXNET_TPU_CHAOS_PLAN": json.dumps(
+                [{"kind": "kill_at_step", "step": kill_step}])},
+            expect_rc=chaos.DEFAULT_KILL_EXIT)
+        snaps = sorted(d for d in os.listdir(ckpt_dir)
+                       if d.startswith("snap-"))
+        # keep=3 over the 5-step schedule before the step-22 kill
+        assert snaps == ["snap-%010d" % s for s in (10, 15, 20)], snaps
+        chaos.corrupt_snapshot(os.path.join(ckpt_dir, snaps[-1]))
+        # resume8's own schedule keeps writing (and retention keeps
+        # pruning) the shared dir — give resume4 a pristine copy of
+        # the post-kill post-corruption state so it too resumes from
+        # step 15 and trains the long re-factorized tail
+        ckpt_dir4 = ckpt_dir + "_dp4"
+        shutil.copytree(ckpt_dir, ckpt_dir4)
+
+        resumed8 = run_child("resume8")
+        # corrupt newest rejected at manifest verify -> previous wins
+        assert resumed8["resume"]["step"] == 15, resumed8["resume"]
+        assert resumed8["resume"]["skip_batches"] == 7, \
+            resumed8["resume"]
+        assert not resumed8["resume"]["refactorized"]
+        # same factorization: the resumed trajectory IS the
+        # uninterrupted one — bitwise
+        assert resumed8["params_sha"] == straight["params_sha"], (
+            "resumed dp=8 params differ from the uninterrupted run")
+        # warm resume: the whole resumed process compiled NOTHING — it
+        # restored every program from the shared cache volume
+        assert resumed8["builds"]["backend_compiles"] == 0, \
+            resumed8["builds"]
+        assert resumed8["builds"]["built"] == 0, resumed8["builds"]
+        assert resumed8["builds"]["restored"] >= 1, resumed8["builds"]
+
+        resumed4 = run_child("resume4",
+                             extra={"MXNET_TPU_CKPT_DIR": ckpt_dir4})
+        assert resumed4["resume"]["step"] == 15, resumed4["resume"]
+        assert resumed4["resume"]["refactorized"], resumed4["resume"]
+        assert resumed4["resume"]["n_dev_to"] == 4
+        pS = np.load(os.path.join(out_dir, "straight.npz"))
+        p4 = np.load(os.path.join(out_dir, "resume4.npz"))
+        param_max_diff = 0.0
+        for k in pS.files:
+            np.testing.assert_allclose(pS[k], p4[k], rtol=1e-4,
+                                       atol=1e-6)
+            param_max_diff = max(param_max_diff,
+                                 float(np.max(np.abs(pS[k] - p4[k]))))
+
+        # the lineage is recoverable from the flight dump
+        tv = _load_traceview()
+        with open(resumed8["flight"]) as f:
+            doc = json.load(f)
+        records = tv.elastic_records(doc)
+        stats = tv.elastic_stats(records)
+        assert stats["rejected"], "rejected snapshot not in lineage"
+        assert stats["resumes"] and \
+            stats["resumes"][0]["from_step"] == 15, stats["resumes"]
+        rendered = tv.summarize_elastic(records)
+        assert "RESUME from step 15" in rendered, rendered
+        flight_text = tv.summarize_flight(doc)
+        assert "last checkpoint: step" in flight_text, flight_text
+    finally:
+        for d in (cache_dir, ckpt_dir, ckpt_dir + "_dp4", out_dir):
+            shutil.rmtree(d, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "bench_elastic_smoke",
+        "kill_step": kill_step,
+        "resume_step": 15,
+        "corrupt_newest_skipped": True,
+        "bitwise_same_factorization": True,
+        "warm_resume_backend_compiles": resumed8["builds"][
+            "backend_compiles"],
+        "warm_resume_disk_restores": resumed8["builds"]["restored"],
+        "refactorized_param_max_diff": param_max_diff,
+        "straight_sha": straight["params_sha"][:16],
+    }))
+
+
+def elastic_child():
+    """One worker of `elastic_smoke`, in a fresh subprocess (role via
+    MXTPU_ELASTIC_ROLE): `straight` trains uninterrupted, `victim`
+    trains under the env-shipped chaos plan until the kill fault
+    `os._exit`s it, `resume8`/`resume4` resume from the checkpoint
+    volume onto 8/4 devices.  Prints ONE JSON line the parent asserts
+    on; final params land in MXTPU_ELASTIC_OUT/<role>.npz."""
+    import hashlib
+    import os
+
+    role = os.environ["MXTPU_ELASTIC_ROLE"]
+    out_dir = os.environ["MXTPU_ELASTIC_OUT"]
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic
+    from mxnet_tpu.elastic import chaos
+    from mxnet_tpu.observability import flight_recorder, memprof
+
+    n_dev = 4 if role == "resume4" else 8
+    epochs, batch = 4, 64
+    rng = np.random.RandomState(0)
+    W = rng.randn(16, 4)
+    X = rng.randn(512, 16).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    def mlp():
+        h = mx.sym.Activation(mx.sym.FullyConnected(
+            mx.sym.var("data"), num_hidden=32, name="fc1"),
+            act_type="relu")
+        return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            h, num_hidden=4, name="fc2"), name="softmax")
+
+    mx.random.seed(0)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False)
+    mod = mx.mod.Module(mlp(), context=[mx.cpu(i) for i in range(n_dev)])
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+    totals0 = memprof.build_totals()
+    report = None
+    if role == "straight":
+        mod.fit(it, num_epoch=epochs, kvstore="tpu_ici",
+                optimizer_params=opt_params)
+    elif role == "victim":
+        ckpt = elastic.Checkpointer()  # env-configured dir/steps/keep
+        ckpt.attach(mod)
+        chaos.ChaosMonkey(chaos.FaultPlan.from_env()).arm(ckpt)
+        mod.fit(it, num_epoch=epochs, kvstore="tpu_ici",
+                optimizer_params=opt_params)
+        raise SystemExit("chaos kill_at_step did not fire")
+    else:
+        report = elastic.resume_fit(mod, it, num_epoch=epochs,
+                                    kvstore="tpu_ici",
+                                    optimizer_params=opt_params)
+    totals1 = memprof.build_totals()
+
+    params = {n: mod._exec_group.execs[0].arg_dict[n].asnumpy()
+              for n in mod._exec_group.param_names}
+    sha = hashlib.sha256()
+    for n in sorted(params):
+        sha.update(params[n].tobytes())
+    np.savez(os.path.join(out_dir, role + ".npz"), **params)
+    dump = flight_recorder.dump(reason="elastic_smoke")
+    print(json.dumps({
+        "role": role,
+        "n_dev": n_dev,
+        "params_sha": sha.hexdigest(),
+        "builds": {k: totals1[k] - totals0[k] for k in totals1},
+        "resume": None if report is None else report.describe(),
+        "flight": dump,
+    }))
+
+
 def _main_with_retry():
     """The tunnel runtime occasionally drops a remote_compile mid-flight
     (observed: 'response body closed before all bytes were read');
@@ -2040,6 +2294,10 @@ if __name__ == "__main__":
         coldstart_smoke()
     elif "--coldstart-child" in sys.argv:
         coldstart_child()
+    elif "--elastic-smoke" in sys.argv:
+        elastic_smoke()
+    elif "--elastic-child" in sys.argv:
+        elastic_child()
     elif "--smoke" in sys.argv:
         smoke()
     else:

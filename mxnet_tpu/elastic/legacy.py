@@ -18,7 +18,7 @@ import glob
 import os
 import re
 
-from .base import _logger as logger
+from ..base import _logger as logger
 
 
 def dead_nodes(timeout_s=60):
@@ -63,8 +63,8 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
     training continues where it left off.  This is the checkpoint-based
     elastic-restart story SURVEY.md §5.3 prescribes for the TPU side.
     """
-    from . import model as model_mod
-    from .callback import do_checkpoint
+    from .. import model as model_mod
+    from ..callback import do_checkpoint
 
     start = resume_epoch(prefix)
     arg_params = aux_params = None

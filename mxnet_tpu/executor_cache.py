@@ -102,7 +102,7 @@ class ProgramEntry:
         self.label = label
 
 
-def note_trace(kind, label=None):
+def note_trace(kind, label=None, build_record=True):
     """Record one jax trace of kind 'fwd' / 'fwd_bwd' / 'fused_step'.
 
     Called from INSIDE jitted function bodies: the body only executes
@@ -115,11 +115,20 @@ def note_trace(kind, label=None):
     (the entry's label) opens a memprof program record that the
     compile-duration listener fills in — the per-program compile-time
     attribution behind ``stats()["programs"]``.
+
+    ``build_record=False`` counts the retrace WITHOUT opening/arming a
+    memprof record: the dp fused step's shape-derivation probe is a
+    real (and its only) trace, but no compile follows it directly — a
+    record armed there would swallow the next unrelated compile on the
+    thread (a sharded device_put's transfer program, say) and put
+    phantom builds into the warm-boot totals the elastic resume proof
+    reads.  Its real compile attributes via ``memprof.aot_compile``.
     """
     with _lock:
         _stats["traces_" + kind] += 1
         value = _stats["traces_" + kind]
-    _memprof.note_build(kind, label)
+    if build_record:
+        _memprof.note_build(kind, label)
     _telemetry.counter("exec_cache.traces_" + kind,
                        help="real jax retraces of the %s program"
                        % kind).inc()

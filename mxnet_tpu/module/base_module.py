@@ -269,7 +269,16 @@ class BaseModule:
                                          epoch, name, val)
                 train_data.reset()
         except _health.TrainingDivergedError:
-            raise  # the raise action already wrote the flight dump
+            # the raise action already wrote the flight dump (black box
+            # first); an attached elastic checkpointer leaves a final
+            # snapshot behind before the error propagates, positioned
+            # at the diverged step so a resume continues the stream
+            ckpt = getattr(self, "_elastic_ckpt", None)
+            if ckpt is not None:
+                pos = getattr(self, "_elastic_position", None)
+                ckpt.on_diverged(self, epoch=pos[0] if pos else 0,
+                                 batch=pos[1] if pos else None)
+            raise
         except Exception as exc:
             # OOM black box, unconditional: on async backends an
             # execution-time RESOURCE_EXHAUSTED surfaces at whatever
@@ -346,6 +355,14 @@ class BaseModule:
                     epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
                     locals=locals()))
             timings = tracker.step_end(nbatch)
+            ckpt = getattr(self, "_elastic_ckpt", None)
+            if ckpt is not None:
+                # stash the completed step's position BEFORE the health
+                # judgment: a raise-action rule unwinds past the
+                # on_step hook below, and the diverged snapshot must
+                # still record where the data stream stands (this
+                # step's update is already applied)
+                self._elastic_position = (epoch, nbatch)
             if pending_health is not None:
                 # record first, judge second: a raising rule's flight
                 # dump must already contain the offending step — and
@@ -357,6 +374,13 @@ class BaseModule:
                     timings=timings,
                     mem=_instrument.last_memory_sample())
                 health_mon.observe(step, summary)
+            if ckpt is not None:
+                # AFTER the health judgment: an anomaly marked by the
+                # monitor's callback snapshots here, strictly after its
+                # flight dump (black box first); schedule/preemption
+                # triggers also fire at this completed-step boundary
+                with tracker.component("sync"):
+                    ckpt.on_step(self, epoch=epoch, batch=nbatch)
             batch = upcoming
             nbatch += 1
         for name, val in eval_metric.get_name_value():
@@ -378,6 +402,11 @@ class BaseModule:
         if mon is None:
             mon = self._health_mon = _health.HealthMonitor(
                 logger=self.logger)
+            ckpt = getattr(self, "_elastic_ckpt", None)
+            if ckpt is not None and ckpt.note_anomaly not in mon.callbacks:
+                # an attached elastic checkpointer snapshots on anomaly
+                # (at the next step boundary, after the monitor's dump)
+                mon.add_callback(ckpt.note_anomaly)
         return mon
 
     def _capture_health(self):

@@ -284,11 +284,25 @@ class FusedTrainStep:
         # off; flip on for memory-bound models on locally-attached chips.
         donate = os.environ.get("MXNET_TPU_FUSED_DONATE", "0") == "1"
 
+        # On the dp path the constructor's jax.eval_shape probe below
+        # IS the step's one real trace — jax's jaxpr cache serves the
+        # later jit lowering from it, so the body never re-runs at
+        # dispatch.  The probe therefore COUNTS as the retrace (the
+        # autotune comm tuner prices candidates on exactly this), but
+        # must not arm a memprof build record: no compile follows the
+        # probe directly (the real one attributes via aot_compile, or
+        # never happens on a disk-restored warm boot), and a dangling
+        # armed record swallows the next unrelated compile on the
+        # thread — breaking the elastic warm-resume proof that
+        # build_totals deltas are zero on a fully restored worker.
+        shape_probe = {"on": False}
+
         def _step(masters, other_vals, states, aux_vals, residuals, keys,
                   lrs, wds, extras, opt_key):
             # body runs only when jax (re)traces: counts real recompiles
             # of the fused step alongside the executor-cache counters
-            _exec_cache.note_trace("fused_step", memprof_label)
+            _exec_cache.note_trace("fused_step", memprof_label,
+                                   build_record=not shape_probe["on"])
             arg_map = dict(zip(other_names, other_vals))
             aux_map = dict(zip(aux_names, aux_vals))
 
@@ -485,9 +499,13 @@ class FusedTrainStep:
         f32v = sds((n_params,), np.float32)
         exv = sds((n_params, max(n_extra, 1)), np.float32)
         kv = sds((2,), np.uint32)
-        outs_sd = jax.eval_shape(
-            _step, mvals, others, svals, avals, rvals, keys, f32v, f32v,
-            exv, kv)[0]
+        shape_probe["on"] = True
+        try:
+            outs_sd = jax.eval_shape(
+                _step, mvals, others, svals, avals, rvals, keys, f32v,
+                f32v, exv, kv)[0]
+        finally:
+            shape_probe["on"] = False
         # XLA derives the gradient all-reduce from these shardings — the
         # kvstore collective collapsed into the step program (monolithic
         # mode) or scheduled per bucket by the shard_map body (overlap)
@@ -782,6 +800,38 @@ class FusedTrainStep:
         return NDArray(self._replica_shard(arr, dev) if self.n_dev > 1
                        else arr)
 
+    def sync_masters(self, arg_params, aux_params):
+        """Copy the step's authoritative state into the host master
+        dicts BITWISE (in each param's storage dtype — under
+        multi_precision the bf16 value the forward consumes, exactly
+        what the exec dicts hold).  Replaces the exec group's
+        cross-device replica average for checkpointing: averaging N
+        bitwise-identical replicas rounds, and a checkpoint an ulp off
+        the live state breaks bitwise resume."""
+        exe = self.exe
+        covered = set()
+        for j, name in enumerate(self.param_names):
+            if name in arg_params:
+                arg_params[name]._h.array = jax.device_put(
+                    np.asarray(self._masters[j])
+                    .astype(self.param_dtypes[j]),
+                    arg_params[name].context.jax_device())
+                covered.add(name)
+        for name, nd in arg_params.items():
+            # fixed (gradient-free) params are not step state: their
+            # bound exec value is already authoritative
+            if name not in covered and name in exe.arg_dict:
+                nd._h.array = jax.device_put(
+                    np.asarray(exe.arg_dict[name]._h.array)
+                    .astype(np.dtype(nd.dtype)),
+                    nd.context.jax_device())
+        for j, name in enumerate(self.prog.aux_names):
+            if name in aux_params:
+                aux_params[name]._h.array = jax.device_put(
+                    np.asarray(self._gaux[j])
+                    .astype(np.dtype(aux_params[name].dtype)),
+                    aux_params[name].context.jax_device())
+
     def transfer_to_updater(self, updater):
         """Seed a local Updater's per-index state from the fused buffers so
         retiring the fused path mid-training keeps optimizer state (and the
@@ -824,26 +874,62 @@ class FusedTrainStep:
                 "buckets": [np.asarray(r) for r in self._residuals]}
         return out
 
+    def _load_residuals(self, comm_st):
+        """Restore checkpointed error-feedback residuals: bitwise when
+        the layout matches, dp-axis sum-merged when the checkpoint was
+        written by a larger factorization this mesh's dp width divides
+        (elastic resume onto surviving workers), dropped with a warning
+        otherwise — a residual applied under the wrong quantization
+        layout would inject noise, not correction."""
+        logger = self.module.logger
+        saved_sig = comm_st.get("signature")
+        cur_sig = _comm.comm_signature()
+        if saved_sig is not None and tuple(saved_sig) != tuple(cur_sig):
+            logger.warning(
+                "checkpointed compression residuals were written under "
+                "comm signature %s but the current configuration is %s; "
+                "dropping them (error feedback restarts from zero)",
+                tuple(saved_sig), tuple(cur_sig))
+            self._residuals = [
+                jax.device_put(np.zeros(tuple(r.shape), np.float32),
+                               self._sh_dp) for r in self._residuals]
+            return
+        buckets = [np.asarray(b, np.float32)
+                   for b in comm_st.get("buckets", [])]
+        want = [tuple(r.shape) for r in self._residuals]
+        if [b.shape for b in buckets] != want:
+            resharded, reason = (None, "bucket count changed") \
+                if len(buckets) != len(want) \
+                else _comm.reshard_residuals(buckets, self.n_dev)
+            if resharded is not None \
+                    and [r.shape for r in resharded] == want:
+                logger.info(
+                    "elastic resume: sum-merged compression residuals "
+                    "from dp=%d onto dp=%d (pending quantization error "
+                    "conserved)", buckets[0].shape[0], self.n_dev)
+                buckets = resharded
+            else:
+                logger.warning(
+                    "checkpointed compression residuals do not match "
+                    "the current bucket layout (%s vs %s%s); dropping "
+                    "them (error feedback restarts from zero)",
+                    [tuple(b.shape) for b in buckets], want,
+                    "; " + reason if reason else "")
+                self._residuals = [
+                    jax.device_put(np.zeros(s, np.float32), self._sh_dp)
+                    for s in want]
+                return
+        self._residuals = [jax.device_put(b, self._sh_dp)
+                           for b in buckets]
+
     def load_states(self, states):
         comm_st = states.get(self._RESIDUAL_KEY) \
             if isinstance(states, dict) else None
         if comm_st is not None and self._residuals:
-            buckets = comm_st.get("buckets", [])
-            if [tuple(np.asarray(b).shape) for b in buckets] \
-                    == [tuple(r.shape) for r in self._residuals]:
-                self._residuals = [
-                    jax.device_put(np.asarray(b, np.float32), self._sh_dp)
-                    for b in buckets]
-            else:
-                self.module.logger.warning(
-                    "checkpointed compression residuals do not match the "
-                    "current bucket layout (%s vs %s); keeping the "
-                    "in-memory residuals",
-                    [tuple(np.asarray(b).shape) for b in buckets],
-                    [tuple(r.shape) for r in self._residuals])
+            self._load_residuals(comm_st)
         for n, v in states.items():
             if n not in self.param_names:
-                continue
+                continue  # __comm_residuals__ handled above
             j = self.param_names.index(n)
             if isinstance(v, dict):  # fused_v2
                 st = v["state"]

@@ -283,7 +283,18 @@ class Module(BaseModule):
         self.params_initialized = True
 
     def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
+        fs = getattr(self, "_fused_step", None)
+        if fs is not None and fs.ran:
+            # the fused step's masters ARE the trained state: copy them
+            # out bitwise.  The general path's cross-device AVERAGE of
+            # replicas rounds (a running sum of 8 identical f32 values
+            # passes through 3x/5x/7x, each up to 1 ulp off), which
+            # would make a checkpoint differ from the live state —
+            # breaking the elastic resume contract that a resumed run
+            # replays the uninterrupted one bitwise.
+            fs.sync_masters(self._arg_params, self._aux_params)
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     # -- optimizer -----------------------------------------------------------

@@ -7,8 +7,11 @@ the last ``MXNET_TPU_FLIGHT_STEPS`` (default 512) per-step records
 the last 200 ``mxnet_tpu.*`` log records (via a handler on the package
 root logger), recent discrete events (anomalies, serving failures,
 exceptions), the last 128 autotune decision records
-(observability/autotune.py; rendered by ``traceview --tuning``) — plus
-an env/config fingerprint, and dumps them all as ONE strict-JSON file:
+(observability/autotune.py; rendered by ``traceview --tuning``), the
+last 128 elastic lifecycle records (checkpoints, preemption signals,
+resumes, chaos faults — ``mxnet_tpu/elastic/``; rendered by
+``traceview --elastic``) — plus an env/config fingerprint, and dumps
+them all as ONE strict-JSON file:
 
 - on anomaly (``HealthMonitor`` actions ``dump``/``raise``),
 - on unhandled exception in ``fit`` / the serving dispatch thread
@@ -43,6 +46,7 @@ DEFAULT_STEPS = 512
 LOG_CAPACITY = 200
 EVENT_CAPACITY = 64
 DECISION_CAPACITY = 128
+ELASTIC_CAPACITY = 128
 
 # env fingerprint: every knob that could explain a divergence later
 _FINGERPRINT_PREFIXES = ("MXNET_TPU_", "JAX_", "XLA_", "DMLC_")
@@ -121,6 +125,7 @@ class FlightRecorder:
         self._events = deque(maxlen=EVENT_CAPACITY)
         self._logs = deque(maxlen=LOG_CAPACITY)
         self._decisions = deque(maxlen=DECISION_CAPACITY)
+        self._elastic = deque(maxlen=ELASTIC_CAPACITY)
         self._anomalies = []
         self._handler = None
         self._dumped_reasons = set()
@@ -188,6 +193,30 @@ class FlightRecorder:
     def decisions_recorded(self):
         with self._lock:
             return len(self._decisions)
+
+    def note_elastic(self, record):
+        """One elastic lifecycle record (checkpoint committed/rejected,
+        preemption signal, resume, chaos fault) — its own bounded ring
+        so ``tools/traceview.py --elastic`` can reconstruct the
+        checkpoint/resume lineage from any dump without competing with
+        anomalies for the small event ring."""
+        entry = dict(record)
+        entry.setdefault("t", time.time())
+        with self._lock:
+            self._elastic.append(entry)
+
+    def elastic_recorded(self):
+        with self._lock:
+            return len(self._elastic)
+
+    def last_checkpoint_step(self):
+        """Step of the newest committed-checkpoint record (None when no
+        checkpoint was recorded) — ``traceview --flight`` notes it."""
+        with self._lock:
+            for entry in reversed(self._elastic):
+                if entry.get("kind") == "checkpoint":
+                    return entry.get("step")
+        return None
 
     def note_anomaly(self, record):
         """A fired health anomaly (called by ``HealthMonitor``)."""
@@ -290,6 +319,7 @@ class FlightRecorder:
                                        if self._anomalies else None),
                 "logs": list(self._logs),
                 "tuning": list(self._decisions),
+                "elastic": list(self._elastic),
             }
         doc["telemetry"] = telemetry_snap
         if sections:
@@ -350,6 +380,10 @@ def note(kind, payload=None):
 
 def note_exception(exc):
     get_recorder().note_exception(exc)
+
+
+def note_elastic(record):
+    get_recorder().note_elastic(record)
 
 
 def dump(path=None, reason="on_demand", sections=None):

@@ -194,6 +194,21 @@ def note_restore(label, nbytes=0):
     warm-started replicas, and it is NOT a recompile: no retrace counter
     moves and no ``recompile_cause:*`` fires."""
     _install_listener()
+    armed = getattr(_tls, "armed", None)
+    if armed is not None and not armed.get("lower_ms") \
+            and not armed.get("compile_ms"):
+        # safety net: a record armed by a trace that never lowered is
+        # waiting for a compile this restore just proved is never
+        # coming.  Retract it — otherwise a warm boot reads built != 0
+        # and the dangling arm attributes the next UNRELATED compile
+        # on this thread here, both of which break the elastic
+        # warm-resume proof (build_totals deltas must be zero on a
+        # fully disk-restored replacement worker).
+        _tls.armed = None
+        with _lock:
+            if armed in _records:
+                _records.remove(armed)
+                _totals["built"] -= 1
     rec = {"kind": "disk", "label": label or "?", "t": time.time(),
            "trace_ms": 0.0, "lower_ms": 0.0, "compile_ms": 0.0,
            "memory": None, "restored_bytes": int(nbytes)}
@@ -311,13 +326,18 @@ def aot_compile(jitted, args, kind, label, capture_memory=None):
     _tls.armed = None
     lowered = jitted.lower(*args)
     rec = getattr(_tls, "armed", None)
-    compiled = lowered.compile()
     if rec is None:
-        # jaxpr-cache hit: the body did not re-run (the plain jit path
-        # would not have counted a retrace either) — open a record for
-        # the new executable so the memory table is complete
+        # jaxpr-cache hit: the body did not re-run, so no in-body
+        # note_trace armed a record (the dp fused step always lands
+        # here — its shape probe owns the only body run).  Open one
+        # NOW, before compile, so the backend-compile phase attributes
+        # to this executable instead of vanishing unarmed.
         rec = note_build(kind, label)
-        _tls.armed = None
+    compiled = lowered.compile()
+    # a cached/deduplicated compile may fire no closing event: never
+    # leave the record armed past this build (a dangling arm would
+    # swallow the next unrelated compile on the thread)
+    _tls.armed = None
     if enabled() if capture_memory is None else capture_memory:
         rec["memory"] = _memory_analysis_dict(compiled)
     return compiled

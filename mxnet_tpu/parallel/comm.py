@@ -270,6 +270,41 @@ def reduce_buckets(grads, axis_name, plan, residuals=None):
     return out, new_residuals
 
 
+# -- elastic resume: residual resharding --------------------------------------
+
+def reshard_residuals(buckets, new_dp):
+    """Re-factorize checkpointed error-feedback residuals onto a new dp
+    width (elastic resume: surviving-worker count != original).
+
+    Each bucket rides as ``(dp, n)`` — one flat f32 error vector per
+    shard.  When workers merge (``old_dp`` divisible by ``new_dp``) the
+    pending quantization error is conserved by SUM-merging each group
+    of ``old_dp // new_dp`` old shards into the new shard that takes
+    over their data: the next quantize(local+residual) then carries
+    exactly the error the retired workers still owed the wire.  A
+    width the old one does not divide (including growing the mesh) has
+    no information-preserving mapping — the caller drops the residuals
+    with a warning (the PR 10 layout-change contract).
+
+    Returns ``(new_buckets, None)`` or ``(None, reason)``."""
+    out = []
+    for j, bucket in enumerate(buckets):
+        arr = np.asarray(bucket, np.float32)
+        if arr.ndim != 2:
+            return None, ("bucket %d has rank %d, expected (dp, n)"
+                          % (j, arr.ndim))
+        old_dp = arr.shape[0]
+        if old_dp == new_dp:
+            out.append(arr)
+            continue
+        if new_dp <= 0 or old_dp % new_dp:
+            return None, ("dp axis %d is not divisible by the new "
+                          "factorization %d" % (old_dp, new_dp))
+        out.append(arr.reshape(new_dp, old_dp // new_dp,
+                               arr.shape[1]).sum(axis=1))
+    return out, None
+
+
 # -- compiled-HLO evidence ----------------------------------------------------
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",

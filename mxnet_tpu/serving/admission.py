@@ -124,6 +124,18 @@ class AdmissionController:
             self._closed = True
             self._cond.notify_all()
 
+    def drain_remaining(self):
+        """Take every still-queued request out of the queue (the
+        drain-deadline path: the dispatch thread did not get to them in
+        time and the caller rejects each with a typed ``ServerClosed``).
+        Call after :meth:`close`; wakes the consumer so it observes the
+        empty queue and exits."""
+        with self._cond:
+            remaining = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        return remaining
+
     def _sweep_locked(self, expired_out):
         """Move expired requests from the queue into ``expired_out``."""
         now = time.monotonic()

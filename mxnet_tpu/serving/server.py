@@ -291,7 +291,14 @@ class Server:
     def close(self, drain=True, timeout=None):
         """Graceful shutdown: stop the HTTP listener, refuse new
         admissions (``ServerClosed``), and — with ``drain=True`` — wait
-        for the dispatch thread to complete every queued request."""
+        for the dispatch thread to complete every queued request.
+
+        ``timeout`` bounds the drain (the preemption contract: a
+        SIGTERM'd replica gets a grace period, not forever): requests
+        still queued when the deadline expires are rejected with a
+        typed ``ServerClosed`` instead of left hanging on futures no
+        replica will ever resolve.  The batch already at the predictor
+        finishes regardless — only undispatched work is shed."""
         with self._close_lock:
             if self._closed:
                 return
@@ -303,6 +310,69 @@ class Server:
         self.admission.close()
         if self.batcher.started and drain:
             self.batcher.join(timeout)
+            if self.batcher.alive:
+                shed = self.admission.drain_remaining()
+                for request in shed:
+                    self.batcher.reject(request, ServerClosed(
+                        "server drain deadline (%.1fs) expired before "
+                        "this queued request for model %r was "
+                        "dispatched" % (timeout or 0.0, request.model)))
+                if shed:
+                    _module_logger(__name__).warning(
+                        "drain deadline expired: rejected %d queued "
+                        "request(s) with ServerClosed", len(shed))
+
+    def install_signal_handlers(self, drain_deadline_s=30.0,
+                                signals=None):
+        """Wire SIGTERM/SIGINT to a graceful bounded drain: a preempted
+        replica finishes its in-flight requests instead of dropping
+        them, and anything still queued past ``drain_deadline_s`` is
+        rejected with typed ``ServerClosed`` (``close(drain=True,
+        timeout=...)``).  The previous handler (if callable) runs after
+        the drain so process supervisors keep their exit semantics.
+        Returns the list of signals actually hooked (empty off the main
+        thread, where Python forbids installing handlers).
+
+        The handler itself only STARTS a drain thread: it runs on the
+        interrupted main thread, which may already hold the
+        non-reentrant flight-recorder or logging lock — draining (or
+        even logging) in signal context would self-deadlock exactly
+        the preempted process this exists to wind down gracefully."""
+        import signal as _signal
+        if signals is None:
+            signals = (_signal.SIGTERM, _signal.SIGINT)
+        if not hasattr(self, "_prev_signal_handlers"):
+            self._prev_signal_handlers = {}
+
+        def _drain(signum):
+            _module_logger(__name__).warning(
+                "signal %d: draining serving (deadline %.1fs)",
+                signum, drain_deadline_s)
+            from ..observability import flight_recorder as _flight
+            _flight.note_elastic({"kind": "serving_drain",
+                                  "signal": int(signum),
+                                  "deadline_s": drain_deadline_s})
+            self.close(drain=True, timeout=drain_deadline_s)
+            prev = self._prev_signal_handlers.get(signum)
+            if callable(prev):
+                prev(signum, None)
+
+        def _handler(signum, frame):
+            threading.Thread(target=_drain, args=(signum,),
+                             name="mxnet_tpu-serving-drain",
+                             daemon=True).start()
+
+        installed = []
+        for sig in signals:
+            try:
+                self._prev_signal_handlers[sig] = _signal.signal(
+                    sig, _handler)
+                installed.append(sig)
+            except ValueError:
+                _module_logger(__name__).warning(
+                    "cannot install the serving drain handler for "
+                    "signal %s off the main thread", sig)
+        return installed
 
     @property
     def closed(self):

@@ -4,6 +4,7 @@
     python tools/traceview.py --serving /tmp/trace_or_telemetry.json
     python tools/traceview.py --flight /tmp/flight_dump.json
     python tools/traceview.py --memory /tmp/memory_report_or_flight.json
+    python tools/traceview.py --elastic /tmp/flight_dump.json
 
 Three views over one trace:
 
@@ -47,6 +48,15 @@ cost paid (retraces spent vs budget).  Accepts a flight dump (the
 `tuning` ring every dump carries), a bare JSON list of decision
 records, or a `{"decisions": [...]}` document.  Exits 2 when the input
 holds no decisions (the autotune layer never ran).
+
+`--elastic` renders the checkpoint/resume lineage
+(`mxnet_tpu/elastic/`): every committed snapshot (step, trigger
+reason, bytes, wall ms), rejected-at-verify snapshots with their
+problems, preemption signals, chaos faults, and resume records with
+their warm-restore counters (disk restores / builds / backend
+compiles).  Accepts a flight dump (the `elastic` ring every dump
+carries), a bare JSON list of records, or an `{"elastic": [...]}`
+document.  Exits 2 when the input holds no elastic records.
 
 Understands both the native "X" complete-event encoding and legacy
 "B"/"E" pairs (paired LIFO per (cat, name, tid, pid))."""
@@ -405,6 +415,15 @@ def summarize_flight(doc, trend_rows=12):
     if decisions:
         lines.append("autotune decisions: %d (render with --tuning)"
                      % len(decisions))
+    elastic = doc.get("elastic") or []
+    if elastic:
+        estats = elastic_stats(elastic)
+        note = "elastic records: %d (render with --elastic)" \
+            % len(elastic)
+        if estats["last_checkpoint_step"] is not None:
+            note += "; last checkpoint: step %s" \
+                % estats["last_checkpoint_step"]
+        lines.append(note)
     if doc.get("memory"):
         # an OOM dump embeds the full memory report — render it inline
         lines.append("")
@@ -594,6 +613,103 @@ def summarize_tuning(records, top=20):
         if decision:
             lines.append("  decision:  %s" % json.dumps(decision,
                                                         sort_keys=True))
+    return "\n".join(lines)
+
+
+# -- elastic view ------------------------------------------------------------
+
+def elastic_records(doc):
+    """Extract the elastic lineage list from any accepted input form:
+    a flight dump (its ``elastic`` ring), an ``{"elastic": [...]}``
+    document, or a bare JSON list of records."""
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("elastic"), list):
+        return doc["elastic"]
+    return []
+
+
+def elastic_stats(records):
+    """The machine-readable summary `--elastic` renders (and tests +
+    bench assert on): per-kind counts, the checkpoint list, the last
+    checkpoint step, rejected snapshots, and resume records with their
+    warm-restore counters."""
+    by_kind = {}
+    checkpoints = []
+    rejected = []
+    resumes = []
+    for r in records:
+        kind = r.get("kind", "?")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if kind == "checkpoint":
+            checkpoints.append({"step": r.get("step"),
+                                "reason": r.get("reason"),
+                                "bytes": r.get("bytes"),
+                                "wall_ms": r.get("wall_ms"),
+                                "path": r.get("path")})
+        elif kind == "checkpoint_rejected":
+            rejected.append({"step": r.get("step"),
+                             "problems": r.get("problems")})
+        elif kind == "resume":
+            resumes.append({"from_step": r.get("from_step"),
+                            "refactorized": r.get("refactorized"),
+                            "n_dev_from": r.get("n_dev_from"),
+                            "n_dev_to": r.get("n_dev_to"),
+                            "warm": r.get("warm") or {},
+                            "comm_retuned": r.get("comm_retuned")})
+    return {"records": len(records), "by_kind": by_kind,
+            "checkpoints": checkpoints,
+            "last_checkpoint_step": (checkpoints[-1]["step"]
+                                     if checkpoints else None),
+            "rejected": rejected, "resumes": resumes}
+
+
+def summarize_elastic(records):
+    """The text report for one elastic lineage."""
+    stats = elastic_stats(records)
+    lines = ["== elastic: checkpoint/resume lineage =="]
+    if not records:
+        lines.append("(no elastic records — was a Checkpointer "
+                     "attached?  see docs/elastic.md)")
+        return "\n".join(lines)
+    lines.append("records: %d   checkpoints: %d   rejected: %d   "
+                 "resumes: %d"
+                 % (stats["records"], len(stats["checkpoints"]),
+                    len(stats["rejected"]), len(stats["resumes"])))
+    lines.append("%-24s %s" % ("Kind", "Count"))
+    for kind in sorted(stats["by_kind"]):
+        lines.append("%-24s %5d" % (kind, stats["by_kind"][kind]))
+    if stats["checkpoints"]:
+        lines.append("")
+        lines.append("%-10s %-18s %12s %9s" % ("Step", "Trigger",
+                                               "Bytes", "Wall ms"))
+        for c in stats["checkpoints"]:
+            lines.append("%-10s %-18s %12s %9s"
+                         % (c["step"], c["reason"],
+                            _fmt_bytes(_fnum(c["bytes"], 0)),
+                            c["wall_ms"]))
+        lines.append("last checkpoint: step %s"
+                     % stats["last_checkpoint_step"])
+    for r in stats["rejected"]:
+        lines.append("REJECTED snapshot step %s: %s"
+                     % (r["step"], "; ".join(r["problems"] or [])))
+    for r in stats["resumes"]:
+        warm = r["warm"]
+        lines.append("")
+        lines.append("RESUME from step %s  %s"
+                     % (r["from_step"],
+                        "re-factorized %s -> %s device(s)"
+                        % (r["n_dev_from"], r["n_dev_to"])
+                        if r.get("refactorized")
+                        else "same factorization (%s device(s))"
+                        % r["n_dev_to"]))
+        lines.append("  warm boot: %s disk restore(s), %s built, %s "
+                     "backend compile(s), %s retrace(s)%s"
+                     % (warm.get("restored", 0), warm.get("built", 0),
+                        warm.get("backend_compiles", 0),
+                        warm.get("traces", 0),
+                        "  [comm re-tuned]" if r.get("comm_retuned")
+                        else ""))
     return "\n".join(lines)
 
 
@@ -854,7 +970,20 @@ def main(argv=None):
                         "cost) from a flight dump or a bare decision-"
                         "log JSON; exits 2 when no decisions are "
                         "recorded")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic view: the checkpoint/resume "
+                        "lineage (snapshots by trigger, rejected-at-"
+                        "verify snapshots, preemption signals, resume "
+                        "warm-restore counters) from a flight dump or "
+                        "a bare record-list JSON; exits 2 when no "
+                        "elastic records are recorded")
     args = parser.parse_args(argv)
+    if args.elastic:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        records = elastic_records(doc)
+        print(summarize_elastic(records))
+        return 0 if records else 2
     if args.tuning:
         with open(args.trace) as f:
             doc = json.load(f)
