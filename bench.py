@@ -782,6 +782,322 @@ def serve_smoke():
     }))
 
 
+class OpenLoopTraffic:
+    """Open-loop traffic generator for the serving SLO harness: Poisson
+    arrivals, heavy-tailed request sizes, burst phases.
+
+    Open-loop is the property that matters for tail-latency claims: a
+    closed-loop client (submit, wait, submit) self-throttles when the
+    server slows down, silently hiding the very overload the harness
+    exists to measure.  Here arrivals follow the SCHEDULE — a request
+    fires at its arrival time whether or not earlier ones completed —
+    so overload manifests as queueing and shedding, exactly like real
+    fleet traffic.
+
+    - **Arrivals**: Poisson — exponential inter-arrival gaps at each
+      phase's rate.
+    - **Sizes**: heavy-tailed via a Zipf(a) draw clamped to
+      [1, max_rows] — most requests are 1-2 rows, the tail fills whole
+      buckets (the skewed-traffic shape the ServingBucketTuner and the
+      padded-row accounting care about).
+    - **Bursts**: ``phases`` = [(duration_s, rate_multiplier), ...]
+      replayed in order; a multiplier > 1 is a burst riding on the base
+      rate.
+
+    Deterministic per seed: the (arrival gap, rows) schedule is drawn
+    up front, so two runs at the same seed offer the same traffic.
+    """
+
+    def __init__(self, rate_rps, duration_s, max_rows=8, zipf_a=1.6,
+                 phases=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.schedule = []  # (t_offset_s, n_rows)
+        t = 0.0
+        for dur, mult in (phases or [(duration_s, 1.0)]):
+            end = t + dur
+            rate = max(1e-6, rate_rps * mult)
+            while True:
+                t += rng.exponential(1.0 / rate)
+                if t >= end:
+                    t = end
+                    break
+                rows = int(min(max_rows, rng.zipf(zipf_a)))
+                self.schedule.append((t, rows))
+
+    def total_rows(self):
+        return sum(r for _, r in self.schedule)
+
+    def run(self, submit, payload_for):
+        """Replay the schedule against ``submit(payload, n_rows)``
+        (returns a future or raises a typed rejection).  Returns
+        [(t_offset, n_rows, future_or_None, exc_or_None)].  Late
+        arrivals are fired immediately (the generator never skips —
+        an overloaded server sees ALL the offered load)."""
+        results = []
+        t0 = time.monotonic()
+        for t_off, rows in self.schedule:
+            delay = t0 + t_off - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            payload = payload_for(rows)
+            try:
+                fut = submit(payload, rows)
+                results.append((t_off, rows, fut, None))
+            except Exception as exc:  # typed rejections recorded per arrival
+                results.append((t_off, rows, None, exc))
+        return results
+
+
+def slo_smoke():
+    """Fleet SLO harness CI mode (`make bench-smoke`, `bench.py
+    --slo-smoke`): a 2-replica FleetServer under open-loop traffic,
+    proving the fleet contracts the tests can't see at scale:
+
+    1. **1x load**: skewed open-loop traffic (Poisson arrivals,
+       Zipf-tailed sizes) at ~half the measured capacity — ZERO
+       executor retraces after warmup across both replicas, every
+       served response BITWISE-equal to a plain serverless Predictor
+       replay at its recorded dispatch bucket (regardless of which
+       replica served it), declared SLO met, (almost) nothing shed;
+    2. **2x overload with a burst phase**: the bounded admission queue
+       sheds load — every rejection is a TYPED `Overloaded`, and the
+       p99 of the requests actually SERVED stays within the declared
+       SLO (shedding converts overload into refusals, not into
+       unbounded latency for everyone);
+    3. both replicas took traffic, and `tools/traceview.py --serving`
+       renders the per-replica routing breakdown + SLO attainment
+       table from the telemetry dump.
+
+    The SLO itself is declared from MEASURED warmup cost (a structural
+    bound: admission queue depth x the widest bucket's verified
+    execution cost across replicas, plus scheduling slack) — the
+    harness proves the shedding MECHANISM bounds tail latency, on any
+    box speed.
+    """
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, serving
+    from mxnet_tpu.observability import telemetry
+    from mxnet_tpu.predict import Predictor
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    os.environ.pop("MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS", None)
+    os.environ.pop("MXNET_TPU_SERVING_QUEUE_DEPTH", None)
+    os.environ.pop("MXNET_TPU_AUTOTUNE_EVERY_S", None)
+
+    rng = np.random.RandomState(0)
+    telemetry.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    feat, classes = 8, 4
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, feat))
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+
+    queue_depth = 16
+    fleet = serving.FleetServer(n_replicas=2, max_batch_size=8,
+                                batch_window_ms=1.0,
+                                queue_depth=queue_depth)
+    fleet.add_model("mlp", sym, arg_params, input_shapes={"data": (feat,)})
+    report = fleet.warmup()
+    assert len(report["replicas"]) == 2, report
+
+    # declared SLO from MEASURED cost: the widest bucket's verified
+    # execution cost (max across replicas), times the worst-case queue
+    # occupancy ahead of an admitted request, plus scheduling slack for
+    # a 2-core CI box.  Shedding at the bounded queue is what makes
+    # this a guarantee rather than a hope.
+    max_bucket = max(report["mlp"]["buckets"])
+    cost_ms = max(
+        per_rep.get("bucket_cost_ms", {}).get(str(max_bucket), 0.0)
+        for per_rep in report["mlp"]["per_replica"].values())
+    slo_ms = max(500.0, (queue_depth + 4) * max(cost_ms, 1.0) * 3.0)
+    fleet.registry.get("mlp").slo_ms = slo_ms
+    from mxnet_tpu.serving import metrics as _smetrics
+    _smetrics.record_slo("mlp", slo_ms)
+
+    # measured capacity: rows/s through the widest bucket across the
+    # group (two replicas work in parallel)
+    capacity_rows_s = 2 * max_bucket / max(cost_ms / 1e3, 1e-4)
+    mean_rows = 2.2  # Zipf(1.6) clamped to 8, empirically ~2.2
+    rate_1x = max(20.0, 0.45 * capacity_rows_s / mean_rows)
+    # cap so 1x stays genuinely sub-capacity even where PYTHON
+    # per-request overhead (not the measured program cost) is the
+    # bottleneck — a 2-core CI box serves this MLP at >1k req/s
+    rate_1x = min(rate_1x, 250.0)
+
+    def payload_for(rows):
+        return rng.rand(rows, feat).astype(np.float32)
+
+    def submit(payload, rows):
+        return fleet.submit_async("mlp", {"data": payload})
+
+    def collect(results, timeout=60):
+        """(served list of (payload, fut, outs, latency_ms), sheds)."""
+        served, sheds, others = [], [], []
+        for t_off, rows, fut, exc in results:
+            if exc is not None:
+                (sheds if isinstance(exc, serving.Overloaded)
+                 else others).append(exc)
+                continue
+            try:
+                outs = fut.result(timeout=timeout)
+            except serving.Overloaded as e:
+                sheds.append(e)
+                continue
+            except Exception as e:
+                others.append(e)
+                continue
+            req = fut.request
+            served.append((req, outs))
+        return served, sheds, others
+
+    # -- phase 1: 1x load -----------------------------------------------------
+    traffic_1x = OpenLoopTraffic(rate_1x, duration_s=4.0, max_rows=8,
+                                 seed=1)
+    with executor_cache.watch_traces() as watch:
+        results_1x = collect(traffic_1x.run(submit, payload_for))
+    served_1x, sheds_1x, others_1x = results_1x
+    assert watch.total() == 0, (
+        "retraces under 1x steady-state load: %s" % watch.delta())
+    assert not others_1x, others_1x[:3]
+    n_1x = len(traffic_1x.schedule)
+    assert len(sheds_1x) <= max(2, 0.05 * n_1x), (
+        "1x load shed %d of %d" % (len(sheds_1x), n_1x))
+
+    snap = telemetry.snapshot()
+    mlat = snap.get("serving.request_latency_ms.mlp", {})
+    from mxnet_tpu.observability.telemetry import quantile_from_snapshot
+    p99_1x = quantile_from_snapshot(mlat, 0.99) if mlat.get("count") \
+        else 0.0
+    assert p99_1x <= slo_ms, (
+        "1x p99 %.1f ms blew the declared SLO %.1f ms" % (p99_1x, slo_ms))
+
+    # bitwise oracle: every served response replayed at its recorded
+    # dispatch bucket through a plain serverless Predictor — whichever
+    # replica served it, the bytes must match.  ONE replay helper for
+    # both phases, so what "verified" means cannot drift between them.
+    params_blob = {"arg:%s" % k: v for k, v in arg_params.items()}
+    oracles = {}
+
+    def replay_mismatches(served):
+        checked = mismatches = 0
+        for req, outs in served:
+            b = req.dispatch_bucket
+            oracle = oracles.get(b)
+            if oracle is None:
+                oracle = oracles[b] = Predictor(
+                    sym.tojson(), params_blob, {"data": (b, feat)})
+            solo = np.zeros((b, feat), np.float32)
+            solo[:req.n_rows] = req.inputs["data"]
+            oracle.forward(data=solo)
+            want = oracle.get_output(0).asnumpy()[:req.n_rows]
+            checked += 1
+            if not np.array_equal(outs[0], want):
+                mismatches += 1
+        return checked, mismatches
+
+    checked, mismatches = replay_mismatches(served_1x)
+    assert checked and mismatches == 0, (
+        "%d/%d served responses differ from the serverless replay"
+        % (mismatches, checked))
+
+    # -- phase 2: 2x overload with a burst ------------------------------------
+    lat_before = dict(snap.get("serving.request_latency_ms.mlp", {}))
+    # sustained >=2x of the 1x rate, with a burst phase whose arrival
+    # rate exceeds ANY box's service rate (the submit path costs ~30us;
+    # the serve path costs a device dispatch) — so the bounded queue
+    # provably overflows and shedding must engage
+    traffic_2x = OpenLoopTraffic(
+        rate_1x, duration_s=4.0, max_rows=8, seed=2,
+        phases=[(1.0, 2.0), (1.0, 50.0), (2.0, 3.0)])
+    results_2x = collect(traffic_2x.run(submit, payload_for))
+    served_2x, sheds_2x, others_2x = results_2x
+    assert not others_2x, (
+        "untyped failures under overload: %r" % others_2x[:3])
+    assert sheds_2x, "2x overload shed nothing — queue bound not binding"
+    for exc in sheds_2x:
+        assert isinstance(exc, serving.Overloaded), type(exc)
+
+    snap = telemetry.snapshot()
+    mlat2 = snap.get("serving.request_latency_ms.mlp", {})
+    # overload-phase p99 estimated over the POST-phase-1 observations:
+    # subtract phase 1's bucket counts (same fixed geometry)
+    phase2 = dict(mlat2)
+    if lat_before.get("buckets") and phase2.get("buckets"):
+        phase2 = dict(phase2)
+        phase2["count"] = phase2["count"] - lat_before.get("count", 0)
+        phase2["buckets"] = [a - b for a, b in
+                             zip(phase2["buckets"], lat_before["buckets"])]
+        phase2["min"] = mlat2.get("min")
+        phase2["max"] = mlat2.get("max")
+    p99_2x = quantile_from_snapshot(phase2, 0.99) \
+        if phase2.get("count") else 0.0
+    assert p99_2x <= slo_ms, (
+        "served-request p99 %.1f ms blew the SLO %.1f ms under 2x "
+        "overload — shedding failed to bound tail latency"
+        % (p99_2x, slo_ms))
+
+    # bitwise oracle holds under overload too
+    checked_2x, mismatches_2x = replay_mismatches(served_2x)
+    assert checked_2x and mismatches_2x == 0, (
+        "%d/%d overload-phase responses differ from the serverless "
+        "replay" % (mismatches_2x, checked_2x))
+
+    # both replicas took traffic, none quarantined
+    stats = fleet.group.stats()
+    assert all(s["healthy"] for s in stats), stats
+    assert all(s["dispatches"] > 0 for s in stats), (
+        "a replica served nothing: %s" % stats)
+
+    fleet.close(drain=True, timeout=30)
+
+    # traceview renders the fleet view from the telemetry dump
+    telem_path = "/tmp/mxnet_tpu_slo_smoke_telemetry.json"
+    with open(telem_path, "w") as f:
+        f.write(telemetry.to_json_lines())
+    traceview = _load_traceview()
+    kind, payload = traceview.load_any(telem_path)
+    rendered = traceview.summarize_serving(kind, payload)
+    assert "per-replica routing" in rendered and "SLO attainment" in \
+        rendered, rendered[:400]
+    tstats = traceview.serving_from_telemetry(payload)
+    assert len(tstats["replicas"]) == 2, tstats["replicas"]
+    assert tstats["slo"] and tstats["slo"][0]["model"] == "mlp", \
+        tstats["slo"]
+
+    shed_frac_2x = len(sheds_2x) / float(len(traffic_2x.schedule))
+    print(json.dumps({
+        "metric": "bench_slo_smoke",
+        "replicas": 2,
+        "slo_ms": round(slo_ms, 1),
+        "rate_1x_rps": round(rate_1x, 1),
+        "phase_1x": {"offered": n_1x, "served": len(served_1x),
+                     "shed": len(sheds_1x),
+                     "p99_ms": round(p99_1x, 2),
+                     "bitwise_checked": checked,
+                     "retraces": 0},
+        "phase_2x": {"offered": len(traffic_2x.schedule),
+                     "served": len(served_2x),
+                     "shed": len(sheds_2x),
+                     "shed_frac": round(shed_frac_2x, 3),
+                     "p99_ms": round(p99_2x, 2)},
+        "replica_dispatches": {str(s["replica"]): s["dispatches"]
+                               for s in stats},
+        "telemetry": telem_path,
+    }))
+
+
 def health_smoke():
     """Health-sentinel CI mode (`make bench-smoke` step 3, `bench.py
     --health-smoke`): proves the sentinel's three contracts on a real
@@ -2278,6 +2594,8 @@ if __name__ == "__main__":
     import sys
     if "--serve-smoke" in sys.argv:
         serve_smoke()
+    elif "--slo-smoke" in sys.argv:
+        slo_smoke()
     elif "--health-smoke" in sys.argv:
         health_smoke()
     elif "--io-smoke" in sys.argv:

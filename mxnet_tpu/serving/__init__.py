@@ -20,6 +20,13 @@ Pieces (each its own module, composable without :class:`Server`):
 - :class:`Server` — futures API (``submit``/``submit_async``),
   ``warmup()`` with zero-recompile verification, optional stdlib HTTP
   endpoint, graceful drain (``server.py``);
+- :class:`FleetServer` / :class:`ReplicaGroup` / :class:`Router` — the
+  fleet tier: N replicas behind the shared admission queue, weighted
+  least-loaded dispatch, per-replica health with quarantine-and-drain
+  (``router.py``, docs/serving.md §fleet);
+- :class:`ContinuousBatcher` — slot-based continuous batching for
+  stateful/recurrent decode: fixed slot count, per-slot state carried
+  on device, streams join/leave without retracing (``continuous.py``);
 - typed rejections (``errors.py``), instrument names (``metrics.py``).
 
 See docs/serving.md for the architecture and the bucket/warmup/
@@ -31,16 +38,22 @@ from __future__ import annotations
 from .admission import (AdmissionController, Request, default_deadline_ms,
                         default_queue_depth)
 from .batcher import DynamicBatcher
+from .continuous import (ContinuousBatcher, DecodeStream,
+                         default_slot_count)
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,
-                     Overloaded, RequestTooLarge, ServerClosed,
-                     ServingError)
+                     NoHealthyReplica, Overloaded, RequestTooLarge,
+                     ServerClosed, ServingError)
 from .registry import ModelRegistry, ServedModel, bucket_for, bucket_sizes
+from .router import FleetServer, Replica, ReplicaGroup, Router, \
+    default_replicas
 from .server import Server
 
 __all__ = [
-    "AdmissionController", "BadRequest", "DeadlineExceeded",
-    "DynamicBatcher", "ModelNotFound", "ModelRegistry", "Overloaded",
-    "Request", "RequestTooLarge", "ServedModel", "Server", "ServerClosed",
-    "ServingError", "bucket_for", "bucket_sizes", "default_deadline_ms",
-    "default_queue_depth",
+    "AdmissionController", "BadRequest", "ContinuousBatcher",
+    "DeadlineExceeded", "DecodeStream", "DynamicBatcher", "FleetServer",
+    "ModelNotFound", "ModelRegistry", "NoHealthyReplica", "Overloaded",
+    "Replica", "ReplicaGroup", "Request", "RequestTooLarge", "Router",
+    "ServedModel", "Server", "ServerClosed", "ServingError", "bucket_for",
+    "bucket_sizes", "default_deadline_ms", "default_queue_depth",
+    "default_replicas", "default_slot_count",
 ]

@@ -63,6 +63,120 @@ def _resolve_future(future, result):
         return False
 
 
+# -- the shared batch-running core (DynamicBatcher + fleet Replica) ----------
+#
+# One place owns "run an assembled group through a ServedModel": the
+# single-process DynamicBatcher below and every fleet Replica worker
+# (serving/router.py) dispatch through these functions, so padding,
+# splitting, metrics and failure accounting cannot drift between the
+# one-replica and N-replica paths.
+
+def assemble_padded(model, batch, bucket):
+    """Concat the requests' input arrays and zero-pad to ``bucket``
+    rows.  One allocation per input: rows copy in-place."""
+    padded = {}
+    for input_name, feature in model.input_shapes.items():
+        buf = np.zeros((bucket,) + feature, dtype=np.float32)
+        off = 0
+        for r in batch:
+            buf[off:off + r.n_rows] = r.inputs[input_name]
+            off += r.n_rows
+        padded[input_name] = buf
+    return padded
+
+
+def split_results(batch, outs, bucket):
+    """Slice each request's rows back out of the batched outputs and
+    resolve its future (list of per-output host arrays)."""
+    off = 0
+    for r in batch:
+        # copy, not view: a retained response must not pin the whole
+        # bucket-sized output (nor expose co-batched rows via .base)
+        result = [o[off:off + r.n_rows].copy() for o in outs]
+        off += r.n_rows
+        r.dispatch_bucket = bucket
+        _resolve_future(r.future, result)
+        metrics.record_request_done(r, time.monotonic())
+
+
+def run_group(model, batch, rows, replica=None):
+    """Run one same-model group end to end: bucket, pad, dispatch,
+    record, split.  RAISES on failure — the caller owns the failure
+    policy (``DynamicBatcher`` fails the futures and continues; a fleet
+    ``Replica`` additionally quarantines itself).  ``replica`` tags the
+    dispatch span + per-replica telemetry with the serving replica
+    index."""
+    name = model.name
+    bucket = bucket_for(rows, model.buckets)
+    padded = assemble_padded(model, batch, bucket)
+    span_args = {"model": name, "bucket": bucket, "rows": rows,
+                 "requests": len(batch)}
+    if replica is not None:
+        span_args["replica"] = int(replica)
+    with tracing.span("serving:batch", category="serving",
+                      pid="serving", args=span_args):
+        t0 = time.monotonic()
+        dispatch_args = {"replica": int(replica)} \
+            if replica is not None else None
+        with tracing.span("serving:dispatch", category="serving",
+                          pid="serving", args=dispatch_args):
+            outs = model.run_batch(bucket, padded)
+        ms = (time.monotonic() - t0) * 1e3
+        metrics.record_dispatch_ms(ms)
+        if replica is not None:
+            metrics.record_replica_dispatch(replica, name, rows, ms)
+    metrics.record_batch(name, bucket, rows)
+    if _health.enabled():
+        _note_output_health(name, bucket, outs)
+    split_results(batch, outs, bucket)
+    return bucket
+
+
+def _note_output_health(model_name, bucket, outs):
+    """Served-output numerics check (opt-in with the health sentinel):
+    host-side isfinite over the already-fetched output arrays — no
+    device sync, no program change.  Warn-only; the batch still
+    ships."""
+    bad = [i for i, o in enumerate(outs)
+           if not np.all(np.isfinite(np.asarray(o)))]
+    if bad:
+        metrics.record_nonfinite_response(model_name, len(bad))
+        _flight.note("serving_nonfinite",
+                     {"model": model_name, "bucket": bucket,
+                      "outputs": bad})
+
+
+def fail_batch(batch, exc, model_name):
+    """Deliver ``exc`` to every request of a failed batch, counting
+    one rejection PER REQUEST actually failed (the reconciliation
+    contract: requests_total minus rejected_total equals responses,
+    so a 4-request batch failure must count 4, not 1)."""
+    reason = getattr(exc, "reason", "dispatch_error")
+    # OOM black box (unconditional — a serving process out of HBM
+    # must leave the memory post-mortem behind even without the
+    # health sentinel): one augmented dump per process, before the
+    # clients see their errors
+    _memprof.maybe_record_oom("serving:%s" % model_name, exc)
+    if _health.enabled():
+        # black-box hook BEFORE the futures resolve: by the time a
+        # client sees the error, the dump exists.  dump_once — a
+        # persistently failing model must not write a file per
+        # batch, so only the process's FIRST failure pays the write.
+        # An OOM skips the generic dump: the augmented oom dump
+        # already exists, and with a fixed MXNET_TPU_FLIGHT_PATH a
+        # second dump would overwrite its memory post-mortem
+        _flight.note("serving_dispatch_error",
+                     {"model": model_name,
+                      "error": "%s: %s" % (type(exc).__name__, exc),
+                      "requests": len(batch)})
+        if not (_memprof.is_oom(exc)
+                and _flight.get_recorder().has_dumped("oom")):
+            _flight.dump_once(reason="serving_exception")
+    for r in batch:
+        if _fail_future(r.future, exc):
+            metrics.record_rejection(reason, model=model_name)
+
+
 class DynamicBatcher:
     """Consumes an :class:`AdmissionController`, dispatches through a
     :class:`ModelRegistry`."""
@@ -74,6 +188,11 @@ class DynamicBatcher:
         self.max_batch_size = int(max_batch_size)
         self.batch_window_ms = float(batch_window_ms)
         self._thread = None
+        # optional per-loop-iteration hook, run on the dispatch thread
+        # AFTER a batch completes (never between assembly and dispatch):
+        # the server's autotune cadence (MXNET_TPU_AUTOTUNE_EVERY_S)
+        # hangs here.  Exceptions are contained by the loop's catch-all.
+        self.cadence = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -109,6 +228,8 @@ class DynamicBatcher:
                 if batch is None:
                     return  # closed and drained
                 self._dispatch(batch)
+                if self.cadence is not None:
+                    self.cadence()
             except Exception:  # the dispatch thread must never die
                 _log.exception("serving dispatch loop survived an "
                                "unexpected error; continuing")
@@ -148,96 +269,11 @@ class DynamicBatcher:
             self._run_group(model, group, group_rows)
 
     def _run_group(self, model, batch, rows):
-        name = model.name
         try:
-            bucket = bucket_for(rows, model.buckets)
-            padded = self._assemble(model, batch, bucket, rows)
-            with tracing.span("serving:batch", category="serving",
-                              pid="serving",
-                              args={"model": name, "bucket": bucket,
-                                    "rows": rows,
-                                    "requests": len(batch)}):
-                t0 = time.monotonic()
-                with tracing.span("serving:dispatch", category="serving",
-                                  pid="serving"):
-                    outs = model.run_batch(bucket, padded)
-                metrics.record_dispatch_ms((time.monotonic() - t0) * 1e3)
-            metrics.record_batch(name, bucket, rows)
-            if _health.enabled():
-                self._note_output_health(name, bucket, outs)
-            self._split(batch, outs, bucket)
+            run_group(model, batch, rows)
         except Exception as exc:  # the dispatch thread must survive
-            self._fail_batch(batch, exc, name)
+            fail_batch(batch, exc, model.name)
 
-    @staticmethod
-    def _note_output_health(model_name, bucket, outs):
-        """Served-output numerics check (opt-in with the health
-        sentinel): host-side isfinite over the already-fetched output
-        arrays — no device sync, no program change.  Warn-only; the
-        batch still ships."""
-        bad = [i for i, o in enumerate(outs)
-               if not np.all(np.isfinite(np.asarray(o)))]
-        if bad:
-            metrics.record_nonfinite_response(model_name, len(bad))
-            _flight.note("serving_nonfinite",
-                         {"model": model_name, "bucket": bucket,
-                          "outputs": bad})
-
-    @staticmethod
-    def _fail_batch(batch, exc, model_name):
-        """Deliver ``exc`` to every request of a failed batch, counting
-        one rejection PER REQUEST actually failed (the reconciliation
-        contract: requests_total minus rejected_total equals responses,
-        so a 4-request batch failure must count 4, not 1)."""
-        reason = getattr(exc, "reason", "dispatch_error")
-        # OOM black box (unconditional — a serving process out of HBM
-        # must leave the memory post-mortem behind even without the
-        # health sentinel): one augmented dump per process, before the
-        # clients see their errors
-        _memprof.maybe_record_oom("serving:%s" % model_name, exc)
-        if _health.enabled():
-            # black-box hook BEFORE the futures resolve: by the time a
-            # client sees the error, the dump exists.  dump_once — a
-            # persistently failing model must not write a file per
-            # batch, so only the process's FIRST failure pays the write.
-            # An OOM skips the generic dump: the augmented oom dump
-            # already exists, and with a fixed MXNET_TPU_FLIGHT_PATH a
-            # second dump would overwrite its memory post-mortem
-            _flight.note("serving_dispatch_error",
-                         {"model": model_name,
-                          "error": "%s: %s" % (type(exc).__name__, exc),
-                          "requests": len(batch)})
-            if not (_memprof.is_oom(exc)
-                    and _flight.get_recorder().has_dumped("oom")):
-                _flight.dump_once(reason="serving_exception")
-        for r in batch:
-            if _fail_future(r.future, exc):
-                metrics.record_rejection(reason, model=model_name)
-
-    @staticmethod
-    def _assemble(model, batch, bucket, rows):
-        """Concat the requests' input arrays and zero-pad to ``bucket``
-        rows.  One allocation per input: rows copy in-place."""
-        padded = {}
-        for input_name, feature in model.input_shapes.items():
-            buf = np.zeros((bucket,) + feature, dtype=np.float32)
-            off = 0
-            for r in batch:
-                buf[off:off + r.n_rows] = r.inputs[input_name]
-                off += r.n_rows
-            padded[input_name] = buf
-        return padded
-
-    @staticmethod
-    def _split(batch, outs, bucket):
-        """Slice each request's rows back out of the batched outputs and
-        resolve its future (list of per-output host arrays)."""
-        off = 0
-        for r in batch:
-            # copy, not view: a retained response must not pin the whole
-            # bucket-sized output (nor expose co-batched rows via .base)
-            result = [o[off:off + r.n_rows].copy() for o in outs]
-            off += r.n_rows
-            r.dispatch_bucket = bucket
-            _resolve_future(r.future, result)
-            metrics.record_request_done(r, time.monotonic())
+    # kept as a method for callers (Server.close's drain shed) that fail
+    # a batch through the batcher object
+    _fail_batch = staticmethod(fail_batch)

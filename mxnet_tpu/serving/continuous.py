@@ -1,0 +1,445 @@
+"""Continuous batching for stateful/recurrent decode (Orca-style
+iteration-level scheduling over one fixed-shape program).
+
+The workloads BucketingModule exists for — LSTM decode, autoregressive
+generation — cannot use the request-level batcher: a request is not one
+forward, it is a SEQUENCE of steps with recurrent state between them,
+and sequences finish at different times.  Request-level batching would
+hold a whole batch hostage to its longest sequence (head-of-line
+blocking) or retrace per occupancy.  This module implements the
+iteration-level alternative:
+
+- ONE bound step program at a fixed batch shape: ``slot_count`` rows
+  (``MXNET_TPU_SERVING_SLOT_COUNT``, default 8).  The step symbol is
+  the same per-step graph BucketingModule unrolls for training (e.g. an
+  ``LSTMCell`` step), bound through ``simple_bind`` exactly like a
+  bucket predictor — so after warmup the executor cache serves every
+  iteration with ZERO retraces, forever, regardless of which streams
+  occupy which slots.
+- Per-slot recurrent state lives ON DEVICE between iterations: each
+  declared state input is fed the previous iteration's corresponding
+  output (a device-resident array — no host round-trip), gated by the
+  slot OCCUPANCY MASK via a row-wise ``where`` select, so a slot whose
+  stream left (EOS) or that a fresh stream just joined starts from
+  exact zeros.  A SELECT (not a multiply) makes the reset
+  unconditional: even a departed stream that overflowed to Inf/NaN
+  cannot poison the next occupant (``0 * Inf`` would be NaN; the
+  select just drops the row), and a kept row passes through bitwise.
+- Streams JOIN a free slot and LEAVE at EOS without any shape change:
+  joins/leaves only edit host-side input rows and the (slot_count,)
+  mask — the program never sees a new signature.
+
+Determinism: the repo's serving contract (docs/serving.md) pins bitwise
+row/offset-invariance within one program shape.  Every iteration of
+every stream runs in the SAME (slot_count)-shaped program, with its
+state row either exact zeros (join) or the bitwise output of its own
+previous iteration — so a stream's decoded outputs are bitwise-equal
+to running it alone through the same slot program, no matter what
+joined or left around it (``tests/test_serving_fleet.py`` pins this).
+
+Usage::
+
+    cb = serving.ContinuousBatcher(
+        step_sym, arg_params,
+        input_shapes={"data": (feat,)},
+        state_shapes={"state_h": (hidden,), "state_c": (hidden,)},
+        state_pairs=[("state_h", 1), ("state_c", 2)],  # output idx
+        slot_count=8)
+    cb.warmup()                       # traces the step + mask programs
+    s = cb.submit({"data": seq})      # seq: (T, feat) — one frame/step
+    cb.drain()                        # or step() under your own loop
+    outs = s.outputs()                # [(T, ...) per non-state output]
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .. import ndarray as _ndops
+from ..base import MXNetError
+from ..context import cpu
+from ..ndarray import NDArray, array as nd_array
+from ..observability import tracing
+from . import metrics
+
+ENV_SLOT_COUNT = "MXNET_TPU_SERVING_SLOT_COUNT"
+DEFAULT_SLOT_COUNT = 8
+
+
+def default_slot_count():
+    try:
+        n = int(os.environ.get(ENV_SLOT_COUNT, str(DEFAULT_SLOT_COUNT)))
+    except ValueError:
+        return DEFAULT_SLOT_COUNT
+    return max(1, n)
+
+
+class DecodeStream:
+    """One logical stream: its input frames, collected outputs, and
+    completion state.  Created by :meth:`ContinuousBatcher.submit`."""
+
+    __slots__ = ("inputs", "length", "eos_fn", "slot", "pos",
+                 "_collected", "_done", "_cond", "error")
+
+    def __init__(self, inputs, length, eos_fn=None):
+        self.inputs = inputs        # {name: (T,) + feature}
+        self.length = length
+        self.eos_fn = eos_fn        # optional (step_outputs_row) -> bool
+        self.slot = None
+        self.pos = 0                # next frame to feed
+        self._collected = []        # per-step list of per-output rows
+        self._done = False
+        self._cond = threading.Condition()
+        self.error = None
+
+    @property
+    def done(self):
+        return self._done
+
+    def _finish(self, error=None):
+        # first finish wins: a close() racing an in-flight step() marks
+        # the stream with the typed close error, and the step's later
+        # EOS bookkeeping must not overwrite it with a clean success
+        with self._cond:
+            if self._done:
+                return
+            self.error = error
+            self._done = True
+            self._cond.notify_all()
+
+    def wait(self, timeout=None):
+        """Block until the stream finished (EOS or error)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise MXNetError("stream did not finish within %ss"
+                                 % timeout)
+        if self.error is not None:
+            raise self.error
+        return self
+
+    def outputs(self):
+        """The decoded outputs: one ``(steps,) + feature`` array per
+        collected (non-state) output, stacked in step order."""
+        if self.error is not None:
+            raise self.error
+        if not self._collected:
+            return []
+        n_outs = len(self._collected[0])
+        return [np.stack([step[i] for step in self._collected])
+                for i in range(n_outs)]
+
+    @property
+    def steps_decoded(self):
+        return len(self._collected)
+
+
+class ContinuousBatcher:
+    """Slot-based iteration-level scheduler over one bound step
+    program (module docstring has the model)."""
+
+    def __init__(self, symbol, arg_params, input_shapes, state_shapes,
+                 state_pairs, slot_count=None, aux_params=None, ctx=None,
+                 collect_outputs=None):
+        """``symbol``: the step graph — data inputs + state inputs ->
+        outputs, where ``state_pairs`` maps each state input name to
+        the output index holding its next value.  ``input_shapes`` /
+        ``state_shapes``: per-row feature shapes (no batch dim).
+        ``collect_outputs``: output indices returned to streams
+        (default: every output NOT claimed as a state by
+        ``state_pairs``)."""
+        self.slot_count = int(slot_count) if slot_count \
+            else default_slot_count()
+        if self.slot_count < 1:
+            raise MXNetError("slot_count must be >= 1")
+        self.input_shapes = {k: tuple(int(d) for d in v)
+                             for k, v in input_shapes.items()}
+        self.state_shapes = {k: tuple(int(d) for d in v)
+                             for k, v in state_shapes.items()}
+        overlap = set(self.input_shapes) & set(self.state_shapes)
+        if overlap:
+            raise MXNetError("names %s are both data inputs and states"
+                             % sorted(overlap))
+        self.state_pairs = [(str(n), int(i)) for n, i in state_pairs]
+        unknown = [n for n, _ in self.state_pairs
+                   if n not in self.state_shapes]
+        if unknown:
+            raise MXNetError("state_pairs name(s) %s missing from "
+                             "state_shapes" % unknown)
+        self._ctx = ctx if ctx is not None else cpu()
+        bind_shapes = {k: (self.slot_count,) + v
+                       for k, v in self.input_shapes.items()}
+        bind_shapes.update({k: (self.slot_count,) + v
+                            for k, v in self.state_shapes.items()})
+        self._sym = symbol
+        self._exe = symbol.simple_bind(self._ctx, grad_req="null",
+                                       **bind_shapes)
+        args = {k: (v if isinstance(v, NDArray) else nd_array(v))
+                for k, v in arg_params.items()}
+        auxs = {k: (v if isinstance(v, NDArray) else nd_array(v))
+                for k, v in (aux_params or {}).items()}
+        self._exe.copy_params_from(args, auxs, allow_extra_params=True)
+        self.output_names = list(symbol.list_outputs())
+        n_outs = len(self.output_names)
+        bad = [i for _, i in self.state_pairs if not 0 <= i < n_outs]
+        if bad:
+            raise MXNetError("state output index(es) %s out of range "
+                             "(%d outputs)" % (bad, n_outs))
+        state_outs = {i for _, i in self.state_pairs}
+        if collect_outputs is None:
+            collect_outputs = [i for i in range(n_outs)
+                               if i not in state_outs]
+        self.collect_outputs = [int(i) for i in collect_outputs]
+        # per-slot scheduling state (host side, _lock-guarded)
+        self._lock = threading.Lock()
+        self._slots = [None] * self.slot_count  # DecodeStream or None
+        self._waiting = []                      # FIFO of DecodeStream
+        # carried device state: state input name -> NDArray of the
+        # previous iteration's corresponding output (None before the
+        # first iteration = feed zeros)
+        self._carry = {name: None for name, _ in self.state_pairs}
+        # occupancy mask (slot_count,) f32: 1 = carry this slot's
+        # state into the next iteration, 0 = start the slot from
+        # exact zeros (row-wise `where` select)
+        self._mask = np.zeros((self.slot_count,), dtype=np.float32)
+        self._zero_inputs = {
+            k: np.zeros((self.slot_count,) + v, dtype=np.float32)
+            for k, v in self.input_shapes.items()}
+        self._zero_states = {
+            k: nd_array(np.zeros((self.slot_count,) + v,
+                                 dtype=np.float32))
+            for k, v in self.state_shapes.items()}
+        self.iterations = 0
+        self._closed = False
+
+    # -- scheduling -----------------------------------------------------------
+
+    def submit(self, inputs, eos_fn=None):
+        """Queue one stream.  ``inputs``: {name: (T,)+feature} — frame
+        t is fed at the stream's t-th iteration.  A bare array is
+        accepted for single-input steps.  ``eos_fn(row_outputs)`` may
+        end the stream early (data-dependent EOS); by default the
+        stream leaves after its last frame.  Returns the
+        :class:`DecodeStream` handle (drive with :meth:`step` /
+        :meth:`drain`, read with ``outputs()``)."""
+        names = sorted(self.input_shapes)
+        if not isinstance(inputs, dict):
+            if len(names) != 1:
+                raise MXNetError("step has inputs %s; pass a "
+                                 "{name: array} dict" % names)
+            inputs = {names[0]: inputs}
+        arrays, length = {}, None
+        for name in names:
+            if name not in inputs:
+                raise MXNetError("missing input %r" % name)
+            arr = np.asarray(inputs[name], dtype=np.float32)
+            feature = self.input_shapes[name]
+            if arr.shape[1:] != feature or arr.ndim != len(feature) + 1 \
+                    or arr.shape[0] == 0:
+                raise MXNetError(
+                    "input %r expects shape (steps,)+%s, got %s"
+                    % (name, feature, arr.shape))
+            if length is None:
+                length = arr.shape[0]
+            elif arr.shape[0] != length:
+                raise MXNetError("inputs disagree on steps: %d vs %d"
+                                 % (length, arr.shape[0]))
+            arrays[name] = arr
+        stream = DecodeStream(arrays, length, eos_fn=eos_fn)
+        with self._lock:
+            # closed-check and append under ONE lock acquisition:
+            # a submit racing close() must either be refused here or
+            # be drained (and failed) by close — never appended after
+            # the drain, where nothing would ever finish it
+            if self._closed:
+                raise MXNetError("ContinuousBatcher is closed")
+            self._waiting.append(stream)
+        return stream
+
+    def _admit_locked(self):
+        """Seat waiting streams in free slots; returns #joins.  A
+        joined slot's mask entry goes to 0 for the NEXT iteration:
+        whatever the program computed there before is dropped by the
+        carry select, so the stream starts from exact-zero state."""
+        joins = 0
+        for slot in range(self.slot_count):
+            if self._slots[slot] is not None or not self._waiting:
+                continue
+            stream = self._waiting.pop(0)
+            stream.slot = slot
+            self._slots[slot] = stream
+            self._mask[slot] = 0.0
+            joins += 1
+        return joins
+
+    def active_streams(self):
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def pending(self):
+        """Streams not yet finished (active + waiting)."""
+        with self._lock:
+            return (sum(1 for s in self._slots if s is not None)
+                    + len(self._waiting))
+
+    # -- the iteration --------------------------------------------------------
+
+    def step(self):
+        """One decode iteration over every occupied slot: seat waiting
+        streams, feed each active stream's next frame (inactive slots
+        feed zeros), run the SAME fixed-shape program, carry state on
+        device, collect output rows, retire EOS streams.  Returns the
+        number of active slots this iteration ran with (0 = nothing to
+        do; the program did not run)."""
+        with self._lock:
+            joins = self._admit_locked()
+            active = [(slot, s) for slot, s in enumerate(self._slots)
+                      if s is not None]
+            if not active:
+                return 0
+            feeds = {k: buf.copy() for k, buf in self._zero_inputs.items()}
+            for slot, stream in active:
+                for name, arr in stream.inputs.items():
+                    feeds[name][slot] = arr[stream.pos]
+            mask_host = self._mask.copy()
+        # device side, outside the lock: feed = data frames + gated
+        # carried state (the row-wise where-select is the join/leave
+        # reset — one cached elementwise program per state shape;
+        # a select, not a multiply, so a departed stream's Inf/NaN
+        # can never bleed into the slot's next occupant)
+        mask_nd = nd_array(mask_host)
+        for name, _ in self.state_pairs:
+            carried = self._carry[name]
+            feeds[name] = self._zero_states[name] if carried is None \
+                else _ndops.where(mask_nd, carried,
+                                  self._zero_states[name])
+        with tracing.span("serving:decode_step", category="serving",
+                          pid="serving",
+                          args={"active": len(active), "joins": joins}):
+            outs = self._exe.forward(is_train=False, **feeds)
+            for name, idx in self.state_pairs:
+                self._carry[name] = outs[idx]
+            host = [outs[i].asnumpy() for i in self.collect_outputs]
+        self.iterations += 1
+        # collect under the lock (no user code), THEN evaluate EOS
+        # outside it: eos_fn is a user callback — running it under the
+        # scheduler lock would deadlock a callback that touches the
+        # batcher, and a raising callback mid-bookkeeping would strand
+        # co-batched streams half-advanced
+        with self._lock:
+            collected = []
+            for slot, stream in active:
+                rows = [h[slot].copy() for h in host]
+                stream._collected.append(rows)
+                stream.pos += 1
+                collected.append((slot, stream, rows))
+        decisions = []
+        for slot, stream, rows in collected:
+            eos = stream.pos >= stream.length
+            error = None
+            if not eos and stream.eos_fn is not None:
+                try:
+                    eos = bool(stream.eos_fn(rows))
+                except Exception as exc:  # a bad callback fails ITS
+                    eos, error = True, exc  # stream, not the batcher
+            decisions.append((slot, stream, eos, error))
+        leaves = 0
+        with self._lock:
+            for slot, stream, eos, _ in decisions:
+                if eos:
+                    self._slots[slot] = None
+                    self._mask[slot] = 0.0
+                    leaves += 1
+                else:
+                    self._mask[slot] = 1.0
+        for _, stream, eos, error in decisions:
+            if eos:
+                stream._finish(error)
+        metrics.record_decode_step(len(active), joins, leaves)
+        return len(active)
+
+    def drain(self, max_iterations=None):
+        """Run :meth:`step` until every submitted stream finished.
+        Returns the number of iterations run."""
+        n = 0
+        while self.pending():
+            if max_iterations is not None and n >= max_iterations:
+                raise MXNetError(
+                    "drain exceeded max_iterations=%d with %d stream(s) "
+                    "unfinished" % (max_iterations, self.pending()))
+            self.step()
+            n += 1
+        return n
+
+    # -- warmup ---------------------------------------------------------------
+
+    def warmup(self, verify=True):
+        """Trace the step + mask programs before traffic: run one idle
+        iteration with a forced active shape (all-zero frames, mask
+        applied), then — with ``verify`` — a second one that must add
+        ZERO executor retraces, exactly the ``Server.warmup`` contract.
+        Idle-slot garbage cannot leak: every join masks its slot's
+        carried state to exact zeros.  Returns {"traces": n}."""
+        from .. import executor_cache
+        if self.pending():
+            raise MXNetError("warmup must run before streams are "
+                             "submitted")
+        with executor_cache.watch_traces() as w:
+            self._warm_iteration()
+        traces = w.total()
+        if verify:
+            with executor_cache.watch_traces() as w2:
+                self._warm_iteration()
+            if w2.total():
+                raise MXNetError(
+                    "continuous-batcher warmup verification failed: %d "
+                    "retraces on the second iteration — steady-state "
+                    "decode would recompile (delta: %s)"
+                    % (w2.total(), w2.delta()))
+        # warmup ran the real program with junk-free zero feeds; reset
+        # the carry so the first real iteration is indistinguishable
+        # from a fresh batcher (mask already all-zero: no slot active)
+        self._carry = {name: None for name, _ in self.state_pairs}
+        self.iterations = 0
+        return {"traces": traces, "slot_count": self.slot_count}
+
+    def _warm_iteration(self):
+        feeds = {k: buf for k, buf in self._zero_inputs.items()}
+        mask_nd = nd_array(self._mask)
+        for name, _ in self.state_pairs:
+            # ALWAYS run the mask select here, even on the first
+            # iteration where steady state would feed plain zeros: the
+            # select is its own cached elementwise program per state
+            # shape, and warmup must trace it or the first mid-traffic
+            # carry would compile in the decode loop
+            feeds[name] = _ndops.where(mask_nd, self._zero_states[name],
+                                       self._zero_states[name])
+        outs = self._exe.forward(is_train=False, **feeds)
+        for name, idx in self.state_pairs:
+            self._carry[name] = outs[idx]
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def close(self):
+        """Refuse new streams and fail the unfinished ones (the bounded
+        analog of a serving drain deadline)."""
+        with self._lock:
+            self._closed = True
+            doomed = [s for s in self._slots if s is not None]
+            doomed += self._waiting
+            self._slots = [None] * self.slot_count
+            self._waiting = []
+            self._mask[:] = 0.0
+        for stream in doomed:
+            stream._finish(MXNetError(
+                "ContinuousBatcher closed with the stream unfinished "
+                "(%d/%d steps decoded)" % (stream.steps_decoded,
+                                           stream.length)))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -61,6 +61,15 @@ class ModelNotFound(ServingError):
     http_status = 404
 
 
+class NoHealthyReplica(ServingError):
+    """Every replica in the fleet group is quarantined — the batch had
+    nowhere to run.  Distinct from ``Overloaded`` (healthy but full) so
+    operators can tell capacity exhaustion from fleet death."""
+
+    reason = "no_healthy_replica"
+    http_status = 503
+
+
 class BadRequest(ServingError):
     """Malformed request payload (HTTP front-end: unparsable JSON,
     missing inputs, wrong feature shape)."""

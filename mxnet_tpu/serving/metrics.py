@@ -26,6 +26,20 @@ parses these):
 - ``serving.rejected_total.<reason>``  counter per typed rejection
 - ``serving.queue_depth``         gauge (live callback)
 
+Fleet tier (docs/serving.md §fleet; the ``--serving`` replica
+breakdown and SLO attainment table parse these):
+
+- ``serving.replica.<i>.dispatches``   counter, batches run by replica i
+- ``serving.replica.<i>.rows``         counter, real rows served by i
+- ``serving.replica.<i>.dispatch_ms``  histogram, executor wall per batch
+- ``serving.replica_quarantined``      counter, replicas quarantined
+- ``serving.request_latency_ms.<model>``  histogram, per-model latency
+  (the SLO attainment input — the process-wide histogram mixes models)
+- ``serving.slo_ms.<model>``           gauge, declared p99 target
+- ``serving.decode.iterations``        counter, continuous-batcher steps
+- ``serving.decode.active_slots``      histogram, occupancy per step
+- ``serving.decode.joins`` / ``serving.decode.leaves``  counters
+
 Trace events (category ``serving``): per-request ``serving:request``
 spans with a nested ``serving:queue`` phase, per-batch ``serving:batch``
 spans with a nested ``serving:dispatch`` phase, and
@@ -96,6 +110,58 @@ def record_dispatch_ms(ms):
                         help="executor wall time per batch").observe(ms)
 
 
+def record_replica_dispatch(replica, model, rows, ms):
+    """Per-replica routing facts (fleet tier): which replica ran the
+    batch, how many real rows it served, and its executor wall time.
+    Cardinality is one series set per replica — replica counts are
+    single digits, the rejected_total.<reason> pattern."""
+    prefix = "serving.replica.%d." % int(replica)
+    telemetry.counter(prefix + "dispatches",
+                      help="batches dispatched to this replica").inc()
+    telemetry.counter(prefix + "rows",
+                      help="real rows served by this replica").inc(rows)
+    telemetry.histogram(prefix + "dispatch_ms",
+                        help="executor wall time per batch on this "
+                             "replica").observe(ms)
+
+
+def record_replica_quarantined(replica, reason):
+    """A replica threw and was quarantined (drained, not the server)."""
+    telemetry.counter("serving.replica_quarantined",
+                      help="replicas quarantined after a dispatch "
+                           "failure").inc()
+    if tracing.is_recording():
+        tracing.emit_instant("serving_replica_quarantined",
+                             category="serving",
+                             args={"replica": int(replica),
+                                   "reason": reason})
+
+
+def record_slo(model, slo_ms):
+    """Declared per-model latency SLO (p99 target, ms) — a gauge so the
+    traceview attainment table can compare observed quantiles against
+    the declared target from a telemetry snapshot alone."""
+    telemetry.gauge("serving.slo_ms." + model,
+                    help="declared p99 latency target (ms)").set(
+        float(slo_ms))
+
+
+def record_decode_step(active_slots, joins, leaves):
+    """One continuous-batcher iteration: slot occupancy + membership
+    churn (serving/continuous.py)."""
+    telemetry.counter("serving.decode.iterations",
+                      help="continuous-batcher iterations").inc()
+    telemetry.histogram("serving.decode.active_slots",
+                        help="occupied slots per iteration").observe(
+        active_slots)
+    if joins:
+        telemetry.counter("serving.decode.joins",
+                          help="streams joined a slot").inc(joins)
+    if leaves:
+        telemetry.counter("serving.decode.leaves",
+                          help="streams left at EOS").inc(leaves)
+
+
 def record_nonfinite_response(model, n_outputs):
     """Served-output health (MXNET_TPU_HEALTH=1): a dispatched batch
     produced non-finite values in ``n_outputs`` of its outputs.  The
@@ -120,6 +186,12 @@ def record_request_done(request, t_done):
     total_s = t_done - request.t_submit
     telemetry.histogram("serving.request_latency_ms",
                         help="submit->completion wall time"
+                        ).observe(total_s * 1e3)
+    # per-model latency: the SLO attainment input (a declared target is
+    # per model; the process-wide histogram mixes models behind one
+    # shared server)
+    telemetry.histogram("serving.request_latency_ms." + request.model,
+                        help="submit->completion wall time for one model"
                         ).observe(total_s * 1e3)
     telemetry.histogram("serving.queue_ms",
                         help="submit->dispatch queue wait"

@@ -63,11 +63,26 @@ class ServedModel:
 
     def __init__(self, name, symbol, arg_params, aux_params, input_shapes,
                  max_batch_size=8, ctx=None, quantize=None,
-                 calibration=None):
+                 calibration=None, slo_ms=None):
         self.name = name
         self.symbol = symbol
         self.buckets = bucket_sizes(max_batch_size)
         self.max_batch_size = max_batch_size
+        # declared per-model latency SLO (p99 target, ms): the contract
+        # the open-loop harness (bench.py --slo-smoke) and the traceview
+        # attainment table judge observed latency against.  None = no
+        # declared target; the env default covers fleets whose deploy
+        # config owns the number.
+        if slo_ms is None:
+            env = os.environ.get("MXNET_TPU_SERVING_SLO_MS", "").strip()
+            try:
+                slo_ms = float(env) if env else None
+            except ValueError:
+                slo_ms = None
+        self.slo_ms = float(slo_ms) if slo_ms else None
+        if self.slo_ms:
+            from . import metrics as _metrics
+            _metrics.record_slo(name, self.slo_ms)
         # int8 serving (docs/serving.md §int8): quantize=None defers to
         # the MXNET_TPU_QUANTIZE env default; the rewrite happens once in
         # the base predictor and every bucket shares its int8 weights
@@ -259,19 +274,19 @@ class ModelRegistry:
 
     def register(self, name, symbol, arg_params, aux_params, input_shapes,
                  max_batch_size=8, ctx=None, quantize=None,
-                 calibration=None):
+                 calibration=None, slo_ms=None):
         """Register a live symbol + params under ``name`` (replacing any
         previous registration) and return its :class:`ServedModel`."""
         model = ServedModel(name, symbol, arg_params, aux_params,
                             input_shapes, max_batch_size=max_batch_size,
                             ctx=ctx, quantize=quantize,
-                            calibration=calibration)
+                            calibration=calibration, slo_ms=slo_ms)
         with self._lock:
             self._models[name] = model
         return model
 
     def load(self, name, prefix, epoch, input_shapes, max_batch_size=8,
-             ctx=None, quantize=None, calibration=None):
+             ctx=None, quantize=None, calibration=None, slo_ms=None):
         """Register from ``save_checkpoint`` artifacts (prefix-symbol.json
         + prefix-%04d.params — the two-artifact reference format)."""
         from ..model import load_checkpoint
@@ -279,7 +294,7 @@ class ModelRegistry:
         return self.register(name, symbol, arg_params, aux_params,
                              input_shapes, max_batch_size=max_batch_size,
                              ctx=ctx, quantize=quantize,
-                             calibration=calibration)
+                             calibration=calibration, slo_ms=slo_ms)
 
     def get(self, name):
         with self._lock:

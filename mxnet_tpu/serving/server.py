@@ -34,7 +34,9 @@ rejection->status mapping is pinned by tests.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -52,6 +54,35 @@ from .errors import (BadRequest, RequestTooLarge, ServerClosed,
 from .registry import ModelRegistry
 
 
+def verify_warm_start(totals_before, disk_before, traces, context):
+    """The warm-boot contract shared by ``Server.warmup`` and
+    ``FleetServer.warmup`` (``expect_warm=True``): since
+    ``totals_before``/``disk_before`` were captured, the warmup must
+    have added ZERO retraces and ZERO builds/backend compiles — every
+    program restored from the persistent cache dir.  Raises MXNetError
+    naming the counts, else returns the report's ``warm_start``
+    section."""
+    from .. import program_cache
+    totals = _memprof.build_totals()
+    built = totals["built"] - totals_before["built"]
+    compiles = (totals["backend_compiles"]
+                - totals_before["backend_compiles"])
+    restored = totals["restored"] - totals_before["restored"]
+    if traces or built or compiles:
+        raise MXNetError(
+            "%s warm-start verification failed: warmup on cache dir %r "
+            "added %d retraces and %d backend compiles (%d programs "
+            "built) — a warm replica must restore everything from "
+            "disk; run prewarm() at deploy time or check "
+            "tools/cachectl.py verify"
+            % (context, program_cache.cache_dir(), traces, compiles,
+               built))
+    return {"traces": 0, "backend_compiles": 0,
+            "disk_restores": restored,
+            "disk_hits": (program_cache.stats()["hits"]
+                          - disk_before["hits"])}
+
+
 class Server:
     """In-process dynamic-batching inference service."""
 
@@ -60,10 +91,15 @@ class Server:
                  http_port=0, auto_start=True):
         self.registry = registry if registry is not None else ModelRegistry()
         self.max_batch_size = int(max_batch_size)
+        self.batch_window_ms = float(batch_window_ms)
         self.admission = AdmissionController(queue_depth)
-        self.batcher = DynamicBatcher(self.registry, self.admission,
-                                      max_batch_size=max_batch_size,
-                                      batch_window_ms=batch_window_ms)
+        self.batcher = self._make_batcher()
+        # autotune cadence (MXNET_TPU_AUTOTUNE_EVERY_S): the controllers
+        # run INSIDE the long-running serving loop, on the dispatch
+        # thread, at most once per period — staged bucket sets adopt at
+        # the next warmup boundary, never mid-traffic.  Unset env = the
+        # hook costs one None check per dispatched batch.
+        self.batcher.cadence = _TunerCadence(self)
         metrics.register_queue_gauge(self.admission)
         self._closed = False
         self._close_lock = threading.Lock()
@@ -74,11 +110,18 @@ class Server:
         if serve_http:
             self._start_http(http_host, http_port)
 
+    def _make_batcher(self):
+        """The dispatch engine behind this server's admission queue —
+        ``FleetServer`` overrides this with the replica-group router."""
+        return DynamicBatcher(self.registry, self.admission,
+                              max_batch_size=self.max_batch_size,
+                              batch_window_ms=self.batch_window_ms)
+
     # -- model management ----------------------------------------------------
 
     def add_model(self, name, symbol, arg_params, aux_params=None,
                   input_shapes=None, ctx=None, quantize=None,
-                  calibration=None):
+                  calibration=None, slo_ms=None):
         """Register a live symbol + params; buckets sized to this
         server's ``max_batch_size``.  ``input_shapes`` maps input name
         -> per-row feature shape (no batch dim): ``{"data": (8,)}``.
@@ -87,23 +130,26 @@ class Server:
         contract) — or padding/co-batching silently corrupts results.
         ``quantize="int8"`` serves the int8 rewrite of the graph
         (per-channel weight scales; ``calibration`` pins activation
-        ranges — docs/serving.md §int8)."""
+        ranges — docs/serving.md §int8).  ``slo_ms`` declares the
+        model's p99 latency target (env default
+        ``MXNET_TPU_SERVING_SLO_MS``) — the number the SLO harness and
+        ``traceview --serving`` attainment table judge against."""
         if not input_shapes:
             raise BadRequest("input_shapes is required: {input_name: "
                              "per-row feature shape}, e.g. {'data': (8,)}")
         return self.registry.register(
             name, symbol, arg_params, aux_params, input_shapes,
             max_batch_size=self.max_batch_size, ctx=ctx,
-            quantize=quantize, calibration=calibration)
+            quantize=quantize, calibration=calibration, slo_ms=slo_ms)
 
     def load_model(self, name, prefix, epoch, input_shapes, ctx=None,
-                   quantize=None, calibration=None):
+                   quantize=None, calibration=None, slo_ms=None):
         """Register from checkpoint artifacts (``save_checkpoint``'s
         prefix-symbol.json + prefix-%04d.params)."""
         return self.registry.load(
             name, prefix, epoch, input_shapes,
             max_batch_size=self.max_batch_size, ctx=ctx,
-            quantize=quantize, calibration=calibration)
+            quantize=quantize, calibration=calibration, slo_ms=slo_ms)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -163,31 +209,15 @@ class Server:
                     help="programs traced during warmup").inc(
                     report[name]["traces_first_pass"])
         if expect_warm:
-            totals = _memprof.build_totals()
-            built = totals["built"] - totals_before["built"]
-            compiles = (totals["backend_compiles"]
-                        - totals_before["backend_compiles"])
-            restored = totals["restored"] - totals_before["restored"]
-            if first_sweep.total() or built or compiles:
-                raise MXNetError(
-                    "serving warm-start verification failed: warmup on "
-                    "cache dir %r added %d retraces and %d backend "
-                    "compiles (%d programs built) — a warm replica must "
-                    "restore everything from disk; run prewarm() at "
-                    "deploy time or check tools/cachectl.py verify"
-                    % (program_cache.cache_dir(), first_sweep.total(),
-                       compiles, built))
+            warm = verify_warm_start(totals_before, disk_before,
+                                     first_sweep.total(), "serving")
             if "warm_start" in report:
                 _module_logger(__name__).warning(
                     'a served model is named "warm_start": the report\'s '
                     "warm-start section is omitted (rename the model to "
                     "get it)")
             else:
-                report["warm_start"] = {
-                    "traces": 0, "backend_compiles": 0,
-                    "disk_restores": restored,
-                    "disk_hits": (program_cache.stats()["hits"]
-                                  - disk_before["hits"])}
+                report["warm_start"] = warm
         if verify:
             for name in names:
                 second = self.registry.get(name).warmup()
@@ -239,6 +269,13 @@ class Server:
                                    for m in per_model.values()),
                 "disk_bytes_written": sum(m["disk_bytes_written"]
                                           for m in per_model.values())}
+
+    def _propagate_staged_buckets(self, model):
+        """Hook for the autotune cadence: the single-registry server has
+        nothing to mirror; ``FleetServer`` copies a staged bucket set
+        onto every replica's twin of ``model`` so all replicas adopt the
+        same set at the next warmup boundary."""
+        return None
 
     def _warmup_memory_report(self, names):
         """The summed-footprint-vs-capacity section of the warmup
@@ -486,6 +523,85 @@ class Server:
         if self._httpd is None:
             return None
         return self._httpd.server_address[:2]
+
+
+ENV_AUTOTUNE_EVERY_S = "MXNET_TPU_AUTOTUNE_EVERY_S"
+
+
+class _TunerCadence:
+    """Periodic autotune inside the serving loop (the ROADMAP autotune
+    remainder: controllers invoked on a schedule in long-running loops,
+    not just at operator/bench call sites).
+
+    ``MXNET_TPU_AUTOTUNE_EVERY_S`` arms it; each elapsed period the
+    dispatch thread runs :class:`~mxnet_tpu.observability.autotune.
+    ServingBucketTuner` over every registered model.  The tuner's own
+    mode gate (``MXNET_TPU_AUTOTUNE=recommend|apply|0``) still decides
+    whether a decision is report-only or STAGES a bucket set — staged
+    adoption happens at the next ``warmup()``/``prewarm()`` boundary,
+    so the cadence never retraces in steady state.  Every run rides
+    the flight recorder's tuning ring like any other autotune decision
+    (``traceview --tuning``).
+
+    The check runs after a dispatched batch completes: an idle server
+    tunes nothing (there is no new traffic evidence to act on), and the
+    tuner cost (a telemetry snapshot + quantile math) is paid at most
+    once per period, never per batch."""
+
+    def __init__(self, server):
+        self._server = server
+        self._next = None
+        self._warned = False
+        self._every = self._parse(os.environ.get(ENV_AUTOTUNE_EVERY_S))
+        if self._every:
+            self._next = time.monotonic() + self._every
+
+    def _parse(self, raw):
+        if not raw:
+            return None
+        try:
+            every = float(raw)
+        except ValueError:
+            every = -1.0
+        if every <= 0:
+            if not self._warned:
+                self._warned = True
+                _module_logger(__name__).warning(
+                    "malformed %s=%r (need a positive number of "
+                    "seconds); serving-loop autotune cadence disabled",
+                    ENV_AUTOTUNE_EVERY_S, raw)
+            return None
+        return every
+
+    @property
+    def enabled(self):
+        return self._every is not None
+
+    def __call__(self):
+        if self._every is None or time.monotonic() < self._next:
+            return None
+        self._next = time.monotonic() + self._every
+        return self.run_once()
+
+    def run_once(self):
+        """One tuner pass over every registered model (also the direct
+        entry for tests/operators).  Never raises — a tuner bug must
+        not take down the dispatch loop it runs on."""
+        from ..observability.autotune import ServingBucketTuner
+        decisions = []
+        try:
+            tuner = ServingBucketTuner()
+            for name in self._server.registry.names():
+                model = self._server.registry.get(name)
+                decision = tuner.run(model)
+                if decision is not None:
+                    decisions.append(decision)
+                self._server._propagate_staged_buckets(model)
+        except Exception:
+            _module_logger(__name__).exception(
+                "serving autotune cadence pass failed; serving "
+                "continues untuned")
+        return decisions
 
 
 class _Handler(BaseHTTPRequestHandler):

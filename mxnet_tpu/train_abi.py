@@ -54,6 +54,20 @@ class TrainSession:
             data_shapes=[(n, shapes[n]) for n in data_names],
             label_shapes=[(n, shapes[n]) for n in label_names] or None,
             for_training=True)
+        # a C host has no way to call mx.random.seed before this init
+        # runs, so the ABI honors MXNET_TPU_SEED: embedded training
+        # binaries (examples/train-c, tests/test_native's convergence
+        # subprocesses) pin their initializer draws explicitly instead
+        # of relying on the interpreter-default seed
+        import os
+        seed_env = os.environ.get("MXNET_TPU_SEED", "").strip()
+        if seed_env:
+            from . import random as random_mod
+            try:
+                random_mod.seed(int(seed_env))
+            except ValueError:
+                raise MXNetError("malformed MXNET_TPU_SEED=%r (need an "
+                                 "integer)" % seed_env)
         self._mod.init_params(initializer or init_mod.Xavier(), force_init=True)
         self._mod.init_optimizer(optimizer=optimizer,
                                  optimizer_params=dict(optimizer_params or

@@ -65,6 +65,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import re
 import sys
 
 # pinned copy of mxnet_tpu/observability/instrument.py:STEP_COMPONENTS —
@@ -748,24 +749,46 @@ def serving_from_trace(events):
     requests, queue, dispatch = [], [], []
     batch_rows = {}
     rejects = {}
+    replicas = {}
     for e in events:
         ph, name = e.get("ph"), e.get("name", "")
         if ph == "X" and e.get("cat") == "serving":
             ms = e.get("dur", 0.0) / 1e3
+            args = e.get("args") or {}
             if name == "serving:request":
                 requests.append(ms)
             elif name == "serving:queue":
                 queue.append(ms)
             elif name == "serving:dispatch":
                 dispatch.append(ms)
+                if args.get("replica") is not None:
+                    rep = replicas.setdefault(
+                        int(args["replica"]),
+                        {"dispatches": 0, "rows": 0, "ms": []})
+                    rep["dispatches"] += 1
+                    rep["ms"].append(ms)
             elif name == "serving:batch":
-                rows = (e.get("args") or {}).get("rows")
+                rows = args.get("rows")
                 if rows is not None:
                     batch_rows[rows] = batch_rows.get(rows, 0) + 1
+                if args.get("replica") is not None and rows is not None:
+                    rep = replicas.setdefault(
+                        int(args["replica"]),
+                        {"dispatches": 0, "rows": 0, "ms": []})
+                    rep["rows"] += rows
         elif ph == "i" and name.startswith("serving_reject:"):
             reason = name[len("serving_reject:"):]
             rejects[reason] = rejects.get(reason, 0) + 1
     requests.sort()
+    replica_rows = []
+    for idx in sorted(replicas):
+        rep = replicas[idx]
+        ms = sorted(rep["ms"])
+        replica_rows.append({
+            "replica": idx, "dispatches": rep["dispatches"],
+            "rows": rep["rows"],
+            "p50": _percentile(ms, 0.50), "p95": _percentile(ms, 0.95),
+            "p99": _percentile(ms, 0.99)})
     return {
         "source": "trace (exact)",
         "requests": len(requests),
@@ -777,6 +800,8 @@ def serving_from_trace(events):
         "batches": sum(batch_rows.values()),
         "batch_rows": batch_rows,
         "rejects": rejects,
+        "replicas": replica_rows,
+        "slo": [],  # declared targets live in telemetry gauges only
     }
 
 
@@ -801,6 +826,49 @@ def serving_from_telemetry(metrics):
     def avg(snap):
         return snap.get("sum", 0.0) / snap["count"] if snap.get("count") \
             else 0.0
+    # per-replica routing breakdown (serving.replica.<i>.*)
+    rep_re = re.compile(r"^serving\.replica\.(\d+)\.(dispatches|rows|"
+                        r"dispatch_ms)$")
+    replicas = {}
+    for name, snap in metrics.items():
+        m = rep_re.match(name)
+        if not m:
+            continue
+        rep = replicas.setdefault(int(m.group(1)),
+                                  {"dispatches": 0, "rows": 0, "ms": None})
+        if m.group(2) == "dispatches":
+            rep["dispatches"] = int(snap.get("value", 0))
+        elif m.group(2) == "rows":
+            rep["rows"] = int(snap.get("value", 0))
+        else:
+            rep["ms"] = snap
+    replica_rows = []
+    for idx in sorted(replicas):
+        rep = replicas[idx]
+        ms = rep["ms"] or {}
+        replica_rows.append({
+            "replica": idx, "dispatches": rep["dispatches"],
+            "rows": rep["rows"],
+            "p50": _hist_quantile(ms, 0.50),
+            "p95": _hist_quantile(ms, 0.95),
+            "p99": _hist_quantile(ms, 0.99)})
+    # SLO attainment: declared targets (serving.slo_ms.<model> gauges)
+    # vs the per-model latency histogram's p99 estimate
+    slo_prefix = "serving.slo_ms."
+    slo_rows = []
+    for name, snap in sorted(metrics.items()):
+        if not name.startswith(slo_prefix):
+            continue
+        model = name[len(slo_prefix):]
+        target = snap.get("value")
+        mlat = metrics.get("serving.request_latency_ms." + model, {})
+        p99 = _hist_quantile(mlat, 0.99)
+        served = mlat.get("count", 0)
+        slo_rows.append({
+            "model": model, "target_ms": target, "served": served,
+            "p50": _hist_quantile(mlat, 0.50),
+            "p95": _hist_quantile(mlat, 0.95), "p99": p99,
+            "met": bool(served) and target is not None and p99 <= target})
     return {
         "source": "telemetry (bucket upper-bound estimates)",
         "requests": lat.get("count", 0),
@@ -812,6 +880,8 @@ def serving_from_telemetry(metrics):
         "batches": batch.get("count", 0),
         "batch_rows": batch_rows,
         "rejects": rejects,
+        "replicas": replica_rows,
+        "slo": slo_rows,
     }
 
 
@@ -841,6 +911,39 @@ def summarize_serving(kind, payload):
                            key=lambda r: float(str(r).lstrip("<="))):
             lines.append("%-12s %7d" % (rows, stats["batch_rows"][rows]))
         lines.append("total batches: %d" % stats["batches"])
+    lines.append("")
+    lines.append("== serving: per-replica routing ==")
+    if not stats.get("replicas"):
+        lines.append("(single-replica or no replica-tagged dispatches "
+                     "recorded)")
+    else:
+        lines.append("%-8s %10s %10s %10s %10s %10s"
+                     % ("Replica", "Dispatches", "Rows", "p50(ms)",
+                        "p95(ms)", "p99(ms)"))
+        for rep in stats["replicas"]:
+            lines.append("%-8d %10d %10d %10.3f %10.3f %10.3f"
+                         % (rep["replica"], rep["dispatches"], rep["rows"],
+                            rep["p50"], rep["p95"], rep["p99"]))
+    lines.append("")
+    lines.append("== serving: SLO attainment ==")
+    if not stats.get("slo"):
+        lines.append("(no declared SLOs — declare with add_model("
+                     "slo_ms=...) or MXNET_TPU_SERVING_SLO_MS; targets "
+                     "live in telemetry gauges, pass a telemetry dump)")
+    else:
+        lines.append("%-16s %10s %8s %10s %10s %10s %6s"
+                     % ("Model", "Target(ms)", "Served", "p50(ms)",
+                        "p95(ms)", "p99(ms)", "Met"))
+        for row in stats["slo"]:
+            lines.append("%-16s %10.1f %8d %10.3f %10.3f %10.3f %6s"
+                         % (row["model"], row["target_ms"] or 0.0,
+                            row["served"], row["p50"], row["p95"],
+                            row["p99"], "yes" if row["met"] else "NO"))
+        shed = sum(stats["rejects"].values())
+        lines.append("shed: %d request(s)%s" % (shed, (
+            " (" + ", ".join("%s=%d" % (r, n) for r, n in
+                             sorted(stats["rejects"].items())) + ")")
+            if shed else ""))
     lines.append("")
     lines.append("== serving: rejections ==")
     if not stats["rejects"]:
