@@ -848,6 +848,83 @@ class OpenLoopTraffic:
         return results
 
 
+def _fleet_slo_setup(queue_depth=16, seed=0):
+    """Shared scaffolding of the slo/reqtrace smokes — ONE recipe for
+    the seeded MLP, the 2-replica fleet, the SLO declared from
+    MEASURED warmup cost (widest bucket's verified execution cost x
+    worst-case queue occupancy ahead of an admitted request, plus
+    scheduling slack for a 2-core CI box), and the 1x open-loop rate
+    derived from measured capacity — so the two harnesses cannot
+    drift apart in calibration."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import metrics as _smetrics
+
+    rng = np.random.RandomState(seed)
+    feat, classes = 8, 4
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, feat))
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+
+    fleet = serving.FleetServer(n_replicas=2, max_batch_size=8,
+                                batch_window_ms=1.0,
+                                queue_depth=queue_depth)
+    fleet.add_model("mlp", sym, arg_params,
+                    input_shapes={"data": (feat,)})
+    report = fleet.warmup()
+
+    # declared SLO from MEASURED cost; shedding at the bounded queue
+    # is what makes it a guarantee rather than a hope
+    max_bucket = max(report["mlp"]["buckets"])
+    cost_ms = max(
+        per_rep.get("bucket_cost_ms", {}).get(str(max_bucket), 0.0)
+        for per_rep in report["mlp"]["per_replica"].values())
+    slo_ms = max(500.0, (queue_depth + 4) * max(cost_ms, 1.0) * 3.0)
+    fleet.registry.get("mlp").slo_ms = slo_ms
+    _smetrics.record_slo("mlp", slo_ms)
+
+    # measured capacity: rows/s through the widest bucket across the
+    # group (two replicas work in parallel)
+    capacity_rows_s = 2 * max_bucket / max(cost_ms / 1e3, 1e-4)
+    mean_rows = 2.2  # Zipf(1.6) clamped to 8, empirically ~2.2
+    # cap so 1x stays genuinely sub-capacity even where PYTHON
+    # per-request overhead (not the measured program cost) is the
+    # bottleneck — a 2-core CI box serves this MLP at >1k req/s
+    rate_1x = min(max(20.0, 0.45 * capacity_rows_s / mean_rows), 250.0)
+    return {"fleet": fleet, "sym": sym, "args": arg_params, "rng": rng,
+            "feat": feat, "report": report, "slo_ms": slo_ms,
+            "rate_1x": rate_1x, "queue_depth": queue_depth}
+
+
+def _collect_fleet_results(results, timeout=60):
+    """Resolve an OpenLoopTraffic run against a fleet: (served list of
+    (request, outs), typed Overloaded sheds, everything else)."""
+    from mxnet_tpu import serving
+    served, sheds, others = [], [], []
+    for t_off, rows, fut, exc in results:
+        if exc is not None:
+            (sheds if isinstance(exc, serving.Overloaded)
+             else others).append(exc)
+            continue
+        try:
+            outs = fut.result(timeout=timeout)
+        except serving.Overloaded as e:
+            sheds.append(e)
+            continue
+        except Exception as e:
+            others.append(e)
+            continue
+        served.append((fut.request, outs))
+    return served, sheds, others
+
+
 def slo_smoke():
     """Fleet SLO harness CI mode (`make bench-smoke`, `bench.py
     --slo-smoke`): a 2-replica FleetServer under open-loop traffic,
@@ -875,7 +952,6 @@ def slo_smoke():
     box speed.
     """
     import os
-    import mxnet_tpu as mx
     from mxnet_tpu import executor_cache, serving
     from mxnet_tpu.observability import telemetry
     from mxnet_tpu.predict import Predictor
@@ -887,54 +963,16 @@ def slo_smoke():
     os.environ.pop("MXNET_TPU_SERVING_QUEUE_DEPTH", None)
     os.environ.pop("MXNET_TPU_AUTOTUNE_EVERY_S", None)
 
-    rng = np.random.RandomState(0)
     telemetry.reset()
     executor_cache.clear()
     executor_cache.reset_stats()
 
-    feat, classes = 8, 4
-    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
-                                name="fc1")
-    net = mx.sym.Activation(net, act_type="relu", name="relu1")
-    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
-    sym = mx.sym.SoftmaxOutput(net, name="softmax")
-    arg_shapes, _, _ = sym.infer_shape(data=(1, feat))
-    arg_params = {
-        n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
-        for n, s in zip(sym.list_arguments(), arg_shapes)
-        if n not in ("data", "softmax_label")}
-
-    queue_depth = 16
-    fleet = serving.FleetServer(n_replicas=2, max_batch_size=8,
-                                batch_window_ms=1.0,
-                                queue_depth=queue_depth)
-    fleet.add_model("mlp", sym, arg_params, input_shapes={"data": (feat,)})
-    report = fleet.warmup()
+    setup = _fleet_slo_setup()
+    fleet, sym, arg_params = setup["fleet"], setup["sym"], setup["args"]
+    rng, feat = setup["rng"], setup["feat"]
+    report, slo_ms, rate_1x = (setup["report"], setup["slo_ms"],
+                               setup["rate_1x"])
     assert len(report["replicas"]) == 2, report
-
-    # declared SLO from MEASURED cost: the widest bucket's verified
-    # execution cost (max across replicas), times the worst-case queue
-    # occupancy ahead of an admitted request, plus scheduling slack for
-    # a 2-core CI box.  Shedding at the bounded queue is what makes
-    # this a guarantee rather than a hope.
-    max_bucket = max(report["mlp"]["buckets"])
-    cost_ms = max(
-        per_rep.get("bucket_cost_ms", {}).get(str(max_bucket), 0.0)
-        for per_rep in report["mlp"]["per_replica"].values())
-    slo_ms = max(500.0, (queue_depth + 4) * max(cost_ms, 1.0) * 3.0)
-    fleet.registry.get("mlp").slo_ms = slo_ms
-    from mxnet_tpu.serving import metrics as _smetrics
-    _smetrics.record_slo("mlp", slo_ms)
-
-    # measured capacity: rows/s through the widest bucket across the
-    # group (two replicas work in parallel)
-    capacity_rows_s = 2 * max_bucket / max(cost_ms / 1e3, 1e-4)
-    mean_rows = 2.2  # Zipf(1.6) clamped to 8, empirically ~2.2
-    rate_1x = max(20.0, 0.45 * capacity_rows_s / mean_rows)
-    # cap so 1x stays genuinely sub-capacity even where PYTHON
-    # per-request overhead (not the measured program cost) is the
-    # bottleneck — a 2-core CI box serves this MLP at >1k req/s
-    rate_1x = min(rate_1x, 250.0)
 
     def payload_for(rows):
         return rng.rand(rows, feat).astype(np.float32)
@@ -942,25 +980,7 @@ def slo_smoke():
     def submit(payload, rows):
         return fleet.submit_async("mlp", {"data": payload})
 
-    def collect(results, timeout=60):
-        """(served list of (payload, fut, outs, latency_ms), sheds)."""
-        served, sheds, others = [], [], []
-        for t_off, rows, fut, exc in results:
-            if exc is not None:
-                (sheds if isinstance(exc, serving.Overloaded)
-                 else others).append(exc)
-                continue
-            try:
-                outs = fut.result(timeout=timeout)
-            except serving.Overloaded as e:
-                sheds.append(e)
-                continue
-            except Exception as e:
-                others.append(e)
-                continue
-            req = fut.request
-            served.append((req, outs))
-        return served, sheds, others
+    collect = _collect_fleet_results
 
     # -- phase 1: 1x load -----------------------------------------------------
     traffic_1x = OpenLoopTraffic(rate_1x, duration_s=4.0, max_rows=8,
@@ -1095,6 +1115,237 @@ def slo_smoke():
         "replica_dispatches": {str(s["replica"]): s["dispatches"]
                                for s in stats},
         "telemetry": telem_path,
+    }))
+
+
+def reqtrace_fleet_worker():
+    """Subprocess half of ``--reqtrace-smoke``'s fleet-merge proof: a
+    SECOND serving process that inherits the parent's env-propagated
+    trace context (``MXNET_TPU_REQTRACE_CTX``), serves a few requests
+    with a deliberately-unmeetable SLO (every journey tail-captures),
+    and writes its standalone reqtrace dump into the shared fleet dir
+    — the artifact ``traceview --fleet`` merges onto the parent's
+    shared-epoch timeline."""
+    import os
+    import sys
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import reqtrace
+
+    out_path = sys.argv[sys.argv.index("--reqtrace-worker") + 1]
+    os.environ["MXNET_TPU_REQTRACE"] = "1"
+    rng = np.random.RandomState(3)
+    feat = 8
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="wfc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, feat))
+    args = {n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    srv = serving.Server(max_batch_size=4, batch_window_ms=0.5)
+    # slo_ms far below any real dispatch: every served request
+    # breaches and pins, so the worker dump holds full waterfalls
+    srv.add_model("worker_mlp", sym, args,
+                  input_shapes={"data": (feat,)}, slo_ms=0.001)
+    srv.warmup()
+    for _ in range(8):
+        srv.submit("worker_mlp",
+                   {"data": rng.rand(2, feat).astype(np.float32)})
+    srv.close()
+    assert reqtrace.stats()["pinned"] > 0, reqtrace.stats()
+    reqtrace.dump(out_path)
+    print(json.dumps({"metric": "reqtrace_fleet_worker",
+                      "root": reqtrace.fleet_header()["root"],
+                      "pinned": reqtrace.stats()["pinned"],
+                      "dump": out_path}))
+
+
+def reqtrace_smoke():
+    """Request-tracing harness CI mode (`make bench-smoke`, `bench.py
+    --reqtrace-smoke`): slo-smoke-style open-loop traffic against a
+    2-replica fleet, proving the reqtrace contracts:
+
+    1. tracing adds ZERO executor retraces (all instrumentation is
+       host-side segment appends);
+    2. every SLO-breaching served request and every typed shed appears
+       in the flight recorder's ``requests`` ring, breaches with a
+       COMPLETE fleet waterfall (queue/route/lane/assemble/dispatch/
+       split) whose segments explain ~100% of measured latency;
+    3. the head-sampled ring stays under its configured byte cap;
+    4. ``traceview --requests`` renders the flight dump and
+       ``traceview --fleet`` merges it with a subprocess worker's dump
+       (env-propagated trace root), both rc 0.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    from mxnet_tpu import executor_cache
+    from mxnet_tpu.observability import (flight_recorder, reqtrace,
+                                         telemetry)
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ.pop("MXNET_TPU_EXEC_CACHE_SIZE", None)
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    os.environ.pop("MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS", None)
+    os.environ.pop("MXNET_TPU_SERVING_QUEUE_DEPTH", None)
+    os.environ.pop("MXNET_TPU_AUTOTUNE_EVERY_S", None)
+    os.environ.pop("MXNET_TPU_FLIGHT_PATH", None)
+    os.environ.pop("MXNET_TPU_REQTRACE_CTX", None)  # fresh trace root
+    os.environ["MXNET_TPU_REQTRACE"] = "8"          # head-sample 1/8
+    ring_bytes = 256 * 1024
+    os.environ["MXNET_TPU_REQTRACE_RING"] = "256"
+    os.environ["MXNET_TPU_REQTRACE_RING_BYTES"] = str(ring_bytes)
+    # the tail ring must hold EVERY shed of the overload phase — the
+    # assertion below is exhaustive, not sampled
+    os.environ["MXNET_TPU_REQTRACE_PINNED"] = "8192"
+
+    telemetry.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+    flight_recorder.reset()
+    reqtrace.reset()
+
+    # same fleet + measured-SLO + rate recipe as slo_smoke (shared
+    # helper — the two harnesses must not drift apart in calibration)
+    setup = _fleet_slo_setup()
+    fleet, rng, feat = setup["fleet"], setup["rng"], setup["feat"]
+    slo_ms, rate_1x = setup["slo_ms"], setup["rate_1x"]
+    mlp = fleet.registry.get("mlp")
+    from mxnet_tpu.serving import metrics as _smetrics
+
+    def payload_for(rows):
+        return rng.rand(rows, feat).astype(np.float32)
+
+    def submit(payload, rows):
+        return fleet.submit_async("mlp", {"data": payload})
+
+    collect = _collect_fleet_results
+
+    with executor_cache.watch_traces() as watch:
+        # phase 1: 1x steady state at the measured SLO
+        traffic_1x = OpenLoopTraffic(rate_1x, duration_s=2.5,
+                                     max_rows=8, seed=1)
+        served_1x, sheds_1x, others_1x = collect(
+            traffic_1x.run(submit, payload_for))
+        assert not others_1x, others_1x[:3]
+
+        # phase 2: tighten the declared SLO below any real dispatch, so
+        # every SERVED request of the overload phase breaches — the
+        # tail-capture path must catch 100% of them — while the burst
+        # overflows the bounded queue and sheds type as Overloaded
+        mlp.slo_ms = 0.01
+        _smetrics.record_slo("mlp", mlp.slo_ms)
+        traffic_2x = OpenLoopTraffic(
+            rate_1x, duration_s=2.5, max_rows=8, seed=2,
+            phases=[(0.75, 2.0), (0.5, 50.0), (1.25, 3.0)])
+        served_2x, sheds_2x, others_2x = collect(
+            traffic_2x.run(submit, payload_for))
+        assert not others_2x, others_2x[:3]
+        assert sheds_2x, "overload shed nothing — queue bound not binding"
+    assert watch.total() == 0, (
+        "request tracing added retraces: %s" % watch.delta())
+
+    stats = reqtrace.stats()
+    assert stats["sampled"] > 0, stats
+    assert stats["sampled_bytes"] <= ring_bytes, stats
+
+    fleet.close(drain=True, timeout=30)
+
+    # the flight dump IS the black box: every shed and every breaching
+    # served request must be in its requests ring
+    fleet_dir = "/tmp/mxnet_tpu_reqtrace_fleet"
+    shutil.rmtree(fleet_dir, ignore_errors=True)
+    os.makedirs(fleet_dir)
+    flight_path = os.path.join(fleet_dir, "flight_parent.json")
+    assert flight_recorder.dump(path=flight_path,
+                                reason="reqtrace_smoke") == flight_path
+    with open(flight_path) as f:
+        doc = json.load(f)
+    pinned = doc.get("requests") or []
+    n_sheds = len(sheds_1x) + len(sheds_2x)
+    overloaded = [r for r in pinned if r.get("reason") == "overloaded"]
+    assert len(overloaded) == n_sheds, (
+        "%d typed sheds but %d pinned overloaded traces"
+        % (n_sheds, len(overloaded)))
+    for r in overloaded:
+        assert r["segments"] and r["segments"][-1]["name"] == "reject", r
+
+    breach_ids = {r["trace_id"] for r in pinned
+                  if r.get("pinned") == "slo_breach"}
+    by_id = {r["trace_id"]: r for r in pinned}
+    hop_names = ("queue", "route", "lane", "assemble", "dispatch",
+                 "split")
+    missing = 0
+    for req, _ in served_2x:
+        tid = req.ctx.trace_id if req.ctx is not None else None
+        if tid is None or tid not in breach_ids:
+            missing += 1
+            continue
+        names = [s["name"] for s in by_id[tid]["segments"]]
+        for hop in hop_names:
+            assert hop in names, (hop, by_id[tid])
+    assert missing == 0, (
+        "%d of %d SLO-breaching served requests missing from the "
+        "flight requests ring" % (missing, len(served_2x)))
+
+    # attribution: segments explain ~100% of measured tail latency
+    traceview = _load_traceview()
+    rstats = traceview.requests_stats(pinned,
+                                      doc.get("requests_sampled") or [])
+    mlp_rows = [m for m in rstats["models"] if m["model"] == "mlp"]
+    assert mlp_rows, rstats
+    coverage = mlp_rows[0]["coverage"]
+    assert coverage >= 0.90, (
+        "waterfall segments explain only %.1f%% of tail latency"
+        % (coverage * 100.0,))
+
+    # fleet-merge proof: a subprocess worker inherits the trace root
+    # from the environment and its dump merges onto our timeline
+    worker_dump = os.path.join(fleet_dir, "reqtrace_worker.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--reqtrace-worker", worker_dump],
+        env=dict(os.environ), capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(worker_dump) as f:
+        wdoc = json.load(f)
+    root = reqtrace.fleet_header()["root"]
+    assert wdoc["fleet"]["root"] == root, (
+        "worker did not inherit the env-propagated trace root: %r vs "
+        "%r" % (wdoc["fleet"].get("root"), root))
+    assert wdoc["requests"], "worker pinned no traces"
+
+    # the CLI contracts: --requests renders the flight dump, --fleet
+    # merges the dir, both rc 0
+    rc_requests = traceview.main(["--requests", flight_path])
+    assert rc_requests == 0, rc_requests
+    rc_fleet = traceview.main(["--fleet", fleet_dir])
+    assert rc_fleet == 0, rc_fleet
+    fstats = traceview.fleet_stats(traceview.fleet_sources(fleet_dir))
+    assert len(fstats["sources"]) == 2, fstats["sources"]
+    assert fstats["roots"] == [root], fstats["roots"]
+
+    print(json.dumps({
+        "metric": "bench_reqtrace_smoke",
+        "slo_ms": round(slo_ms, 1),
+        "phase_1x": {"offered": len(traffic_1x.schedule),
+                     "served": len(served_1x), "shed": len(sheds_1x)},
+        "phase_2x": {"offered": len(traffic_2x.schedule),
+                     "served": len(served_2x), "shed": len(sheds_2x)},
+        "retraces": 0,
+        "pinned": len(pinned),
+        "pinned_overloaded": len(overloaded),
+        "pinned_slo_breach": len(breach_ids),
+        "sampled": stats["sampled"],
+        "sampled_bytes": stats["sampled_bytes"],
+        "sampled_byte_cap": ring_bytes,
+        "tail_coverage": round(coverage, 4),
+        "fleet_dir": fleet_dir,
+        "trace_root": root,
     }))
 
 
@@ -2596,6 +2847,10 @@ if __name__ == "__main__":
         serve_smoke()
     elif "--slo-smoke" in sys.argv:
         slo_smoke()
+    elif "--reqtrace-smoke" in sys.argv:
+        reqtrace_smoke()
+    elif "--reqtrace-worker" in sys.argv:
+        reqtrace_fleet_worker()
     elif "--health-smoke" in sys.argv:
         health_smoke()
     elif "--io-smoke" in sys.argv:

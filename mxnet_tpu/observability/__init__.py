@@ -28,6 +28,13 @@ Three layers, lowest first:
   ``memory_analysis`` byte attribution (``MXNET_TPU_MEMPROF=1``), the
   live-array census, and the OOM black box
   (docs/observability.md §memory).
+- ``reqtrace`` — end-to-end request tracing for the serving fleet:
+  a per-request context minted at submit/HTTP ingress, typed segments
+  appended at every hop (admission wait, router scoring, lane wait,
+  assembly, dispatch, split, decode iterations), head-sampled storage
+  plus tail capture of SLO breaches and typed rejections into the
+  flight recorder's ``requests`` ring (``traceview --requests`` /
+  ``--fleet``; docs/observability.md §request-tracing).
 - ``autotune`` — the CONTROL half of the loop: controllers that turn
   the recorded signals above into bounded, auditable configuration
   changes (comm bucket size, traffic-shaped serving buckets, io worker
@@ -47,12 +54,14 @@ from . import instrument
 from . import flight_recorder
 from . import health
 from . import memprof
+from . import reqtrace
 from . import autotune
 from .tracing import span, emit_instant
 from .telemetry import counter, gauge, histogram, snapshot
 from .health import HealthMonitor, TrainingDivergedError
 
 __all__ = ["tracing", "telemetry", "instrument", "flight_recorder",
-           "health", "memprof", "autotune", "span", "emit_instant",
+           "health", "memprof", "reqtrace", "autotune", "span",
+           "emit_instant",
            "counter", "gauge", "histogram", "snapshot", "HealthMonitor",
            "TrainingDivergedError"]

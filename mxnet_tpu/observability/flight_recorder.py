@@ -10,8 +10,11 @@ exceptions), the last 128 autotune decision records
 (observability/autotune.py; rendered by ``traceview --tuning``), the
 last 128 elastic lifecycle records (checkpoints, preemption signals,
 resumes, chaos faults — ``mxnet_tpu/elastic/``; rendered by
-``traceview --elastic``) — plus an env/config fingerprint, and dumps
-them all as ONE strict-JSON file:
+``traceview --elastic``), and the request-trace rings
+(``observability/reqtrace.py``: the tail-captured ``requests`` ring of
+SLO-breaching/rejected journeys plus the head-sampled ring, both
+embedded at dump time; rendered by ``traceview --requests``) — plus an
+env/config fingerprint, and dumps them all as ONE strict-JSON file:
 
 - on anomaly (``HealthMonitor`` actions ``dump``/``raise``),
 - on unhandled exception in ``fit`` / the serving dispatch thread
@@ -303,6 +306,19 @@ class FlightRecorder:
             telemetry_snap = _telemetry.snapshot()
         except Exception:
             telemetry_snap = {}
+        # the request-trace rings live in reqtrace (lazy import: this
+        # module must not hard-depend on the serving layer's tracer);
+        # the tail-captured ring IS the flight recorder's "requests"
+        # section — the black box of SLO-breaching/rejected journeys
+        try:
+            from . import reqtrace as _reqtrace
+            requests_pinned = _reqtrace.pinned_snapshot()
+            requests_sampled = _reqtrace.sampled_snapshot()
+            requests_fleet = _reqtrace.fleet_header() \
+                if (requests_pinned or requests_sampled) else None
+        except Exception:
+            requests_pinned, requests_sampled, requests_fleet = \
+                [], [], None
         with self._lock:
             doc = {
                 "kind": "mxnet_tpu_flight",
@@ -322,6 +338,10 @@ class FlightRecorder:
                 "elastic": list(self._elastic),
             }
         doc["telemetry"] = telemetry_snap
+        doc["requests"] = requests_pinned
+        doc["requests_sampled"] = requests_sampled
+        if requests_fleet is not None:
+            doc["fleet"] = requests_fleet
         if sections:
             for k, v in sections.items():
                 doc.setdefault(str(k), v)

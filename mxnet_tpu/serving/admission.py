@@ -51,7 +51,7 @@ class Request:
     the future its caller holds, and its admission-time metadata."""
 
     __slots__ = ("model", "inputs", "n_rows", "future", "t_submit",
-                 "deadline", "t_dispatch", "dispatch_bucket")
+                 "deadline", "t_dispatch", "dispatch_bucket", "ctx")
 
     def __init__(self, model, inputs, n_rows, future, deadline_ms=None):
         self.model = model
@@ -71,6 +71,9 @@ class Request:
         # replaying a response exactly requires replaying its bucket —
         # bench.py --serve-smoke's oracle reads this.
         self.dispatch_bucket = None
+        # observability/reqtrace.py RequestContext (None when tracing
+        # is off): the per-request waterfall every hop appends to
+        self.ctx = None
 
     def expired(self, now=None):
         if self.deadline is None:
@@ -171,6 +174,10 @@ class AdmissionController:
                 now = time.monotonic()
                 for r in batch:
                     r.t_dispatch = now
+                    if r.ctx is not None:
+                        # the admission-wait hop of the waterfall:
+                        # submit -> claimed into an assembled batch
+                        r.ctx.seg("queue", r.t_submit, now)
                 return batch
             # every claimed request expired during the window: loop
 

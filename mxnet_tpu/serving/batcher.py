@@ -34,6 +34,7 @@ import numpy as np
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
 from ..observability import memprof as _memprof
+from ..observability import reqtrace as _reqtrace
 from ..observability import tracing
 from . import metrics
 from .registry import bucket_for
@@ -89,6 +90,7 @@ def split_results(batch, outs, bucket):
     """Slice each request's rows back out of the batched outputs and
     resolve its future (list of per-output host arrays)."""
     off = 0
+    t_split = time.monotonic()
     for r in batch:
         # copy, not view: a retained response must not pin the whole
         # bucket-sized output (nor expose co-batched rows via .base)
@@ -96,7 +98,16 @@ def split_results(batch, outs, bucket):
         off += r.n_rows
         r.dispatch_bucket = bucket
         _resolve_future(r.future, result)
-        metrics.record_request_done(r, time.monotonic())
+        t_done = time.monotonic()
+        metrics.record_request_done(r, t_done)
+        if r.ctx is not None:
+            # split + future resolution is the waterfall's last hop;
+            # finish() decides the record's fate (tail-pin on an SLO
+            # breach, sampled ring otherwise)
+            r.ctx.seg("split", t_split, t_done)
+            r.ctx.bucket = bucket
+            _reqtrace.finish(r.ctx, status="ok")
+        t_split = t_done
 
 
 def run_group(model, batch, rows, replica=None):
@@ -107,8 +118,19 @@ def run_group(model, batch, rows, replica=None):
     dispatch span + per-replica telemetry with the serving replica
     index."""
     name = model.name
+    t_a0 = time.monotonic()
     bucket = bucket_for(rows, model.buckets)
     padded = assemble_padded(model, batch, bucket)
+    t_a1 = time.monotonic()
+    traced = [r for r in batch if r.ctx is not None]
+    if traced:
+        # co-batching facts every rider of this batch records: who it
+        # shared the program shape with, and the padding it paid for
+        ids = [r.ctx.trace_id for r in traced]
+        for r in traced:
+            r.ctx.seg("assemble", t_a0, t_a1, bucket=bucket,
+                      cobatched=len(batch), padded_rows=bucket - rows,
+                      neighbours=[i for i in ids if i != r.ctx.trace_id])
     span_args = {"model": name, "bucket": bucket, "rows": rows,
                  "requests": len(batch)}
     if replica is not None:
@@ -121,8 +143,15 @@ def run_group(model, batch, rows, replica=None):
         with tracing.span("serving:dispatch", category="serving",
                           pid="serving", args=dispatch_args):
             outs = model.run_batch(bucket, padded)
-        ms = (time.monotonic() - t0) * 1e3
+        t1 = time.monotonic()
+        ms = (t1 - t0) * 1e3
         metrics.record_dispatch_ms(ms)
+        for r in traced:
+            r.ctx.seg("dispatch", t0, t1, bucket=bucket,
+                      **({"replica": int(replica)}
+                         if replica is not None else {}))
+            if replica is not None:
+                r.ctx.replica = int(replica)
         if replica is not None:
             metrics.record_replica_dispatch(replica, name, rows, ms)
     metrics.record_batch(name, bucket, rows)
@@ -175,6 +204,9 @@ def fail_batch(batch, exc, model_name):
     for r in batch:
         if _fail_future(r.future, exc):
             metrics.record_rejection(reason, model=model_name)
+        # the trace closes regardless of who resolved the future: a
+        # typed error is exactly the journey tail capture exists for
+        _reqtrace.finish_rejected(r.ctx, exc)
 
 
 class DynamicBatcher:
@@ -243,9 +275,18 @@ class DynamicBatcher:
         delivered it — a client that already cancel()ed its future was
         never rejected, and double-counting would break
         admitted-vs-rejected reconciliation."""
+        now = time.monotonic()
         if _fail_future(request.future, exc):
             metrics.record_rejection(getattr(exc, "reason", "serving_error"),
                                      model=request.model)
+            # a queued-stage rejection spent its whole life waiting:
+            # its accrued wait belongs in serving.queue_ms, or the
+            # queue histogram sees only survivors and reads healthiest
+            # exactly while the server sheds its slowest waiters
+            metrics.record_queue_wait((now - request.t_submit) * 1e3)
+        if request.ctx is not None:
+            request.ctx.seg("queue", request.t_submit, now)
+            _reqtrace.finish_rejected(request.ctx, exc)
 
     def _dispatch(self, batch):
         """Run one assembled batch, split into sub-batches when the

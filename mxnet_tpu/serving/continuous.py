@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -61,6 +62,7 @@ from .. import ndarray as _ndops
 from ..base import MXNetError
 from ..context import cpu
 from ..ndarray import NDArray, array as nd_array
+from ..observability import reqtrace as _reqtrace
 from ..observability import tracing
 from . import metrics
 
@@ -81,7 +83,7 @@ class DecodeStream:
     completion state.  Created by :meth:`ContinuousBatcher.submit`."""
 
     __slots__ = ("inputs", "length", "eos_fn", "slot", "pos",
-                 "_collected", "_done", "_cond", "error")
+                 "_collected", "_done", "_cond", "error", "ctx")
 
     def __init__(self, inputs, length, eos_fn=None):
         self.inputs = inputs        # {name: (T,) + feature}
@@ -93,6 +95,9 @@ class DecodeStream:
         self._done = False
         self._cond = threading.Condition()
         self.error = None
+        # observability/reqtrace.py context (None when tracing is off):
+        # continuous-decode streams get per-iteration segments
+        self.ctx = None
 
     @property
     def done(self):
@@ -141,14 +146,16 @@ class ContinuousBatcher:
 
     def __init__(self, symbol, arg_params, input_shapes, state_shapes,
                  state_pairs, slot_count=None, aux_params=None, ctx=None,
-                 collect_outputs=None):
+                 collect_outputs=None, name="decode"):
         """``symbol``: the step graph — data inputs + state inputs ->
         outputs, where ``state_pairs`` maps each state input name to
         the output index holding its next value.  ``input_shapes`` /
         ``state_shapes``: per-row feature shapes (no batch dim).
         ``collect_outputs``: output indices returned to streams
         (default: every output NOT claimed as a state by
-        ``state_pairs``)."""
+        ``state_pairs``).  ``name`` labels this batcher's streams in
+        request traces (``traceview --requests``)."""
+        self.name = str(name)
         self.slot_count = int(slot_count) if slot_count \
             else default_slot_count()
         if self.slot_count < 1:
@@ -247,13 +254,19 @@ class ContinuousBatcher:
                                  % (length, arr.shape[0]))
             arrays[name] = arr
         stream = DecodeStream(arrays, length, eos_fn=eos_fn)
+        stream.ctx = _reqtrace.mint(self.name, rows=1, kind="stream")
         with self._lock:
             # closed-check and append under ONE lock acquisition:
             # a submit racing close() must either be refused here or
             # be drained (and failed) by close — never appended after
             # the drain, where nothing would ever finish it
             if self._closed:
-                raise MXNetError("ContinuousBatcher is closed")
+                exc = MXNetError("ContinuousBatcher is closed")
+                # the refusal is a typed rejection like any other:
+                # close the minted context so it tail-captures instead
+                # of leaking an unfinished trace
+                _reqtrace.finish_rejected(stream.ctx, exc)
+                raise exc
             self._waiting.append(stream)
         return stream
 
@@ -263,6 +276,7 @@ class ContinuousBatcher:
         whatever the program computed there before is dropped by the
         carry select, so the stream starts from exact-zero state."""
         joins = 0
+        now = time.monotonic()
         for slot in range(self.slot_count):
             if self._slots[slot] is not None or not self._waiting:
                 continue
@@ -271,6 +285,11 @@ class ContinuousBatcher:
             self._slots[slot] = stream
             self._mask[slot] = 0.0
             joins += 1
+            if stream.ctx is not None:
+                # slot wait: submit -> seated (the stream analog of the
+                # request batcher's admission-queue hop)
+                stream.ctx.seg("queue", stream.ctx.t0_mono, now,
+                               slot=slot)
         return joins
 
     def active_streams(self):
@@ -314,6 +333,7 @@ class ContinuousBatcher:
             feeds[name] = self._zero_states[name] if carried is None \
                 else _ndops.where(mask_nd, carried,
                                   self._zero_states[name])
+        t_i0 = time.monotonic()
         with tracing.span("serving:decode_step", category="serving",
                           pid="serving",
                           args={"active": len(active), "joins": joins}):
@@ -321,6 +341,14 @@ class ContinuousBatcher:
             for name, idx in self.state_pairs:
                 self._carry[name] = outs[idx]
             host = [outs[i].asnumpy() for i in self.collect_outputs]
+        t_i1 = time.monotonic()
+        for slot, stream in active:
+            if stream.ctx is not None:
+                # one typed segment per decode iteration: which slot,
+                # how full the program was, which step of the stream
+                stream.ctx.seg("decode_step", t_i0, t_i1, slot=slot,
+                               active=len(active),
+                               iteration=self.iterations)
         self.iterations += 1
         # collect under the lock (no user code), THEN evaluate EOS
         # outside it: eos_fn is a user callback — running it under the
@@ -356,6 +384,13 @@ class ContinuousBatcher:
         for _, stream, eos, error in decisions:
             if eos:
                 stream._finish(error)
+                if error is None:
+                    _reqtrace.finish(stream.ctx, status="ok",
+                                     steps=stream.steps_decoded,
+                                     eos="fn" if stream.pos
+                                     < stream.length else "length")
+                else:
+                    _reqtrace.finish_rejected(stream.ctx, error)
         metrics.record_decode_step(len(active), joins, leaves)
         return len(active)
 
@@ -432,10 +467,12 @@ class ContinuousBatcher:
             self._waiting = []
             self._mask[:] = 0.0
         for stream in doomed:
-            stream._finish(MXNetError(
+            exc = MXNetError(
                 "ContinuousBatcher closed with the stream unfinished "
                 "(%d/%d steps decoded)" % (stream.steps_decoded,
-                                           stream.length)))
+                                           stream.length))
+            stream._finish(exc)
+            _reqtrace.finish_rejected(stream.ctx, exc)
 
     def __enter__(self):
         return self

@@ -12,6 +12,9 @@ parses these):
 
 - ``serving.request_latency_ms``  histogram, submit -> completion
 - ``serving.queue_ms``            histogram, submit -> batch dispatch
+  (REJECTED-while-queued requests feed it too, with their accrued
+  wait — a queue that is shedding must not look healthy because only
+  survivors report)
 - ``serving.dispatch_ms``         histogram, executor run per batch
 - ``serving.batch_size``          histogram, real (unpadded) rows
 - ``serving.request_rows``        histogram, rows per ADMITTED request
@@ -92,6 +95,16 @@ def record_admitted(n_rows=None, model=None):
     # once at Server construction).  Every admission is a cheap, natural
     # point to restore it for all live servers.
     _ensure_queue_gauge()
+
+
+def record_queue_wait(ms):
+    """Accrued queue wait of a request REJECTED at the queued stage
+    (deadline sweep, drain shed).  Served requests record theirs in
+    :func:`record_request_done`; without this, the queue histogram
+    sees only survivors and looks healthiest exactly when the server
+    is shedding its slowest waiters."""
+    telemetry.histogram("serving.queue_ms",
+                        help="submit->dispatch queue wait").observe(ms)
 
 
 def record_batch(model, bucket, rows):

@@ -192,7 +192,8 @@ class Replica:
                     "replica %d is %s" % (
                         self.index,
                         "closed" if self._closed else "quarantined"))
-            self._lane.append((model_name, batch, rows, est_ms))
+            self._lane.append((model_name, batch, rows, est_ms,
+                               time.monotonic()))
             self._outstanding_rows += rows
             self._outstanding_ms += est_ms
             self._cond.notify()
@@ -204,7 +205,7 @@ class Replica:
             if not self._lane:
                 return None  # closed and drained
             item = self._lane.popleft()
-            _, _, rows, est_ms = item
+            _, _, rows, est_ms, _ = item
             # the item moves from queued accounting to running
             # accounting (whose score contribution tracks wall clock)
             self._outstanding_rows -= rows
@@ -227,7 +228,12 @@ class Replica:
             item = self._take()
             if item is None:
                 return
-            model_name, batch, rows, _ = item
+            model_name, batch, rows, _, t_enq = item
+            # lane-wait hop: routed-enqueue -> taken by this worker
+            t_take = self._running_since or time.monotonic()
+            for r in batch:
+                if r.ctx is not None:
+                    r.ctx.seg("lane", t_enq, t_take, replica=self.index)
             try:
                 try:
                     model = self.registry.get(model_name)
@@ -238,6 +244,14 @@ class Replica:
                     # the failure path itself must not kill the worker
                     # with healthy=True — a dead lane that still
                     # accepts routed work hangs its futures forever
+                    if not isinstance(exc, ServingError):
+                        # the batch that felled this replica rode a
+                        # replica about to be quarantined: pin BEFORE
+                        # fail_batch closes the traces, so the black
+                        # box names the quarantine, not just the error
+                        for r in batch:
+                            if r.ctx is not None:
+                                r.ctx.pin("quarantined_replica")
                     try:
                         fail_batch(batch, exc, model_name)
                     except Exception:
@@ -272,9 +286,17 @@ class Replica:
             self._lane.clear()
             # the stranded items' accounting unwinds here; the running
             # item's unwind happens in the worker's finally
-            for _, _, rows, est_ms in stranded:
+            for _, _, rows, est_ms, _ in stranded:
                 self._outstanding_rows -= rows
                 self._outstanding_ms -= est_ms
+        # a stranded request RODE a quarantined replica even though a
+        # healthy one will eventually serve it: pin its trace so the
+        # detour is always in the black box (the re-route appends new
+        # route/lane segments to the same waterfall)
+        for _, stranded_batch, _, _, _ in stranded:
+            for r in stranded_batch:
+                if r.ctx is not None:
+                    r.ctx.pin("quarantined_replica")
         _module_logger(__name__).error(
             "serving replica %d quarantined after dispatch failure "
             "(%s: %s); re-routing %d queued group(s)",
@@ -380,45 +402,86 @@ class ReplicaGroup:
 
     # -- routing --------------------------------------------------------------
 
+    def _scored_healthy(self):
+        """Healthy replicas with their load scores, best first — the
+        ONE place the routing order is defined (``pick`` and
+        ``dispatch`` both consume it; the trace records the whole
+        list).  The lexicographic (outstanding ms, outstanding rows,
+        index) score ends in the unique replica index, so the sort
+        never compares Replica objects."""
+        return sorted((r.load_score(), r)
+                      for r in self.healthy_replicas())
+
     def pick(self):
         """The least-loaded healthy replica (weighted by measured
         per-bucket cost of outstanding work), or None when the whole
         group is quarantined."""
-        healthy = self.healthy_replicas()
-        if not healthy:
-            return None
-        return min(healthy, key=Replica.load_score)
+        scored = self._scored_healthy()
+        return scored[0][1] if scored else None
 
-    def dispatch(self, model_name, batch, rows, bucket):
+    def dispatch(self, model_name, batch, rows, bucket, t_route0=None):
         """Route one assembled group; fails the batch typed when no
-        healthy replica exists."""
+        healthy replica exists.  ``t_route0`` overrides the route-hop
+        start for redispatches (whose claim timestamp belongs to the
+        FIRST attempt's segments)."""
+        if t_route0 is None:
+            # contiguous with the queue segment: routing starts the
+            # moment the dispatch thread claimed the batch
+            t_route0 = (batch[0].t_dispatch
+                        if batch and batch[0].t_dispatch is not None
+                        else time.monotonic())
         while True:
-            replica = self.pick()
-            if replica is None:
+            # the full scored candidate list (pick()'s order) so the
+            # trace can record WHO was considered and why the winner won
+            scored = self._scored_healthy()
+            if not scored:
                 fail_batch(batch, NoHealthyReplica(
                     "all %d replica(s) are quarantined; group for model "
                     "%r not dispatched" % (len(self.replicas),
                                            model_name)), model_name)
                 return None
+            replica = scored[0][1]
             est_ms = replica.estimate_ms(model_name, bucket, rows)
+            # the route segment is appended BEFORE enqueue: the instant
+            # the batch lands on the lane a fast replica worker may run
+            # it to completion and finish() the traces, after which
+            # seg() is a no-op — appending afterwards would race the
+            # route hop out of the waterfall.  A lost enqueue race
+            # (quarantine landed between scoring and enqueue) leaves
+            # this attempt's segment in place and the retry appends
+            # another — an honest record of both routing attempts.
+            t_route1 = time.monotonic()
+            traced = [r for r in batch if r.ctx is not None]
+            if traced:
+                candidates = [{"replica": rep.index,
+                               "score_ms": round(score[0], 4),
+                               "score_rows": score[1]}
+                              for score, rep in scored]
+                for req in traced:
+                    req.ctx.seg("route", t_route0, t_route1,
+                                winner=replica.index,
+                                est_ms=round(est_ms, 4),
+                                candidates=candidates)
             try:
                 replica.enqueue(model_name, batch, rows, est_ms)
-                return replica
             except NoHealthyReplica:
+                t_route0 = time.monotonic()
                 continue  # lost the race with a quarantine; re-pick
+            return replica
 
     def redispatch(self, stranded):
         """Re-route a quarantined replica's queued lane.  Called from
         the dying replica's worker thread; items land on healthy
         replicas or fail typed."""
         from .registry import bucket_for
-        for model_name, batch, rows, _ in stranded:
+        for model_name, batch, rows, _, _ in stranded:
             try:
                 model = self.primary_registry.get(model_name)
                 bucket = bucket_for(rows, model.buckets)
             except Exception:
                 bucket = rows
-            self.dispatch(model_name, batch, rows, bucket)
+            self.dispatch(model_name, batch, rows, bucket,
+                          t_route0=time.monotonic())
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -439,7 +502,7 @@ class ReplicaGroup:
                 with r._cond:
                     stranded = list(r._lane)
                     r._lane.clear()
-                for model_name, batch, _, _ in stranded:
+                for model_name, batch, _, _, _ in stranded:
                     shed += len(batch)
                     fail_batch(batch, ServerClosed(
                         "fleet drain deadline expired before this "

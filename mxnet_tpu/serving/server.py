@@ -45,6 +45,7 @@ import numpy as np
 from ..base import MXNetError
 from ..log import module_logger as _module_logger
 from ..observability import memprof as _memprof
+from ..observability import reqtrace as _reqtrace
 from ..observability import telemetry
 from . import metrics
 from .admission import AdmissionController, Request
@@ -430,6 +431,12 @@ class Server:
         this request's rows).  Raises typed rejections synchronously
         when the request can never be served; queued-stage failures
         (deadline expiry, dispatch errors) arrive through the future."""
+        # request-trace context minted at ingress (None when
+        # MXNET_TPU_REQTRACE=0): every hop from here to the future's
+        # resolution appends a typed segment (docs/observability.md
+        # §request-tracing).  The HTTP handler funnels through submit,
+        # so one mint point covers both front doors.
+        ctx = _reqtrace.mint(model)
         try:
             if self._closed:
                 raise ServerClosed("server is closed")
@@ -438,9 +445,17 @@ class Server:
                                             self.max_batch_size)
             request = Request(model, arrays, n_rows, Future(),
                               deadline_ms=deadline_ms)
+            if ctx is not None:
+                ctx.rows = n_rows
+                ctx.slo_ms = served.slo_ms
+                request.ctx = ctx
             self.admission.offer(request)
         except ServingError as exc:
             metrics.record_rejection(exc.reason, model=model)
+            # a submit-time typed rejection (Overloaded, ModelNotFound,
+            # RequestTooLarge, ...) is tail-captured too: sheds are the
+            # journeys the black box exists for
+            _reqtrace.finish_rejected(ctx, exc)
             raise
         metrics.record_admitted(request.n_rows, model=model)
         # debug/verification handle: the queued Request (rows, deadline,

@@ -34,7 +34,7 @@ _FAST_MODULES = {
     "test_kvstore_ici", "test_module", "test_ndarray",
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
     "test_serving", "test_pallas_kernels", "test_comm_overlap",
-    "test_program_cache", "test_autotune",
+    "test_program_cache", "test_autotune", "test_reqtrace",
 }
 
 

@@ -5,6 +5,8 @@
     python tools/traceview.py --flight /tmp/flight_dump.json
     python tools/traceview.py --memory /tmp/memory_report_or_flight.json
     python tools/traceview.py --elastic /tmp/flight_dump.json
+    python tools/traceview.py --requests /tmp/flight_or_reqtrace.json
+    python tools/traceview.py --fleet /tmp/fleet_dump_dir/
 
 Three views over one trace:
 
@@ -23,8 +25,28 @@ latency, queue/dispatch phase breakdown, batch-size distribution,
 rejection counts by reason).  It accepts EITHER a Chrome trace holding
 `serving:*` spans (exact percentiles over the recorded requests) OR a
 telemetry JSON-lines dump from `observability.telemetry.to_json_lines`
-(percentiles estimated from the fixed log2 histogram buckets — each
-quantile reports its bucket's upper bound).
+(percentiles estimated with the shared log2-interpolation estimator —
+a pinned copy of `telemetry.quantile_from_snapshot`, linear inside the
+holding bucket and clamped to the recorded min/max; the old
+bucket-upper-bound answer overstated p99 by up to 2x at coarse
+buckets).
+
+`--requests` renders the end-to-end request traces
+(`observability/reqtrace.py`): one waterfall per tail-captured request
+(admission wait, router candidate scoring, lane wait, assembly,
+dispatch, split — or per-iteration decode segments for streams), plus
+the p99 attribution table: per model, each hop's share of tail-request
+latency.  Accepts a flight dump (`requests` / `requests_sampled`
+sections) or a standalone `reqtrace.dump()` file.  Exits 2 when the
+input holds no request records.
+
+`--fleet <dir>` merges every parseable JSON dump in a directory —
+flight dumps, reqtrace dumps, from fleet replicas or elastic/chaos
+subprocess workers sharing an env-propagated trace root
+(`MXNET_TPU_REQTRACE_CTX`) — onto one shared-epoch timeline: per-source
+table (pid, trace root, records, wall span), the merged request
+timeline, and the fleet-wide attribution table.  Exits 2 when no dump
+holds request records.
 
 `--flight` reads a flight-recorder dump
 (`observability/flight_recorder.py`): first-anomaly step, per-rule
@@ -425,6 +447,10 @@ def summarize_flight(doc, trend_rows=12):
             note += "; last checkpoint: step %s" \
                 % estats["last_checkpoint_step"]
         lines.append(note)
+    requests_pinned = doc.get("requests") or []
+    if requests_pinned:
+        lines.append("tail-captured request traces: %d (render with "
+                     "--requests)" % len(requests_pinned))
     if doc.get("memory"):
         # an OOM dump embeds the full memory report — render it inline
         lines.append("")
@@ -725,23 +751,52 @@ def _percentile(sorted_vals, q):
     return sorted_vals[idx]
 
 
+def _snap_bound(snap, key):
+    """The recorded min/max of a snapshot as a finite float, or None."""
+    v = snap.get(key)
+    if isinstance(v, str):
+        v = _NONFINITE_TOKENS.get(v)
+    return float(v) if isinstance(v, (int, float)) \
+        and math.isfinite(v) else None
+
+
 def _hist_quantile(snap, q):
-    """Quantile estimate from a fixed log2-bucket histogram snapshot:
-    the UPPER BOUND of the bucket holding the q-th observation (the
-    honest answer a bucketed histogram can give)."""
+    """Quantile estimate from a fixed log2-bucket histogram snapshot —
+    a pinned copy of ``observability.telemetry.quantile_from_snapshot``
+    (this CLI stays import-free): LINEAR interpolation inside the
+    bucket holding the q-th observation, clamped to the recorded
+    min/max so single-valued histograms and q=0/1 are exact.  The old
+    bucket-upper-bound answer overstated p99 by up to 2x at coarse log2
+    buckets."""
+    count = snap.get("count", 0) or 0
     buckets = snap.get("buckets") or []
-    count = snap.get("count", 0)
-    if not count or not buckets:
+    if count <= 0 or not buckets:
         return 0.0
-    target = q * count
+    mn = _snap_bound(snap, "min")
+    mx = _snap_bound(snap, "max")
+    q = min(1.0, max(0.0, float(q)))
+    target = max(1.0, q * count)  # 1-based rank; q=0 -> the first
     cumulative = 0
+    est = 0.0
     for i, n in enumerate(buckets):
+        if not n:
+            continue
         cumulative += n
         if cumulative >= target:
             if i < len(HIST_BUCKET_BOUNDS):
-                return HIST_BUCKET_BOUNDS[i]
-            return float("inf")  # overflow bucket
-    return float("inf")
+                lo = 0.0 if i == 0 else HIST_BUCKET_BOUNDS[i - 1]
+                hi = HIST_BUCKET_BOUNDS[i]
+            else:  # overflow: the recorded max is the only upper bound
+                lo = HIST_BUCKET_BOUNDS[-1]
+                hi = mx if mx is not None else HIST_BUCKET_BOUNDS[-1] * 2
+            frac = (target - (cumulative - n)) / n
+            est = lo + frac * (hi - lo)
+            break
+    if mn is not None:
+        est = max(est, mn)
+    if mx is not None:
+        est = min(est, mx)
+    return est
 
 
 def serving_from_trace(events):
@@ -806,8 +861,8 @@ def serving_from_trace(events):
 
 
 def serving_from_telemetry(metrics):
-    """Serving stats from a telemetry JSON-lines dump (histogram-bucket
-    estimates; each quantile is its bucket's upper bound)."""
+    """Serving stats from a telemetry JSON-lines dump (quantiles via
+    the shared log2-interpolation estimator — see ``_hist_quantile``)."""
     lat = metrics.get("serving.request_latency_ms", {})
     queue = metrics.get("serving.queue_ms", {})
     dispatch = metrics.get("serving.dispatch_ms", {})
@@ -870,7 +925,7 @@ def serving_from_telemetry(metrics):
             "p95": _hist_quantile(mlat, 0.95), "p99": p99,
             "met": bool(served) and target is not None and p99 <= target})
     return {
-        "source": "telemetry (bucket upper-bound estimates)",
+        "source": "telemetry (interpolated histogram estimates)",
         "requests": lat.get("count", 0),
         "p50": _hist_quantile(lat, 0.50),
         "p95": _hist_quantile(lat, 0.95),
@@ -951,6 +1006,293 @@ def summarize_serving(kind, payload):
     else:
         for reason in sorted(stats["rejects"]):
             lines.append("%-24s %7d" % (reason, stats["rejects"][reason]))
+    return "\n".join(lines)
+
+
+# -- request-trace view (reqtrace) -------------------------------------------
+
+# pinned copy of observability/reqtrace.py:SEGMENT_ORDER — the hop
+# order the attribution table renders in
+REQUEST_SEGMENTS = ("queue", "route", "lane", "assemble", "dispatch",
+                    "split", "reject", "decode_step")
+
+
+def request_records(doc):
+    """(pinned, sampled) request-trace record lists from any accepted
+    input form: a flight dump or a standalone ``reqtrace.dump()``
+    document (both carry ``requests`` / ``requests_sampled``)."""
+    if not isinstance(doc, dict):
+        return [], []
+    return (list(doc.get("requests") or []),
+            list(doc.get("requests_sampled") or []))
+
+
+def requests_stats(pinned, sampled):
+    """The machine-readable summary `--requests` renders (and tests +
+    bench assert on): per model, the exact p99 over recorded totals
+    and — over the TAIL set (records at/above p99) — each hop's share
+    of measured latency.  ``coverage`` is the instrumented fraction
+    (sum of segment durations / sum of totals); the remainder is
+    inter-hop scheduling gaps, reported as ``other``."""
+    records = [r for r in list(pinned) + list(sampled)
+               if _fnum(r.get("total_ms"), 0.0) > 0.0]
+    by_model = {}
+    for r in records:
+        by_model.setdefault(str(r.get("model", "?")), []).append(r)
+    rows = []
+    for model in sorted(by_model):
+        recs = by_model[model]
+        totals = sorted(_fnum(r.get("total_ms"), 0.0) for r in recs)
+        p99 = _percentile(totals, 0.99)
+        tail = [r for r in recs
+                if _fnum(r.get("total_ms"), 0.0) >= p99] or recs
+        tail_total = sum(_fnum(r.get("total_ms"), 0.0) for r in tail)
+        seg_ms = {}
+        covered = 0.0
+        for r in tail:
+            for s in r.get("segments") or []:
+                d = _fnum(s.get("dur_ms"), 0.0)
+                seg_ms[str(s.get("name", "?"))] = \
+                    seg_ms.get(str(s.get("name", "?")), 0.0) + d
+                covered += d
+        shares = {name: (ms / tail_total if tail_total else 0.0)
+                  for name, ms in seg_ms.items()}
+        rows.append({
+            "model": model,
+            "requests": len(recs),
+            "pinned": sum(1 for r in recs if r.get("pinned")),
+            "p50_ms": _percentile(totals, 0.50),
+            "p99_ms": p99,
+            "tail_requests": len(tail),
+            "shares": shares,
+            "coverage": covered / tail_total if tail_total else 0.0,
+        })
+    by_pin = {}
+    for r in list(pinned):
+        key = str(r.get("pinned", "?"))
+        by_pin[key] = by_pin.get(key, 0) + 1
+    return {"records": len(records), "pinned": len(list(pinned)),
+            "sampled": len(list(sampled)), "by_pin_reason": by_pin,
+            "models": rows}
+
+
+def _waterfall_lines(record, width=30, max_segments=16):
+    """The text waterfall for one request record."""
+    total = _fnum(record.get("total_ms"), 0.0)
+    scale = total if total > 0 else 1.0
+    head = "req %s  model=%s rows=%s total=%.3fms status=%s" % (
+        record.get("trace_id", "?"), record.get("model", "?"),
+        record.get("rows", "?"), total, record.get("status", "?"))
+    if record.get("reason"):
+        head += " reason=%s" % record["reason"]
+    if record.get("pinned"):
+        head += "  PINNED=%s" % record["pinned"]
+    if record.get("slo_ms"):
+        head += "  slo=%gms" % _fnum(record["slo_ms"], 0.0)
+    if record.get("replica") is not None:
+        head += "  replica=%s" % record["replica"]
+    lines = [head]
+    segments = record.get("segments") or []
+    shown = segments if len(segments) <= max_segments else (
+        segments[:max_segments // 2] + [None]
+        + segments[-(max_segments - max_segments // 2):])
+    for s in shown:
+        if s is None:
+            lines.append("  ... (%d segment(s) elided)"
+                         % (len(segments) - max_segments))
+            continue
+        t0 = _fnum(s.get("t0_ms"), 0.0)
+        dur = _fnum(s.get("dur_ms"), 0.0)
+        start = min(width - 1, max(0, int(width * t0 / scale)))
+        span = max(1, int(round(width * dur / scale)))
+        bar = " " * start + "#" * min(span, width - start)
+        note = ""
+        name = s.get("name", "?")
+        if name == "route":
+            cands = s.get("candidates") or []
+            note = "-> replica %s of %d candidate(s)" % (
+                s.get("winner", "?"), len(cands))
+        elif name == "assemble":
+            note = "bucket=%s cobatched=%s padded=%s" % (
+                s.get("bucket", "?"), s.get("cobatched", "?"),
+                s.get("padded_rows", "?"))
+        elif name in ("dispatch", "lane") \
+                and s.get("replica") is not None:
+            note = "replica=%s" % s["replica"]
+        elif name == "decode_step":
+            note = "slot=%s active=%s" % (s.get("slot", "?"),
+                                          s.get("active", "?"))
+        elif name == "reject":
+            note = str(s.get("reason", ""))
+        lines.append("  %-11s %9.3f +%9.3fms |%-*s| %s"
+                     % (name[:11], t0, dur, width, bar, note))
+    if record.get("segments_dropped"):
+        lines.append("  (%d segment(s) dropped at the per-request cap)"
+                     % record["segments_dropped"])
+    return lines
+
+
+def summarize_requests(doc, top=8):
+    """The text report for `--requests` over one dump."""
+    pinned, sampled = request_records(doc)
+    stats = requests_stats(pinned, sampled)
+    lines = []
+    fleet = doc.get("fleet") or {}
+    lines.append("== requests: end-to-end traces (pinned %d, sampled "
+                 "%d)%s ==" % (stats["pinned"], stats["sampled"],
+                               ("  root=%s pid=%s"
+                                % (fleet.get("root"), fleet.get("pid")))
+                               if fleet else ""))
+    if not stats["records"]:
+        lines.append("(no request traces recorded — is "
+                     "MXNET_TPU_REQTRACE=0, or did no traffic run?)")
+        return "\n".join(lines)
+    if stats["by_pin_reason"]:
+        lines.append("tail-captured by reason: " + "  ".join(
+            "%s=%d" % kv for kv in sorted(
+                stats["by_pin_reason"].items())))
+    lines.append("")
+    lines.append("== requests: p99 attribution (tail-request hop "
+                 "shares) ==")
+    seg_cols = [s for s in REQUEST_SEGMENTS
+                if any(s in m["shares"] for m in stats["models"])]
+    header = "%-14s %8s %9s %9s" % ("Model", "Requests", "p50(ms)",
+                                    "p99(ms)")
+    for s in seg_cols:
+        header += " %9s" % s[:9]
+    header += " %9s" % "other"
+    lines.append(header)
+    for m in stats["models"]:
+        row = "%-14s %8d %9.3f %9.3f" % (m["model"][:14],
+                                         m["requests"], m["p50_ms"],
+                                         m["p99_ms"])
+        for s in seg_cols:
+            row += " %8.1f%%" % (m["shares"].get(s, 0.0) * 100.0)
+        row += " %8.1f%%" % (max(0.0, 1.0 - m["coverage"]) * 100.0)
+        lines.append(row)
+        lines.append("  (tail set: %d request(s); segments explain "
+                     "%.1f%% of tail latency)"
+                     % (m["tail_requests"], m["coverage"] * 100.0))
+    lines.append("")
+    lines.append("== requests: tail-captured waterfalls ==")
+    if not pinned:
+        lines.append("(none pinned — no SLO breaches, typed "
+                     "rejections, or quarantined-replica rides)")
+    else:
+        for record in pinned[-top:]:
+            lines.extend(_waterfall_lines(record))
+            lines.append("")
+        if len(pinned) > top:
+            lines.append("(%d more pinned request(s) in the ring)"
+                         % (len(pinned) - top))
+    return "\n".join(lines)
+
+
+# -- fleet view (merged multi-process dumps) ---------------------------------
+
+def fleet_sources(dirpath):
+    """Every parseable JSON document in ``dirpath`` as (filename, doc),
+    sorted by name.  Non-JSON files (telemetry JSON-lines, traces with
+    trailing garbage) are skipped — a fleet dir mixes artifacts."""
+    import os as _os
+    sources = []
+    for fn in sorted(_os.listdir(dirpath)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(_os.path.join(dirpath, fn)) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        if isinstance(doc, dict):
+            sources.append((fn, doc))
+    return sources
+
+
+def fleet_stats(sources):
+    """The machine-readable `--fleet` summary: per-source facts and
+    the merged, epoch-ordered request timeline."""
+    rows, merged = [], []
+    for fn, doc in sources:
+        pinned, sampled = request_records(doc)
+        recs = list(pinned) + list(sampled)
+        fleet = doc.get("fleet") or {}
+        fp = doc.get("fingerprint") or {}
+        times = [_fnum(r.get("t0")) for r in recs]
+        times += [_fnum(s.get("t")) for s in (doc.get("steps") or [])]
+        times += [_fnum(e.get("t")) for e in (doc.get("elastic") or [])]
+        times = [t for t in times if _isfinite(t) and t > 0]
+        rows.append({"source": fn,
+                     "kind": doc.get("kind", "?"),
+                     "pid": fleet.get("pid", fp.get("pid")),
+                     "root": fleet.get("root"),
+                     "requests": len(recs), "pinned": len(pinned),
+                     "steps": len(doc.get("steps") or []),
+                     "elastic": len(doc.get("elastic") or []),
+                     "t_min": min(times) if times else None,
+                     "t_max": max(times) if times else None})
+        for r in recs:
+            merged.append((fn, r))
+    merged.sort(key=lambda fr: _fnum(fr[1].get("t0"), 0.0))
+    t_mins = [r["t_min"] for r in rows if r["t_min"] is not None]
+    return {"sources": rows, "merged": merged,
+            "roots": sorted({r["root"] for r in rows if r["root"]}),
+            "epoch0": min(t_mins) if t_mins else None}
+
+
+def summarize_fleet(stats, top=30):
+    """The text report for `--fleet` over one dump directory."""
+    lines = []
+    lines.append("== fleet: %d dump(s), %d request trace(s), trace "
+                 "root(s): %s =="
+                 % (len(stats["sources"]), len(stats["merged"]),
+                    ", ".join(stats["roots"]) or "(none)"))
+    lines.append("%-34s %-8s %-10s %9s %7s %6s %8s"
+                 % ("Source", "Pid", "Root", "Requests", "Pinned",
+                    "Steps", "Span(s)"))
+    epoch0 = stats["epoch0"]
+    for r in stats["sources"]:
+        span = (r["t_max"] - r["t_min"]) \
+            if r["t_min"] is not None and r["t_max"] is not None else None
+        lines.append("%-34s %-8s %-10s %9d %7d %6d %8s"
+                     % (r["source"][:34], r["pid"] or "?",
+                        (r["root"] or "?")[:10], r["requests"],
+                        r["pinned"], r["steps"],
+                        ("%.2f" % span) if span is not None else "?"))
+    lines.append("")
+    lines.append("== fleet: merged request timeline (shared epoch) ==")
+    if not stats["merged"]:
+        lines.append("(no request traces in any dump)")
+    else:
+        lines.append("%-9s %-24s %-12s %5s %10s %-9s %s"
+                     % ("t(+s)", "Source", "Model", "Rows",
+                        "Total(ms)", "Status", "Pinned"))
+        shown = stats["merged"][-top:]
+        if len(stats["merged"]) > top:
+            lines.append("... (%d earlier request(s) elided)"
+                         % (len(stats["merged"]) - top))
+        for fn, r in shown:
+            rel = _fnum(r.get("t0"), 0.0) - (epoch0 or 0.0)
+            lines.append("%-9.3f %-24s %-12s %5s %10.3f %-9s %s"
+                         % (rel, fn[:24], str(r.get("model", "?"))[:12],
+                            r.get("rows", "?"),
+                            _fnum(r.get("total_ms"), 0.0),
+                            str(r.get("status", "?"))[:9],
+                            r.get("pinned", "")))
+        # fleet-wide attribution over the merged set
+        merged_records = [r for _, r in stats["merged"]]
+        rstats = requests_stats(
+            [r for r in merged_records if r.get("pinned")],
+            [r for r in merged_records if not r.get("pinned")])
+        lines.append("")
+        lines.append("== fleet: merged p99 attribution ==")
+        for m in rstats["models"]:
+            shares = "  ".join(
+                "%s=%.1f%%" % (s, m["shares"][s] * 100.0)
+                for s in REQUEST_SEGMENTS if s in m["shares"])
+            lines.append("%-14s p99 %.3f ms over %d request(s): %s"
+                         % (m["model"][:14], m["p99_ms"],
+                            m["requests"], shares))
     return "\n".join(lines)
 
 
@@ -1051,7 +1393,8 @@ def main(argv=None):
         description="Summarize an mxnet_tpu Chrome trace dump")
     parser.add_argument("trace", help="trace JSON written by "
                         "profiler.dump_profile() (or, with --serving, a "
-                        "telemetry JSON-lines dump)")
+                        "telemetry JSON-lines dump; with --fleet, a "
+                        "DIRECTORY of dumps)")
     parser.add_argument("--top", type=int, default=15,
                         help="rows in the top-spans table")
     parser.add_argument("--serving", action="store_true",
@@ -1073,6 +1416,19 @@ def main(argv=None):
                         "cost) from a flight dump or a bare decision-"
                         "log JSON; exits 2 when no decisions are "
                         "recorded")
+    parser.add_argument("--requests", action="store_true",
+                        help="request-trace view: per-request "
+                        "waterfalls + the p99 attribution table "
+                        "(queue/route/lane/assemble/dispatch/split "
+                        "shares of tail latency, per model) from a "
+                        "flight dump or a reqtrace dump; exits 2 when "
+                        "no request traces are recorded")
+    parser.add_argument("--fleet", action="store_true",
+                        help="fleet view: merge every JSON dump in a "
+                        "DIRECTORY (fleet replicas, elastic workers "
+                        "sharing an env-propagated trace root) onto "
+                        "one shared-epoch timeline; exits 2 when no "
+                        "dump holds request traces")
     parser.add_argument("--elastic", action="store_true",
                         help="elastic view: the checkpoint/resume "
                         "lineage (snapshots by trigger, rejected-at-"
@@ -1081,6 +1437,16 @@ def main(argv=None):
                         "a bare record-list JSON; exits 2 when no "
                         "elastic records are recorded")
     args = parser.parse_args(argv)
+    if args.fleet:
+        stats = fleet_stats(fleet_sources(args.trace))
+        print(summarize_fleet(stats))
+        return 0 if stats["merged"] else 2
+    if args.requests:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        print(summarize_requests(doc))
+        pinned, sampled = request_records(doc)
+        return 0 if (pinned or sampled) else 2
     if args.elastic:
         with open(args.trace) as f:
             doc = json.load(f)
