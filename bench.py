@@ -763,6 +763,37 @@ def serve_smoke():
     assert rejected.get("overloaded") == 1, rejected
     assert rejected.get("deadline_exceeded") == 1, rejected
 
+    # 4) locksan leg: the same serving path under MXNET_TPU_LOCKSAN=1 —
+    # a fresh server whose locks are all sanitizer proxies must show
+    # zero violations (the serving lock discipline is inversion-free and
+    # dispatch-clear) and zero added retraces (proxies are host-side
+    # bookkeeping; no program signature changes)
+    from mxnet_tpu.analysis import locksan
+    prev_locksan = os.environ.get("MXNET_TPU_LOCKSAN")
+    os.environ["MXNET_TPU_LOCKSAN"] = "1"
+    locksan.reset()
+    try:
+        sanitized = serving.Server(max_batch_size=8, batch_window_ms=3.0,
+                                   queue_depth=64)
+        sanitized.add_model("mlp", sym, arg_params,
+                            input_shapes={"data": (8,)})
+        sanitized.warmup(expect_warm=True)  # programs already cached
+        with executor_cache.watch_traces() as watch:
+            futs = [sanitized.submit_async("mlp", {"data": p})
+                    for p in payloads[:16]]
+            for f in futs:
+                f.result(timeout=60)
+        sanitized.close(drain=True, timeout=30)
+        assert watch.total() == 0, (
+            "recompiles under LOCKSAN=1: %s" % watch.delta())
+        assert locksan.violations() == [], locksan.violations()
+    finally:
+        locksan.reset()
+        if prev_locksan is None:
+            os.environ.pop("MXNET_TPU_LOCKSAN", None)
+        else:
+            os.environ["MXNET_TPU_LOCKSAN"] = prev_locksan
+
     telem_path = "/tmp/mxnet_tpu_serve_smoke_telemetry.json"
     with open(telem_path, "w") as f:
         f.write(telemetry.to_json_lines())
@@ -778,6 +809,7 @@ def serve_smoke():
             lat.get("sum", 0.0) / lat["count"], 3) if lat.get("count")
         else None,
         "rejections": rejected,
+        "locksan": {"violations": 0, "recompiles": 0},
         "telemetry": telem_path,
     }))
 
@@ -2654,7 +2686,8 @@ def elastic_smoke():
     for k in ("MXNET_TPU_CHAOS_PLAN", "MXNET_TPU_COMM_BUCKET_MB",
               "MXNET_TPU_GRAD_COMPRESS", "MXNET_TPU_EXEC_CACHE",
               "MXNET_TPU_PROGRAM_CACHE_RO", "MXNET_TPU_FLIGHT_PATH",
-              "MXNET_TPU_HEALTH", "MXNET_TPU_QUANTIZE"):
+              "MXNET_TPU_HEALTH", "MXNET_TPU_QUANTIZE",
+              "MXNET_TPU_LOCKSAN", "MXNET_TPU_LOCKSAN_RULES"):
         env.pop(k, None)
 
     def run_child(role, extra=None, expect_rc=0):
@@ -2696,6 +2729,8 @@ def elastic_smoke():
         # step 15 and trains the long re-factorized tail
         ckpt_dir4 = ckpt_dir + "_dp4"
         shutil.copytree(ckpt_dir, ckpt_dir4)
+        ckpt_dir_ls = ckpt_dir + "_ls"
+        shutil.copytree(ckpt_dir, ckpt_dir_ls)
 
         resumed8 = run_child("resume8")
         # corrupt newest rejected at manifest verify -> previous wins
@@ -2713,6 +2748,21 @@ def elastic_smoke():
             resumed8["builds"]
         assert resumed8["builds"]["built"] == 0, resumed8["builds"]
         assert resumed8["builds"]["restored"] >= 1, resumed8["builds"]
+
+        # LOCKSAN leg: the identical dp=8 resume under the runtime lock
+        # sanitizer (MXNET_TPU_LOCKSAN=1) — the elastic loop's lock
+        # discipline shows zero violations, the warm resume still
+        # compiles nothing (proxies are host-side bookkeeping, no
+        # program changes), and final params stay BITWISE-equal
+        resumed_ls = run_child("resume8ls", extra={
+            "MXNET_TPU_CKPT_DIR": ckpt_dir_ls, "MXNET_TPU_LOCKSAN": "1"})
+        assert resumed_ls["locksan_violations"] == 0, resumed_ls
+        assert resumed_ls["resume"]["step"] == 15, resumed_ls["resume"]
+        assert resumed_ls["params_sha"] == straight["params_sha"], (
+            "LOCKSAN=1 resume params differ from the uninterrupted run")
+        assert resumed_ls["builds"]["backend_compiles"] == 0, \
+            resumed_ls["builds"]
+        assert resumed_ls["builds"]["built"] == 0, resumed_ls["builds"]
 
         resumed4 = run_child("resume4",
                              extra={"MXNET_TPU_CKPT_DIR": ckpt_dir4})
@@ -2742,7 +2792,8 @@ def elastic_smoke():
         flight_text = tv.summarize_flight(doc)
         assert "last checkpoint: step" in flight_text, flight_text
     finally:
-        for d in (cache_dir, ckpt_dir, ckpt_dir + "_dp4", out_dir):
+        for d in (cache_dir, ckpt_dir, ckpt_dir + "_dp4",
+                  ckpt_dir + "_ls", out_dir):
             shutil.rmtree(d, ignore_errors=True)
 
     print(json.dumps({
@@ -2755,6 +2806,7 @@ def elastic_smoke():
             "backend_compiles"],
         "warm_resume_disk_restores": resumed8["builds"]["restored"],
         "refactorized_param_max_diff": param_max_diff,
+        "locksan_resume_violations": 0,
         "straight_sha": straight["params_sha"][:16],
     }))
 
@@ -2773,6 +2825,7 @@ def elastic_child():
     out_dir = os.environ["MXTPU_ELASTIC_OUT"]
     import mxnet_tpu as mx
     from mxnet_tpu import elastic
+    from mxnet_tpu.analysis import locksan
     from mxnet_tpu.elastic import chaos
     from mxnet_tpu.observability import flight_recorder, memprof
 
@@ -2825,6 +2878,7 @@ def elastic_child():
         "params_sha": sha.hexdigest(),
         "builds": {k: totals1[k] - totals0[k] for k in totals1},
         "resume": None if report is None else report.describe(),
+        "locksan_violations": len(locksan.violations()),
         "flight": dump,
     }))
 

@@ -24,6 +24,8 @@ it over the package against `.graftlint-baseline.json`.
 from .lint_core import (Finding, LintContext, Rule, RULES, lint_source,
                         lint_file, lint_paths, iter_py_files)
 from . import lint_rules  # noqa: F401  (imports register the rule set)
+from .concurrency import (ConcurrencyModel, analyze_paths, analyze_source,
+                          analyze_contexts)
 from .baseline import (load_baseline, save_baseline, finding_counts,
                        new_findings)
 from .graph_verify import GraphIssue, GraphReport, verify_graph, verify_json
@@ -31,6 +33,8 @@ from .graph_verify import GraphIssue, GraphReport, verify_graph, verify_json
 __all__ = [
     "Finding", "LintContext", "Rule", "RULES",
     "lint_source", "lint_file", "lint_paths", "iter_py_files",
+    "ConcurrencyModel", "analyze_paths", "analyze_source",
+    "analyze_contexts",
     "load_baseline", "save_baseline", "finding_counts", "new_findings",
     "GraphIssue", "GraphReport", "verify_graph", "verify_json",
 ]

@@ -1,0 +1,1022 @@
+"""graftsan tier 1: package-wide concurrency static analysis.
+
+graftlint's GL001-GL006 are single-file rules; the concurrency rules
+cannot be, because a deadlock is a property of *pairs* of call paths.
+This module builds one model of the whole package from its ASTs:
+
+* a **lock catalog** — every ``threading.Lock/RLock/Condition`` (or
+  `mxnet_tpu.threads` factory) stored on a class attribute or module
+  global, identified as ``module:Class.attr`` / ``module:name``;
+* an **acquisition map** — every ``with <lock>:`` block and explicit
+  ``.acquire()`` call, resolved to a cataloged lock where possible
+  (``self.attr`` through the class-and-bases chain, bare names through
+  module globals and intra-package imports, ``other.attr`` by unique
+  attribute match in the same module, then package-wide);
+* an approximate **call graph** — ``self.method``, local/nested and
+  module functions, and intra-package ``from .x import y`` /
+  ``module.func`` calls.  ``threading.Thread(target=f)`` is deliberately
+  NOT a call edge: handing work to a thread is the sanctioned way out of
+  a signal handler or a lock region, and the spawned body runs on its
+  own stack with its own (empty) held-lock set.
+
+From the model, four package-scope rules:
+
+* **GL007 lock-order cycle** — acquiring B (directly, or anywhere inside
+  a called function, transitively) while holding A adds edge A→B to the
+  lock-order graph; any strongly-connected component is a potential
+  deadlock, reported at each participating acquisition site.
+* **GL008 lock held across blocking call** — inside a held region, calls
+  that can block unboundedly or synchronize with the device:
+  ``queue.get`` (zero-positional ``.get()``), ``Future.result``,
+  thread-style ``.join()``, ``.wait()/.wait_for()`` (exempt when waiting
+  on the held lock itself — that *releases* it), ``time.sleep``,
+  ``open()``, socket recv/accept/connect, and jax syncs
+  (``block_until_ready``, ``device_get``, ``.asnumpy()``).  One level of
+  inter-procedural propagation: calling a function that itself directly
+  blocks is flagged at the call site.
+* **GL009 signal-handler-unsafe call** — any function reachable from a
+  ``signal.signal``-registered handler that acquires a lock, calls
+  logging, or touches the flight recorder.  A handler interrupts an
+  arbitrary frame that may already hold the very lock it would take
+  (logging and the flight recorder both lock internally) — the PR 13
+  bug class.  The clean patterns stay silent: set a flag (elastic
+  Checkpointer) or spawn a thread (serving drain).
+* **GL010 unjoined non-daemon thread** — package-spawned threads that
+  are neither ``daemon=True`` nor joined anywhere in their file
+  (including ``for t in threads: t.join()`` loops) outlive close() and
+  hang interpreter shutdown.
+
+Findings ride the standard machinery: per-file ``# graftlint:
+disable=GLxxx`` suppressions apply at the reported line, and keys diff
+against the shared ``.graftlint-baseline.json`` ratchet so CI fails only
+on NEW findings.  Model limits (documented, shared with locksan): lock
+identity is per *name*, not per instance, so ordering between two
+instances of one per-replica lock is invisible; dynamic dispatch,
+callbacks and dataflow through containers are not call edges.
+
+Driven by ``tools/graftcheck.py --concurrency`` and ``make lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .lint_core import (Finding, LintContext, Rule, register, RULES,
+                        SEV_ERROR, SEV_WARNING, iter_py_files)
+
+# -- rule registrations (package scope: per-file check() is empty; the ----
+# -- model drives them via analyze_paths/analyze_contexts) ----------------
+
+
+class _PackageRule(Rule):
+    scope = "package"
+
+    def check(self, ctx):  # package-scope rules never run per-file
+        return ()
+
+
+@register
+class LockOrderCycleRule(_PackageRule):
+    """Inter-procedural lock-order graph has a cycle (potential deadlock)."""
+    id = "GL007"
+    severity = SEV_ERROR
+    title = "lock-order cycle"
+    hint = ("acquire locks in one global order or restructure so only one "
+            "is held at a time; the cited sites are the cycle's edges")
+
+
+@register
+class HeldAcrossBlockingRule(_PackageRule):
+    """A lock is held across a call that can block unboundedly."""
+    id = "GL008"
+    severity = SEV_WARNING
+    title = "lock held across blocking call"
+    hint = ("release the lock before blocking (copy state out, work, "
+            "re-acquire); suppress with a justification when the "
+            "serialization is the point")
+
+
+@register
+class SignalUnsafeRule(_PackageRule):
+    """A signal handler's call graph acquires a lock / logs / records."""
+    id = "GL009"
+    severity = SEV_ERROR
+    title = "signal-handler-unsafe call"
+    hint = ("the handler interrupts a frame that may already hold that "
+            "lock (logging and the flight recorder lock internally): set "
+            "a flag or hand off to a thread and do the work outside the "
+            "handler")
+
+
+@register
+class UnjoinedThreadRule(_PackageRule):
+    """A non-daemon package thread has no registered join/close path."""
+    id = "GL010"
+    severity = SEV_WARNING
+    title = "unjoined non-daemon thread"
+    hint = ("pass daemon=True (threads.spawn's default) or join the "
+            "thread in the owner's close()/stop() path")
+
+
+_CONCURRENCY_RULES = ("GL007", "GL008", "GL009", "GL010")
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threads.package_lock", "threads.package_rlock",
+    "threads.package_condition",
+    "package_lock", "package_rlock", "package_condition",
+}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_SPAWN_CTORS = {"threads.spawn", "spawn"}
+_LOG_RECEIVERS = {"log", "logger", "logging", "_log", "_logger"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_FLIGHT_RECEIVERS = {"flight", "_flight", "flight_recorder"}
+_SOCKET_BLOCKING = {"recv", "recv_into", "accept", "connect", "sendall"}
+_JAX_SYNC = {"block_until_ready", "asnumpy"}
+
+
+def _modname(path):
+    """'mxnet_tpu/serving/router.py' -> 'mxnet_tpu.serving.router'."""
+    if not path.endswith(".py"):
+        return path
+    mod = path[:-3].replace("\\", "/").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+def _short(modname):
+    return modname[len("mxnet_tpu."):] if modname.startswith("mxnet_tpu.") \
+        else modname
+
+
+class _FuncInfo:
+    """One function/method definition plus everything the rules need."""
+
+    __slots__ = ("key", "node", "file", "cls", "qual",
+                 "acquire_sites", "calls", "blocking_ops",
+                 "gl9_logging", "gl9_flight", "gl008_direct")
+
+    def __init__(self, key, node, file, cls, qual):
+        self.key = key            # (modname, qualname)
+        self.node = node
+        self.file = file          # _FileInfo
+        self.cls = cls            # enclosing class name or None
+        self.qual = qual
+        self.acquire_sites = []   # (lock_id, lineno)
+        self.calls = []           # (callee_key, lineno, held_ids_tuple)
+        self.blocking_ops = []    # (desc, kind, waited_lock_id, lineno)
+        self.gl9_logging = []     # (dotted, lineno)
+        self.gl9_flight = []      # (dotted, lineno)
+        self.gl008_direct = []    # (held_id, desc, lineno)
+
+
+class _FileInfo:
+    __slots__ = ("ctx", "modname", "package", "module_locks", "classes",
+                 "imports", "from_imports", "functions", "signal_aliases",
+                 "join_targets", "daemon_true", "thread_creations",
+                 "signal_regs")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.modname = _modname(ctx.path)
+        self.package = self.modname.rsplit(".", 1)[0] \
+            if "." in self.modname else self.modname
+        if ctx.path.endswith("__init__.py"):
+            self.package = self.modname
+        self.module_locks = {}     # name -> lineno
+        self.classes = {}          # cls -> {"locks": {attr: lineno},
+        #                                    "bases": [dotted, ...]}
+        self.imports = {}          # alias -> module dotted name
+        self.from_imports = {}     # name -> (module dotted, orig name)
+        self.functions = {}        # qual -> _FuncInfo
+        self.signal_aliases = set()   # names bound to the signal module
+        self.join_targets = set()  # base names with thread-style .join()
+        self.daemon_true = set()   # base names assigned .daemon = True
+        self.thread_creations = []  # (lineno, effective_daemon, base, anon)
+        self.signal_regs = []      # (handler_key, handler_name, lineno)
+
+
+class ConcurrencyModel:
+    """The package-wide lock/thread model; see the module docstring."""
+
+    def __init__(self):
+        self.files = []            # [_FileInfo]
+        self.by_mod = {}           # modname -> _FileInfo
+        self.functions = {}        # key -> _FuncInfo
+        self.lock_attr_index = {}  # attr -> set of lock ids (class attrs)
+        self.edges = {}            # (a, b) -> (ctx, lineno) first site
+        self._finalized = False
+
+    # -- pass 1: indexing ---------------------------------------------------
+
+    def add_file(self, ctx):
+        fi = _FileInfo(ctx)
+        self.files.append(fi)
+        self.by_mod.setdefault(fi.modname, fi)
+        self._index_imports(fi)
+        self._index_defs(fi)
+        self._index_joins(fi)
+
+    def _index_imports(self, fi):
+        for node in ast.walk(fi.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    fi.imports[name] = alias.name
+                    if alias.name == "signal":
+                        fi.signal_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:  # relative import -> absolute
+                    base = fi.package
+                    for _ in range(node.level - 1):
+                        base = base.rsplit(".", 1)[0] if "." in base else base
+                    mod = "%s.%s" % (base, mod) if mod else base
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    fi.from_imports[name] = (mod, alias.name)
+                    if mod == "signal" and alias.name == "signal":
+                        fi.signal_aliases.add(name)
+                    # `from . import telemetry` binds a module object
+                    fi.imports.setdefault(name, "%s.%s" % (mod, alias.name))
+
+    def _index_defs(self, fi):
+        def walk(body, cls, qual_prefix):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    if qual_prefix or cls:
+                        continue  # nested classes: out of model
+                    bases = [b for b in
+                             (LintContext.dotted(base)
+                              for base in node.bases) if b]
+                    fi.classes[node.name] = {"locks": {}, "bases": bases}
+                    walk(node.body, node.name, "")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    qual = ("%s.%s" % (qual_prefix, node.name)
+                            if qual_prefix else
+                            ("%s.%s" % (cls, node.name) if cls
+                             else node.name))
+                    key = (fi.modname, qual)
+                    info = _FuncInfo(key, node, fi, cls, qual)
+                    fi.functions[qual] = info
+                    self.functions[key] = info
+                    walk(node.body, cls, qual)
+                elif isinstance(node, ast.Assign):
+                    self._index_lock_assign(fi, node, cls,
+                                            in_func=bool(qual_prefix))
+                else:
+                    # descend into compound statements (if/try/with/for)
+                    # so defs nested inside them are still indexed
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, ast.stmt):
+                            walk([child], cls, qual_prefix)
+                        elif isinstance(child, ast.ExceptHandler):
+                            walk(child.body, cls, qual_prefix)
+
+        walk(fi.ctx.tree.body, None, "")
+        # lock attrs assigned inside methods (`self.x = Lock()` in
+        # __init__) need a sweep of every function body
+        for info in fi.functions.values():
+            if info.cls is None:
+                continue
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign):
+                    self._index_lock_assign(fi, node, info.cls,
+                                            in_func=True)
+
+    def _index_lock_assign(self, fi, node, cls, in_func):
+        if not isinstance(node.value, ast.Call):
+            return
+        ctor = LintContext.dotted(node.value.func)
+        if ctor not in _LOCK_CTORS and ctor not in ("Lock", "RLock",
+                                                    "Condition"):
+            return
+        if ctor in ("Lock", "RLock", "Condition") \
+                and fi.from_imports.get(ctor, ("",))[0] != "threading":
+            return
+        for target in node.targets:
+            if isinstance(target, ast.Name) and not in_func and cls is None:
+                fi.module_locks[target.id] = node.lineno
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" and cls:
+                entry = fi.classes.setdefault(
+                    cls, {"locks": {}, "bases": []})
+                entry["locks"][target.attr] = node.lineno
+
+    def _index_joins(self, fi):
+        def thread_join(call):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "join"):
+                return False
+            if isinstance(call.func.value, ast.Constant):
+                return False  # "".join(...)
+            dotted = LintContext.dotted(call.func)
+            if dotted and ".path." in ".%s." % dotted:
+                return False  # os.path.join
+            if not call.args:
+                return True
+            if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, (int, float)):
+                return True
+            return any(kw.arg == "timeout" for kw in call.keywords)
+
+        def base_of(expr):
+            if isinstance(expr, ast.Attribute):
+                return expr.attr
+            if isinstance(expr, ast.Name):
+                return expr.id
+            return None
+
+        for node in ast.walk(fi.ctx.tree):
+            if isinstance(node, ast.Call) and thread_join(node):
+                base = base_of(node.func.value)
+                if base:
+                    fi.join_targets.add(base)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                # `for t in threads: t.join()` registers `threads`
+                tgt = node.target
+                it = node.iter
+                if isinstance(tgt, ast.Name):
+                    body = node.body if isinstance(node, ast.For) else []
+                    for sub in body:
+                        for call in ast.walk(sub):
+                            if isinstance(call, ast.Call) \
+                                    and thread_join(call) \
+                                    and isinstance(call.func.value,
+                                                   ast.Name) \
+                                    and call.func.value.id == tgt.id:
+                                base = base_of(it)
+                                if base:
+                                    fi.join_targets.add(base)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) \
+                            and target.attr == "daemon":
+                        base = base_of(target.value)
+                        if base:
+                            fi.daemon_true.add(base)
+
+    # -- resolution helpers -------------------------------------------------
+
+    def _lock_id(self, modname, owner, attr):
+        if owner:
+            return "%s:%s.%s" % (_short(modname), owner, attr)
+        return "%s:%s" % (_short(modname), attr)
+
+    def _file_of(self, modname):
+        return self.by_mod.get(modname)
+
+    def _class_entry(self, modname, cls, seen=None):
+        """(modname, cls) entry, or None."""
+        fi = self._file_of(modname)
+        if fi and cls in fi.classes:
+            return modname, fi.classes[cls], fi
+        return None
+
+    def _resolve_class_name(self, fi, dotted):
+        """A base-class reference in file `fi` -> (modname, cls)."""
+        if "." in dotted:
+            head, _, cls = dotted.rpartition(".")
+            mod = fi.imports.get(head)
+            return (mod, cls) if mod else None
+        if dotted in fi.classes:
+            return fi.modname, dotted
+        if dotted in fi.from_imports:
+            mod, orig = fi.from_imports[dotted]
+            return mod, orig
+        return None
+
+    def _class_lock(self, modname, cls, attr, seen=None):
+        """Lock id for attr on class (walking bases), or None."""
+        seen = seen or set()
+        if (modname, cls) in seen:
+            return None
+        seen.add((modname, cls))
+        hit = self._class_entry(modname, cls)
+        if hit is None:
+            return None
+        owner_mod, entry, fi = hit
+        if attr in entry["locks"]:
+            return self._lock_id(owner_mod, cls, attr)
+        for base in entry["bases"]:
+            resolved = self._resolve_class_name(fi, base)
+            if resolved:
+                lid = self._class_lock(resolved[0], resolved[1], attr, seen)
+                if lid:
+                    return lid
+        return None
+
+    def _class_method(self, modname, cls, name, seen=None):
+        seen = seen or set()
+        if (modname, cls) in seen:
+            return None
+        seen.add((modname, cls))
+        hit = self._class_entry(modname, cls)
+        if hit is None:
+            return None
+        owner_mod, entry, fi = hit
+        key = (owner_mod, "%s.%s" % (cls, name))
+        if key in self.functions:
+            return key
+        for base in entry["bases"]:
+            resolved = self._resolve_class_name(fi, base)
+            if resolved:
+                got = self._class_method(resolved[0], resolved[1], name,
+                                         seen)
+                if got:
+                    return got
+        return None
+
+    def resolve_lock(self, finfo, expr):
+        """Lock id for an acquisition expression, or None."""
+        fi = finfo.file
+        if isinstance(expr, ast.Name):
+            if expr.id in fi.module_locks:
+                return self._lock_id(fi.modname, None, expr.id)
+            if expr.id in fi.from_imports:
+                mod, orig = fi.from_imports[expr.id]
+                other = self._file_of(mod)
+                if other and orig in other.module_locks:
+                    return self._lock_id(mod, None, orig)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and finfo.cls:
+                lid = self._class_lock(fi.modname, finfo.cls, attr)
+                if lid:
+                    return lid
+            mod = fi.imports.get(base.id)
+            if mod:
+                other = self._file_of(mod)
+                if other and attr in other.module_locks:
+                    return self._lock_id(mod, None, attr)
+                return None
+        # unique-attribute fallback: same module, then package-wide
+        local = [self._lock_id(fi.modname, cls, attr)
+                 for cls, entry in fi.classes.items()
+                 if attr in entry["locks"]]
+        if len(local) == 1:
+            return local[0]
+        if not local:
+            global_hits = self.lock_attr_index.get(attr, ())
+            if len(global_hits) == 1:
+                return next(iter(global_hits))
+        return None
+
+    def resolve_callee(self, finfo, call):
+        """FuncInfo key for a call, or None.  Thread targets excluded."""
+        fi = finfo.file
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested defs: innermost enclosing scope outward
+            qual = finfo.qual
+            while qual:
+                cand = "%s.%s" % (qual, name)
+                if (fi.modname, cand) in self.functions:
+                    return fi.modname, cand
+                qual = qual.rpartition(".")[0]
+            if (fi.modname, name) in self.functions:
+                return fi.modname, name
+            if name in fi.from_imports:
+                mod, orig = fi.from_imports[name]
+                if (mod, orig) in self.functions:
+                    return mod, orig
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and finfo.cls:
+                return self._class_method(fi.modname, finfo.cls, attr)
+            mod = fi.imports.get(base.id)
+            if mod and (mod, attr) in self.functions:
+                return mod, attr
+            resolved = self._resolve_class_name(fi, base.id) \
+                if (base.id in fi.classes or base.id in fi.from_imports) \
+                else None
+            if resolved:
+                return self._class_method(resolved[0], resolved[1], attr)
+        return None
+
+    # -- pass 2: per-function body scan --------------------------------------
+
+    def finalize(self):
+        if self._finalized:
+            return
+        self._finalized = True
+        for fi in self.files:
+            for cls, entry in fi.classes.items():
+                for attr in entry["locks"]:
+                    self.lock_attr_index.setdefault(attr, set()).add(
+                        self._lock_id(fi.modname, cls, attr))
+        for info in self.functions.values():
+            _BodyScan(self, info).run()
+
+    def add_edge(self, a, b, ctx, lineno):
+        if a == b:
+            return
+        self.edges.setdefault((a, b), (ctx, lineno))
+
+    # -- findings -------------------------------------------------------------
+
+    def findings(self, rules=None):
+        self.finalize()
+        wanted = set(rules) if rules else set(_CONCURRENCY_RULES)
+        out = []
+        if "GL007" in wanted:
+            out.extend(self._gl007())
+        if "GL008" in wanted:
+            out.extend(self._gl008())
+        if "GL009" in wanted:
+            out.extend(self._gl009())
+        if "GL010" in wanted:
+            out.extend(self._gl010())
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+    def _emit(self, rule_id, ctx, lineno, message):
+        rule = RULES[rule_id]
+        if ctx.suppressed(lineno, rule_id):
+            return None
+        return Finding(rule_id, rule.severity, ctx.path, lineno, 0,
+                       message, rule.hint, ctx.line_text(lineno))
+
+    # GL007 -------------------------------------------------------------------
+
+    def _order_graph(self):
+        """Direct edges are recorded during the body scan; here the
+        inter-procedural ones are added: holding L while calling f orders
+        L before everything f (transitively) acquires."""
+        # transitive acquires fixpoint over the call graph
+        trans = {key: {lid for lid, _ in info.acquire_sites}
+                 for key, info in self.functions.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, info in self.functions.items():
+                mine = trans[key]
+                before = len(mine)
+                for callee, _, _ in info.calls:
+                    if callee in trans:
+                        mine |= trans[callee]
+                if len(mine) != before:
+                    changed = True
+        for info in self.functions.values():
+            for callee, lineno, held in info.calls:
+                if not held or callee not in trans:
+                    continue
+                for h in held:
+                    for lid in trans[callee]:
+                        self.add_edge(h, lid, info.file.ctx, lineno)
+        graph = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        return graph
+
+    def _gl007(self):
+        graph = self._order_graph()
+        sccs = _tarjan(graph)
+        in_cycle = {}
+        for comp in sccs:
+            if len(comp) > 1:
+                for n in comp:
+                    in_cycle[n] = frozenset(comp)
+        out = []
+        for (a, b), (ctx, lineno) in sorted(
+                self.edges.items(), key=lambda kv: (kv[1][0].path,
+                                                    kv[1][1])):
+            comp = in_cycle.get(a)
+            if comp is None or b not in comp:
+                continue
+            cycle = _cycle_path(graph, b, a, comp)
+            f = self._emit(
+                "GL007", ctx, lineno,
+                "lock-order cycle: %r acquired while holding %r "
+                "(cycle: %s)" % (b, a,
+                                 " -> ".join([a, b] + cycle[1:])))
+            if f:
+                out.append(f)
+        return out
+
+    # GL008 -------------------------------------------------------------------
+
+    def _gl008(self):
+        out = []
+        for info in self.functions.values():
+            for held_id, desc, lineno in info.gl008_direct:
+                f = self._emit(
+                    "GL008", info.file.ctx, lineno,
+                    "lock %r held across blocking %s" % (held_id, desc))
+                if f:
+                    out.append(f)
+            # depth-1 inter-procedural: call under lock to a function
+            # with its own direct blocking ops
+            for callee, lineno, held in info.calls:
+                if not held or callee not in self.functions:
+                    continue
+                target = self.functions[callee]
+                for desc, kind, waited, _ in target.blocking_ops:
+                    culprits = [h for h in held
+                                if not (kind == "wait" and waited == h)]
+                    if not culprits:
+                        continue
+                    f = self._emit(
+                        "GL008", info.file.ctx, lineno,
+                        "lock %s held across call to '%s', which blocks "
+                        "on %s" % (", ".join(map(repr, culprits)),
+                                   target.qual, desc))
+                    if f:
+                        out.append(f)
+                    break  # one finding per call site is enough
+        return out
+
+    # GL009 -------------------------------------------------------------------
+
+    def _gl009(self):
+        handlers = []
+        for fi in self.files:
+            handlers.extend((key, name, fi, lineno)
+                            for key, name, lineno in fi.signal_regs)
+        out = []
+        reported = set()
+        for key, hname, reg_fi, reg_line in handlers:
+            if key not in self.functions:
+                continue
+            seen = set()
+            queue = [key]
+            while queue:
+                cur = queue.pop()
+                if cur in seen or cur not in self.functions:
+                    continue
+                seen.add(cur)
+                info = self.functions[cur]
+                queue.extend(c for c, _, _ in info.calls)
+                if cur in reported:
+                    continue
+                reported.add(cur)
+                prefix = ("'%s' is reachable from signal handler %r "
+                          "(registered at %s:%d) and "
+                          % (info.qual, hname, reg_fi.ctx.path, reg_line))
+                for lid, lineno in info.acquire_sites:
+                    f = self._emit("GL009", info.file.ctx, lineno,
+                                   prefix + "acquires lock %r" % lid)
+                    if f:
+                        out.append(f)
+                for dotted, lineno in info.gl9_logging:
+                    f = self._emit("GL009", info.file.ctx, lineno,
+                                   prefix + "calls logging (%r)" % dotted)
+                    if f:
+                        out.append(f)
+                for dotted, lineno in info.gl9_flight:
+                    f = self._emit(
+                        "GL009", info.file.ctx, lineno,
+                        prefix + "touches the flight recorder (%r)"
+                        % dotted)
+                    if f:
+                        out.append(f)
+        return out
+
+    # GL010 -------------------------------------------------------------------
+
+    def _gl010(self):
+        out = []
+        for fi in self.files:
+            for lineno, daemon, base, anon in fi.thread_creations:
+                if daemon is True:
+                    continue
+                if base and (base in fi.join_targets
+                             or base in fi.daemon_true):
+                    continue
+                what = "anonymous " if anon else ""
+                f = self._emit(
+                    "GL010", fi.ctx, lineno,
+                    "%snon-daemon thread has no join/close path in this "
+                    "file" % what)
+                if f:
+                    out.append(f)
+        return out
+
+
+def _tarjan(graph):
+    """Iterative Tarjan SCC over {node: set(succ)}."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(sorted(graph.get(succ, ())))))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    comp.append(n)
+                    if n == node:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+def _cycle_path(graph, start, goal, comp):
+    """Shortest path start -> goal within one SCC (for the message)."""
+    if start == goal:
+        return [start]
+    prev = {start: None}
+    queue = [start]
+    while queue:
+        cur = queue.pop(0)
+        for succ in sorted(graph.get(cur, ())):
+            if succ not in comp or succ in prev:
+                continue
+            prev[succ] = cur
+            if succ == goal:
+                path = [succ]
+                while prev[path[-1]] is not None:
+                    path.append(prev[path[-1]])
+                return list(reversed(path))
+            queue.append(succ)
+    return [start, goal]
+
+
+class _BodyScan:
+    """One function body: held-region tracking + op classification."""
+
+    def __init__(self, model, finfo):
+        self.model = model
+        self.f = finfo
+        self.held = []  # [(lock_id, lineno)]
+
+    def run(self):
+        node = self.f.node
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return  # nested defs execute on their own stack, not here
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = 0
+            for item in node.items:
+                self.visit(item.context_expr)
+                lid = self.model.resolve_lock(self.f, item.context_expr)
+                if lid is not None:
+                    self.on_acquire(lid, item.context_expr.lineno)
+                    self.held.append((lid, item.context_expr.lineno))
+                    acquired += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            if acquired:
+                del self.held[-acquired:]
+            return
+        if isinstance(node, ast.Call):
+            self.on_call(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def on_acquire(self, lid, lineno):
+        self.f.acquire_sites.append((lid, lineno))
+        for held_id, _ in self.held:
+            self.model.add_edge(held_id, lid, self.f.file.ctx, lineno)
+
+    def on_call(self, call):
+        fi = self.f.file
+        dotted = LintContext.dotted(call.func)
+        # explicit .acquire() on a resolvable lock
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire":
+            lid = self.model.resolve_lock(self.f, call.func.value)
+            if lid is not None:
+                self.on_acquire(lid, call.lineno)
+                return
+        # thread creation: catalog for GL010; target is NOT a call edge
+        if dotted in _THREAD_CTORS or dotted in _SPAWN_CTORS:
+            self.on_thread(call, dotted in _SPAWN_CTORS)
+            return
+        # signal.signal(sig, handler) registration
+        if self._is_signal_reg(dotted) and len(call.args) >= 2:
+            key = self._handler_key(call.args[1])
+            if key is not None:
+                name = (LintContext.dotted(call.args[1])
+                        or self.f.qual)
+                fi.signal_regs.append((key, name, call.lineno))
+        blocking = self._blocking(call)
+        if blocking is not None:
+            desc, kind, waited = blocking
+            self.f.blocking_ops.append((desc, kind, waited, call.lineno))
+            for held_id, _ in self.held:
+                if kind == "wait" and waited == held_id:
+                    continue  # Condition.wait releases the held lock
+                self.f.gl008_direct.append((held_id, desc, call.lineno))
+        if self._is_logging(call, dotted):
+            self.f.gl9_logging.append((dotted, call.lineno))
+        elif self._is_flight(call, dotted):
+            self.f.gl9_flight.append((dotted, call.lineno))
+        callee = self.model.resolve_callee(self.f, call)
+        if callee is not None:
+            self.f.calls.append((callee, call.lineno,
+                                 tuple(h for h, _ in self.held)))
+
+    def _is_signal_reg(self, dotted):
+        if not dotted:
+            return False
+        parts = dotted.split(".")
+        fi = self.f.file
+        if len(parts) == 2 and parts[1] == "signal" \
+                and parts[0] in fi.signal_aliases:
+            return True
+        return len(parts) == 1 and parts[0] in fi.signal_aliases \
+            and fi.from_imports.get(parts[0], ("",))[0] == "signal"
+
+    def _handler_key(self, expr):
+        if isinstance(expr, ast.Name):
+            qual = self.f.qual
+            fi = self.f.file
+            while qual:
+                cand = "%s.%s" % (qual, expr.id)
+                if (fi.modname, cand) in self.model.functions:
+                    return fi.modname, cand
+                qual = qual.rpartition(".")[0]
+            if (fi.modname, expr.id) in self.model.functions:
+                return fi.modname, expr.id
+            if expr.id in fi.from_imports:
+                mod, orig = fi.from_imports[expr.id]
+                if (mod, orig) in self.model.functions:
+                    return mod, orig
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and self.f.cls:
+            return self.model._class_method(self.f.file.modname,
+                                            self.f.cls, expr.attr)
+        return None
+
+    def on_thread(self, call, is_spawn):
+        daemon = True if is_spawn else None
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        base, anon = self._assign_base(call)
+        self.f.file.thread_creations.append(
+            (call.lineno, daemon, base, anon))
+
+    def _assign_base(self, call):
+        """Base name the created thread is bound to, by scanning the
+        enclosing function for the Assign that contains this call."""
+        for node in ast.walk(self.f.node):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            if not any(sub is call for sub in ast.walk(node.value)):
+                continue
+            target = node.targets[0] if isinstance(node, ast.Assign) \
+                else node.target
+            if isinstance(target, ast.Attribute):
+                return target.attr, False
+            if isinstance(target, ast.Name):
+                return target.id, False
+        return None, True
+
+    def _blocking(self, call):
+        """(desc, kind, waited_lock_id) for a blocking call, else None."""
+        dotted = LintContext.dotted(call.func)
+        if dotted in ("time.sleep",):
+            return "time.sleep()", "sleep", None
+        if dotted == "open":
+            return "open()", "io", None
+        if dotted in ("jax.device_get", "device_get"):
+            return "%s()" % dotted, "jax", None
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = call.func.value
+        npos = len(call.args)
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if attr == "result":
+            return "Future.result()", "future", None
+        if attr == "join":
+            if isinstance(recv, ast.Constant):
+                return None  # "".join(...)
+            if dotted and ".path." in ".%s." % dotted:
+                return None  # os.path.join
+            if npos == 0 or has_timeout or (
+                    npos == 1 and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, (int, float))):
+                return ".join()", "join", None
+            return None
+        if attr == "get":
+            if isinstance(recv, ast.Name) \
+                    and recv.id in self.f.file.imports:
+                return None  # module.get(): a function, not a queue
+            if npos == 0 and not call.keywords:
+                return "queue get()", "queue", None
+            if has_timeout and npos == 0:
+                return "queue get(timeout=...)", "queue", None
+            return None
+        if attr in ("wait", "wait_for"):
+            waited = self.model.resolve_lock(self.f, recv)
+            return ".%s()" % attr, "wait", waited
+        if attr in _JAX_SYNC:
+            return ".%s()" % attr, "jax", None
+        if attr in _SOCKET_BLOCKING:
+            return ".%s()" % attr, "socket", None
+        return None
+
+    def _is_logging(self, call, dotted):
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _LOG_METHODS):
+            return False
+        recv = call.func.value
+        if isinstance(recv, ast.Name):
+            return recv.id in _LOG_RECEIVERS
+        if isinstance(recv, ast.Attribute):
+            return recv.attr in _LOG_RECEIVERS
+        return False
+
+    def _is_flight(self, call, dotted):
+        if dotted and "flight_recorder." in dotted:
+            return True
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        recv = call.func.value
+        base = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else None)
+        if base in _FLIGHT_RECEIVERS:
+            return True
+        return base is not None and call.func.attr.startswith("note_") \
+            and base in _FLIGHT_RECEIVERS
+    # (note_* on arbitrary receivers is deliberately NOT matched: only
+    # recognizably flight-named receivers count, to keep GL009 precise)
+
+
+# -- drivers ------------------------------------------------------------------
+
+
+def analyze_contexts(ctxs, rules=None):
+    """Run the concurrency rules over pre-parsed LintContexts."""
+    model = ConcurrencyModel()
+    for ctx in ctxs:
+        model.add_file(ctx)
+    return model.findings(rules=rules)
+
+
+def analyze_source(src, path="<string>", rules=None):
+    """Single-source convenience (tests): analyze one file's worth."""
+    return analyze_contexts([LintContext(src, path)], rules=rules)
+
+
+def analyze_paths(paths, root=None, rules=None):
+    """Package-wide concurrency analysis over files/dirs (the
+    graftcheck --concurrency entry point).  Files that fail to parse are
+    skipped here — the per-file lint pass already reports GL000."""
+    ctxs = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+        rel = os.path.relpath(path, root) if root else path
+        try:
+            ctxs.append(LintContext(src, rel.replace(os.sep, "/")))
+        except SyntaxError:
+            continue
+    return analyze_contexts(ctxs, rules=rules)

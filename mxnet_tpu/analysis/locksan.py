@@ -1,0 +1,296 @@
+"""locksan — runtime lock-order sanitizer (graftsan tier 2).
+
+Static analysis (`analysis/concurrency.py`) reasons about every path; this
+module watches the paths the process actually takes.  Under
+``MXNET_TPU_LOCKSAN=1`` the `mxnet_tpu.threads` factories wrap each
+package-created lock in a :class:`LockProxy` that records, per thread, the
+stack of currently-held locks and where each was acquired.  From those it
+detects, as they happen:
+
+* **lock-order inversions** (GL007's dynamic analog): acquiring B while
+  holding A after some thread has already acquired A while holding B —
+  the two-thread interleaving is a deadlock whether or not it deadlocked
+  *this* run.  Ordering is tracked per lock *name* (the static catalog's
+  ``Class.attr`` spelling), so all instances of a per-replica lock share
+  one node and an inversion between any pair of instances is caught.
+  Nesting two same-named instances yields no edge — instance-level order
+  within a name class is invisible to the name graph, a documented
+  model limit shared with the static pass.
+
+* **held-across-dispatch** (GL008's dynamic analog): the serving dispatch
+  path calls :func:`check_dispatch_clear` just before handing a batch to
+  the model; any package lock held by the dispatching thread at that
+  point serializes device work behind host bookkeeping.
+
+Every violation increments the ``locksan.violations`` telemetry counter,
+lands a ``locksan`` flight-recorder note, and is appended to an in-process
+list (:func:`violations`) that tests and bench smokes assert empty.  Set
+``MXNET_TPU_LOCKSAN_RULES=GL007,GL008`` to additionally *raise*
+:class:`LockSanError` at the violation site — the proxy releases the
+just-acquired lock first, so the raise leaves lock state sane.
+
+The sanitizer reports through telemetry and the flight recorder, whose
+own locks may themselves be proxied: a per-thread reentrancy flag makes
+every proxy a silent pass-through while a report is being written, so the
+sanitizer never recurses into (or deadlocks on) itself.
+
+With the env var unset (the default), no proxy exists anywhere — the
+factories hand out plain ``threading`` primitives and this module is
+never imported.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+STACK_LIMIT = 6  # frames kept per acquisition site
+
+
+class LockSanError(RuntimeError):
+    """A lock-discipline violation, raised only for rule ids listed in
+    MXNET_TPU_LOCKSAN_RULES."""
+
+
+_tls = threading.local()
+
+# Plain primitives (created at import, before any proxying can be active)
+# guarding the process-wide order graph and violation list.
+_state_lock = threading.Lock()
+_order = {}       # (held_name, acquired_name) -> (held_stack, acq_stack)
+_violations = []  # dict records, append-only until reset()
+
+
+def enabled():
+    return os.environ.get("MXNET_TPU_LOCKSAN") == "1"
+
+
+def raise_rules():
+    """Rule ids (GL007/GL008) that escalate from record to raise."""
+    raw = os.environ.get("MXNET_TPU_LOCKSAN_RULES", "")
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+def reset():
+    """Drop the order graph and violation list (test/smoke isolation)."""
+    with _state_lock:
+        _order.clear()
+        del _violations[:]
+
+
+def violations():
+    """Snapshot of violation records seen since the last reset()."""
+    with _state_lock:
+        return list(_violations)
+
+
+def order_edges():
+    """Snapshot of observed (held, acquired) lock-name pairs."""
+    with _state_lock:
+        return sorted(_order)
+
+
+def _held():
+    lst = getattr(_tls, "held", None)
+    if lst is None:
+        lst = _tls.held = []
+    return lst
+
+
+def _reporting():
+    return getattr(_tls, "reporting", False)
+
+
+def _capture_stack():
+    """Short formatted stack of the acquisition site, sanitizer frames
+    trimmed, innermost last."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        fname = frame.f_code.co_filename
+        if not (fname.endswith("locksan.py") or fname.endswith("threads.py")
+                or fname.endswith("threading.py")):
+            break
+        frame = frame.f_back
+    summary = traceback.extract_stack(frame, limit=STACK_LIMIT)
+    return ["%s:%d (%s)" % (os.path.basename(fs.filename), fs.lineno,
+                            fs.name) for fs in summary]
+
+
+class _Held:
+    __slots__ = ("proxy", "name", "count", "stack")
+
+    def __init__(self, proxy, stack):
+        self.proxy = proxy
+        self.name = proxy.name
+        self.count = 1
+        self.stack = stack
+
+
+def _record(rule, kind, message, detail):
+    """Append + export one violation; returns a LockSanError to raise at
+    the call site when the rule is escalated, else None."""
+    rec = {"rule": rule, "kind": kind, "message": message,
+           "thread": threading.current_thread().name}
+    rec.update(detail)
+    _tls.reporting = True
+    try:
+        with _state_lock:
+            _violations.append(rec)
+        try:
+            from ..observability import telemetry, flight_recorder
+            telemetry.counter("locksan.violations").inc()
+            flight_recorder.note("locksan", rec)
+        except Exception:
+            pass  # never let reporting break the locked region itself
+    finally:
+        _tls.reporting = False
+    if rule in raise_rules():
+        return LockSanError("[%s] %s: %s" % (rule, kind, message))
+    return None
+
+
+def _note_acquired(proxy):
+    """Bookkeeping after a successful inner acquire; returns an error to
+    raise (after the caller unwinds the acquire) or None."""
+    held = _held()
+    for e in held:
+        if e.proxy is proxy:
+            e.count += 1  # reentrant re-acquire: no new order information
+            return None
+    stack = _capture_stack()
+    inversion = None
+    with _state_lock:
+        for e in held:
+            a, b = e.name, proxy.name
+            if a == b:
+                continue
+            _order.setdefault((a, b), (e.stack, stack))
+            if (b, a) in _order and inversion is None:
+                inversion = (a, b, _order[(b, a)])
+    held.append(_Held(proxy, stack))
+    if inversion is None:
+        return None
+    a, b, (b_stack, a_stack) = inversion
+    err = _record(
+        "GL007", "lock-order-inversion",
+        "acquired %r while holding %r, but the opposite order was "
+        "observed earlier" % (b, a),
+        {"locks": [a, b],
+         "this_thread": {"holding": a, "acquiring": b, "stack": stack},
+         "prior_order": {"holding": b, "acquiring": a,
+                         "stack": list(a_stack)}})
+    return err
+
+
+def _forget(proxy):
+    """Drop one recursion level of ``proxy`` from this thread's held
+    stack; tolerant of entries already cleared by ``_release_save``."""
+    held = _held()
+    for i, e in enumerate(held):
+        if e.proxy is proxy:
+            e.count -= 1
+            if e.count <= 0:
+                del held[i]
+            return
+
+
+def _forget_all(proxy):
+    held = _held()
+    for i, e in enumerate(held):
+        if e.proxy is proxy:
+            del held[i]
+            return
+
+
+class LockProxy:
+    """Wraps a ``threading.Lock``/``RLock`` with acquisition tracking.
+
+    Also usable as the lock of a ``threading.Condition``: the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio is exposed
+    via ``__getattr__`` when (and only when) the inner lock has it, so
+    RLock-backed conditions keep exact recursion semantics and
+    Lock-backed ones hit Condition's documented fallback — which routes
+    through :meth:`acquire`/:meth:`release` and stays tracked.
+    """
+
+    __slots__ = ("_lock", "name", "reentrant")
+
+    def __init__(self, lock, name, reentrant=False):
+        self._lock = lock
+        self.name = name
+        self.reentrant = reentrant
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and not _reporting():
+            err = _note_acquired(self)
+            if err is not None:
+                _forget(self)
+                self._lock.release()
+                raise err
+        return ok
+
+    def release(self):
+        if not _reporting():
+            _forget(self)
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __getattr__(self, attr):
+        # Condition-protocol delegation; AttributeError propagates for
+        # plain Locks so Condition installs its fallback instead.
+        if attr == "_is_owned":
+            return self._lock._is_owned
+        if attr == "_release_save":
+            inner = self._lock._release_save
+
+            def _release_save():
+                state = inner()
+                if not _reporting():
+                    _forget_all(self)
+                return state
+            return _release_save
+        if attr == "_acquire_restore":
+            inner = self._lock._acquire_restore
+
+            def _acquire_restore(state):
+                inner(state)
+                if not _reporting():
+                    _note_acquired(self)
+            return _acquire_restore
+        raise AttributeError(attr)
+
+    def __repr__(self):
+        return "<LockProxy %r %r>" % (self.name, self._lock)
+
+
+def held_locks():
+    """Names of package locks the current thread holds (tracked proxies
+    only) — empty when locksan is off."""
+    return [e.name for e in _held()]
+
+
+def check_dispatch_clear(site):
+    """Dispatch-path hook: record a GL008 violation if the calling thread
+    holds any package lock while handing work to the device.  Free when
+    locksan is off (the held list is empty)."""
+    held = _held()
+    if not held or _reporting():
+        return
+    names = [e.name for e in held]
+    err = _record(
+        "GL008", "held-across-dispatch",
+        "%s dispatched while holding %s" % (site, ", ".join(map(repr,
+                                                                names))),
+        {"locks": names, "site": site,
+         "stacks": {e.name: list(e.stack) for e in held}})
+    if err is not None:
+        raise err

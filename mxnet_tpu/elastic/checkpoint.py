@@ -42,6 +42,8 @@ import os
 import shutil
 import signal
 import threading
+
+from .. import threads as _threads
 import time
 
 from ..base import MXNetError
@@ -66,7 +68,7 @@ BACKOFF_CAP_S = 2.0
 
 _log = _module_logger(__name__)
 _tmp_counter = [0]
-_tmp_lock = threading.Lock()
+_tmp_lock = _threads.package_lock("checkpoint._tmp_lock")
 
 
 def _int_env(name, default):

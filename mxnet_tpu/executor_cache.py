@@ -41,6 +41,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from . import threads as _threads
 from collections import OrderedDict
 
 import jax
@@ -53,7 +55,7 @@ from .observability import health as _health
 from .observability import memprof as _memprof
 from .observability import telemetry as _telemetry
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("executor_cache._lock")
 _entries = OrderedDict()  # key -> ProgramEntry, LRU order
 _stats = {"hits": 0, "misses": 0, "evictions": 0,
           "traces_fwd": 0, "traces_fwd_bwd": 0, "traces_fused_step": 0}

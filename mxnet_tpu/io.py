@@ -16,6 +16,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import threads as _threads
 from .base import MXNetError
 from .ndarray import NDArray, array
 from .context import cpu
@@ -207,8 +208,8 @@ class PrefetchingIter(DataIter):
                 self.data_ready[i].set()
 
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i],
-                             daemon=True)
+            _threads.spawn(prefetch_func, "io", "prefetch-%d" % i,
+                           args=(self, i), start=False)
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             thread.start()

@@ -16,6 +16,8 @@ import os
 import subprocess
 import threading
 
+from .. import threads as _threads
+
 
 def native_disabled():
     """``MXNET_TPU_IO_NATIVE=0`` forces every native fast path
@@ -41,7 +43,7 @@ def _find_src_dir():
 
 _SRC_DIR = _find_src_dir()
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libmxnet_tpu_native.so")
-_lock = threading.Lock()
+_lock = _threads.package_lock("io_native._lock")
 _lib = None
 _tried = False
 
@@ -254,7 +256,7 @@ class NativeEngine:
         self._h = lib.engine_create(num_workers)
         self._keep = {}  # op id -> callback keepalive
         self._next = 0
-        self._cb_lock = threading.Lock()
+        self._cb_lock = _threads.package_lock("NativeEngine._cb_lock")
         # engines destroyed during interpreter finalization deadlock: the
         # C++ destructor joins worker threads whose Python callbacks can no
         # longer acquire the GIL.  Close every live engine from atexit

@@ -31,6 +31,7 @@ import queue as _queue
 import threading
 import warnings
 
+from .. import threads as _threads
 from ..base import MXNetError
 from ..observability import tracing as _tracing
 from ..observability.instrument import (arm_pipeline_gauges,
@@ -113,7 +114,7 @@ class ReorderBuffer:
         self._items = {}
         self._next = 0
         self._closed = False
-        self._cv = threading.Condition()
+        self._cv = _threads.package_condition("ReorderBuffer._cv")
 
     def put(self, seq, item):
         with self._cv:
@@ -254,12 +255,11 @@ class PrefetchExecutor:
         # survive a telemetry.reset() between epochs (serving idiom);
         # last-armed run wins when several pipelines are live
         gauge_token = arm_pipeline_gauges(task_q.qsize, rb.fill)
-        threads = [threading.Thread(target=feeder,
-                                    name="%s-feeder" % self.name,
-                                    daemon=True)]
-        threads += [threading.Thread(target=worker,
-                                     name="%s-worker-%d" % (self.name, i),
-                                     daemon=True)
+        threads = [_threads.spawn(feeder, "io_pipeline",
+                                  "%s-feeder" % self.name, start=False)]
+        threads += [_threads.spawn(worker, "io_pipeline",
+                                   "%s-worker-%d" % (self.name, i),
+                                   start=False)
                     for i in range(self.num_workers)]
         for t in threads:
             t.start()
@@ -386,9 +386,8 @@ class ThreadedStage:
         # timed=True when the foreground consumer IS the pipeline's
         # end consumer: its blocked time here is the starvation signal
         self._timed = bool(timed)
-        self._thread = threading.Thread(target=self._run, name=name,
-                                        daemon=True)
-        self._thread.start()
+        self._thread = _threads.spawn(self._run, "io_pipeline",
+                                      "stage-%s" % name)
 
     def _run(self):
         try:

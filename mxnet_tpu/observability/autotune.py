@@ -48,6 +48,8 @@ from __future__ import annotations
 import math
 import os
 import threading
+
+from .. import threads as _threads
 import time
 from collections import deque
 
@@ -68,7 +70,7 @@ MODE_ENV = "MXNET_TPU_AUTOTUNE"
 ACTIONS = ("apply", "recommend", "hold", "reject", "stop", "skip")
 
 _warned_mode = set()
-_log_lock = threading.Lock()
+_log_lock = _threads.package_lock("autotune._log_lock")
 _decisions = deque(maxlen=256)
 
 

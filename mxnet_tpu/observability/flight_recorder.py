@@ -37,6 +37,8 @@ import os
 import sys
 import tempfile
 import threading
+
+from .. import threads as _threads
 import time
 import traceback
 from collections import deque
@@ -123,7 +125,7 @@ class FlightRecorder:
                     "%d", _STEPS_ENV, raw, DEFAULT_STEPS)
                 capacity = DEFAULT_STEPS
         self.capacity = max(1, capacity)
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("FlightRecorder._lock")
         self._steps = deque(maxlen=self.capacity)
         self._events = deque(maxlen=EVENT_CAPACITY)
         self._logs = deque(maxlen=LOG_CAPACITY)
@@ -377,7 +379,7 @@ class FlightRecorder:
 # -- process-wide singleton ----------------------------------------------------
 
 _recorder = None
-_singleton_lock = threading.Lock()
+_singleton_lock = _threads.package_lock("flight_recorder._singleton_lock")
 
 
 def get_recorder():

@@ -48,6 +48,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from .. import threads as _threads
 import time
 
 import numpy as np
@@ -62,7 +64,7 @@ _ENV = "MXNET_TPU_MEMPROF"
 # far past any healthy process (the executor cache LRU caps at 128)
 MAX_RECORDS = 512
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("memprof._lock")
 _records = []          # program records, build order, bounded
 _tls = threading.local()
 _listener_installed = False
@@ -369,7 +371,7 @@ class ProfiledJit:
         self._label = label
         self._static = tuple(static_argnums)
         self._compiled = {}
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("ProfiledJit._lock")
         self._fallback = False
 
     def _arg_key(self, args):

@@ -57,6 +57,8 @@ import itertools
 import json
 import os
 import threading
+
+from .. import threads as _threads
 import time
 import uuid
 from collections import deque
@@ -85,7 +87,7 @@ MAX_SEGMENTS = 512
 SEGMENT_ORDER = ("queue", "route", "lane", "assemble", "dispatch",
                  "split", "reject", "decode_step")
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("reqtrace._lock")
 _seq = itertools.count()
 _sampled = None       # deque of records (created lazily; env-sized)
 _sampled_bytes = 0

@@ -27,6 +27,8 @@ import math
 import os
 import threading
 
+from .. import threads as _threads
+
 _ENV = "MXNET_TPU_TELEMETRY"
 
 # log2 bucket bounds for histograms: 2**k for k in [_K_MIN, _K_MAX],
@@ -38,7 +40,7 @@ _K_MIN = -10
 _K_MAX = 20
 BUCKET_BOUNDS = tuple(2.0 ** k for k in range(_K_MIN, _K_MAX + 1))
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("telemetry._lock")
 _metrics = {}  # name -> instrument
 _epoch = 0     # bumped by reset(); invalidates cached instrument handles
 
@@ -59,7 +61,7 @@ class Counter:
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("Counter._lock")
         self._value = 0.0
 
     def inc(self, amount=1):
@@ -121,7 +123,7 @@ class Histogram:
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("Histogram._lock")
         self.buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # +1 overflow
         self.sum = 0.0
         self.count = 0

@@ -25,9 +25,11 @@ import itertools
 import logging
 import os
 import threading
+
+from .. import threads as _threads
 import time
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("tracing._lock")
 _events = []
 _recording = False
 _span_ids = itertools.count(1)

@@ -50,6 +50,8 @@ import os
 import pickle
 import struct
 import threading
+
+from . import threads as _threads
 import time
 
 import numpy as np
@@ -70,7 +72,7 @@ ENV_MAX_MB = "MXNET_TPU_PROGRAM_CACHE_MAX_MB"
 MAGIC = b"MXTPC1\n"
 SUFFIX = ".mxprog"
 
-_lock = threading.Lock()
+_lock = _threads.package_lock("program_cache._lock")
 _stats = {"hits": 0, "misses": 0, "evictions": 0, "writes": 0,
           "bytes_written": 0, "bytes_read": 0, "pruned": 0,
           "pruned_bytes": 0}
@@ -723,7 +725,7 @@ class DiskCachedJit:
         self._platform = platform
         self._static = tuple(static_argnums)
         self._compiled = {}
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("DiskCachedJit._lock")
         self._fallback = False
 
     def _mem_key(self, args):

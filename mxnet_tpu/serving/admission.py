@@ -28,6 +28,7 @@ import os
 import threading
 import time
 
+from .. import threads as _threads
 from .errors import DeadlineExceeded, Overloaded, ServerClosed
 
 ENV_QUEUE_DEPTH = "MXNET_TPU_SERVING_QUEUE_DEPTH"
@@ -92,7 +93,7 @@ class AdmissionController:
         self.queue_depth = (default_queue_depth() if queue_depth is None
                             else int(queue_depth))
         self._queue = []  # FIFO; list because assembly removes mid-queue
-        self._cond = threading.Condition()
+        self._cond = _threads.package_condition("AdmissionController._cond")
         self._closed = False
 
     def pending(self):

@@ -31,6 +31,8 @@ from concurrent.futures import InvalidStateError
 
 import numpy as np
 
+from .. import threads as _threads
+from ..analysis import locksan as _locksan
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
 from ..observability import memprof as _memprof
@@ -142,6 +144,9 @@ def run_group(model, batch, rows, replica=None):
             if replica is not None else None
         with tracing.span("serving:dispatch", category="serving",
                           pid="serving", args=dispatch_args):
+            # locksan (MXNET_TPU_LOCKSAN=1): a package lock held here
+            # would serialize device work behind host bookkeeping
+            _locksan.check_dispatch_clear("serving.run_group")
             outs = model.run_batch(bucket, padded)
         t1 = time.monotonic()
         ms = (t1 - t0) * 1e3
@@ -231,10 +236,8 @@ class DynamicBatcher:
     def start(self):
         if self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._loop,
-                                        name="mxnet_tpu-serving-batcher",
-                                        daemon=True)
-        self._thread.start()
+        self._thread = _threads.spawn(self._loop, "serving",
+                                      "batcher")
 
     @property
     def started(self):

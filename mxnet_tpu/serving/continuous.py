@@ -59,6 +59,8 @@ import time
 import numpy as np
 
 from .. import ndarray as _ndops
+from .. import threads as _threads
+from ..analysis import locksan as _locksan
 from ..base import MXNetError
 from ..context import cpu
 from ..ndarray import NDArray, array as nd_array
@@ -93,7 +95,7 @@ class DecodeStream:
         self.pos = 0                # next frame to feed
         self._collected = []        # per-step list of per-output rows
         self._done = False
-        self._cond = threading.Condition()
+        self._cond = _threads.package_condition("DecodeStream._cond")
         self.error = None
         # observability/reqtrace.py context (None when tracing is off):
         # continuous-decode streams get per-iteration segments
@@ -199,7 +201,7 @@ class ContinuousBatcher:
                                if i not in state_outs]
         self.collect_outputs = [int(i) for i in collect_outputs]
         # per-slot scheduling state (host side, _lock-guarded)
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("ContinuousBatcher._lock")
         self._slots = [None] * self.slot_count  # DecodeStream or None
         self._waiting = []                      # FIFO of DecodeStream
         # carried device state: state input name -> NDArray of the
@@ -337,6 +339,7 @@ class ContinuousBatcher:
         with tracing.span("serving:decode_step", category="serving",
                           pid="serving",
                           args={"active": len(active), "joins": joins}):
+            _locksan.check_dispatch_clear("continuous.step")
             outs = self._exe.forward(is_train=False, **feeds)
             for name, idx in self.state_pairs:
                 self._carry[name] = outs[idx]

@@ -53,6 +53,7 @@ from __future__ import annotations
 import threading
 import weakref
 
+from .. import threads as _threads
 from ..observability import telemetry, tracing
 
 
@@ -229,7 +230,7 @@ def record_request_done(request, t_done):
 # taken on one thread from discarding a registration racing in on
 # another (the rebuild in _total_queued would lose the append).
 _queue_sources = []
-_queue_sources_lock = threading.Lock()
+_queue_sources_lock = _threads.package_lock("_queue_sources_lock")
 
 
 def _total_queued():

@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 from .. import executor_cache
+from .. import threads as _threads
 from ..observability import memprof as _memprof
 from ..predict import Predictor
 from .errors import ModelNotFound, RequestTooLarge
@@ -110,11 +111,11 @@ class ServedModel:
         # bucket would retrace in the dispatch thread
         self._pending_buckets = None
         self._by_bucket = {self.buckets[0]: self._base}
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("ServedModel._lock")
         # serializes run_batch: predictors are forward()+get_output()
         # pairs, not atomic — warmup from the caller thread must not
         # interleave with the dispatch thread on the same bucket
-        self._run_lock = threading.Lock()
+        self._run_lock = _threads.package_lock("ServedModel._run_lock")
 
     def _bind_shapes(self, bucket):
         return {k: (bucket,) + v for k, v in self.input_shapes.items()}
@@ -177,6 +178,11 @@ class ServedModel:
         p = self.predictor_for(bucket)
         with self._run_lock:
             p.forward(**inputs)
+            # holding _run_lock across the device sync is the point:
+            # predictors are forward()+get_output() pairs, not atomic,
+            # so warmup from the caller thread must not interleave with
+            # the dispatch thread on the same bucket (see __init__)
+            # graftlint: disable=GL008
             return [p.get_output(i).asnumpy()
                     for i in range(len(self.output_names))]
 
@@ -270,7 +276,7 @@ class ModelRegistry:
 
     def __init__(self):
         self._models = {}
-        self._lock = threading.Lock()
+        self._lock = _threads.package_lock("ModelRegistry._lock")
 
     def register(self, name, symbol, arg_params, aux_params, input_shapes,
                  max_batch_size=8, ctx=None, quantize=None,

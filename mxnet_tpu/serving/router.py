@@ -65,6 +65,7 @@ import threading
 import time
 from collections import deque
 
+from .. import threads as _threads
 from ..base import MXNetError
 from ..log import module_logger as _module_logger
 from ..observability import flight_recorder as _flight
@@ -101,7 +102,7 @@ class Replica:
         self.registry = ModelRegistry()
         # (model_name, batch, rows, est_ms) work items, router-ordered
         self._lane = deque()
-        self._cond = threading.Condition()
+        self._cond = _threads.package_condition("Replica._cond")
         self._thread = None
         self._closed = False
         # accounting the router's least-loaded pick reads: rows and
@@ -128,10 +129,8 @@ class Replica:
     def start(self):
         if self._thread is not None:
             return
-        self._thread = threading.Thread(
-            target=self._worker,
-            name="mxnet_tpu-serving-replica-%d" % self.index, daemon=True)
-        self._thread.start()
+        self._thread = _threads.spawn(
+            self._worker, "serving", "replica-%d" % self.index)
 
     @property
     def alive(self):

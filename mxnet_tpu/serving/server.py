@@ -42,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from .. import threads as _threads
 from ..base import MXNetError
 from ..log import module_logger as _module_logger
 from ..observability import memprof as _memprof
@@ -103,7 +104,7 @@ class Server:
         self.batcher.cadence = _TunerCadence(self)
         metrics.register_queue_gauge(self.admission)
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = _threads.package_lock("Server._close_lock")
         self._httpd = None
         self._http_thread = None
         if auto_start:
@@ -396,9 +397,8 @@ class Server:
                 prev(signum, None)
 
         def _handler(signum, frame):
-            threading.Thread(target=_drain, args=(signum,),
-                             name="mxnet_tpu-serving-drain",
-                             daemon=True).start()
+            _threads.spawn(_drain, "serving", "drain",
+                           args=(signum,))
 
         installed = []
         for sig in signals:
@@ -527,10 +527,8 @@ class Server:
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="mxnet_tpu-serving-http", daemon=True)
-        self._http_thread.start()
+        self._http_thread = _threads.spawn(
+            self._httpd.serve_forever, "serving", "http")
 
     @property
     def http_address(self):

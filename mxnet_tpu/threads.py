@@ -1,0 +1,99 @@
+"""Shared thread + lock factories for every threaded subsystem.
+
+Two jobs, one module:
+
+* **Structured thread names.**  Every package-spawned thread is created
+  through :func:`spawn` and named ``mxnet_tpu/<subsystem>/<role>`` — so a
+  ``py-spy dump`` of a wedged fleet reads as a org chart instead of
+  ``Thread-7``, and the test suite's leak fixture can assert that closing
+  a Server/pipeline leaves zero package threads behind just by scanning
+  :func:`threading.enumerate` for the prefix.
+
+* **The locksan injection point.**  :func:`package_lock` /
+  :func:`package_rlock` / :func:`package_condition` are drop-in
+  replacements for the ``threading`` constructors.  With
+  ``MXNET_TPU_LOCKSAN=1`` in the environment *at creation time* they
+  return `analysis.locksan` proxies that record per-thread acquisition
+  stacks and detect lock-order inversions at runtime; otherwise they
+  return the plain ``threading`` primitive — bitwise-identical behaviour,
+  no wrapper object, no per-acquire overhead.  The env var is read per
+  call (not cached at import) so tests can flip it on and construct a
+  fresh subsystem without re-importing the package; objects created while
+  it was off keep their plain locks.
+
+Import discipline: this module sits at the package root below everything
+threaded (serving, io_pipeline, observability, elastic all import it), so
+it must import nothing from the package at module scope — the locksan
+import is deferred into the factory bodies.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+THREAD_PREFIX = "mxnet_tpu/"
+
+
+def locksan_enabled():
+    """True when the runtime lock sanitizer is requested (checked at
+    lock-creation time, not cached)."""
+    return os.environ.get("MXNET_TPU_LOCKSAN") == "1"
+
+
+def thread_name(subsystem, role):
+    """The structured name ``mxnet_tpu/<subsystem>/<role>``."""
+    return "%s%s/%s" % (THREAD_PREFIX, subsystem, role)
+
+
+def spawn(target, subsystem, role, args=(), kwargs=None, daemon=True,
+          start=True):
+    """Create (and by default start) a package thread with a structured
+    name.  ``daemon`` defaults to True: package threads are service
+    threads whose owners register an explicit join/close path; a
+    non-daemon spawn without one is exactly what graftlint GL010 flags.
+    """
+    # the factory itself cannot know its caller's join path; daemon
+    # defaults True and GL010 audits the call sites, not this line
+    # graftlint: disable=GL010
+    t = threading.Thread(target=target, args=args, kwargs=kwargs or {},
+                         name=thread_name(subsystem, role), daemon=daemon)
+    if start:
+        t.start()
+    return t
+
+
+def live_package_threads():
+    """Alive threads spawned through :func:`spawn` (by name prefix) —
+    what the test suite's leak fixture asserts is empty after close."""
+    return [t for t in threading.enumerate()
+            if t.name.startswith(THREAD_PREFIX) and t.is_alive()]
+
+
+def package_lock(name):
+    """A ``threading.Lock``, locksan-proxied when MXNET_TPU_LOCKSAN=1.
+
+    ``name`` identifies the lock in the runtime order graph — use the
+    static catalog's spelling (``Class.attr`` or ``module.attr``) so
+    runtime inversions line up with graftlint GL007 lock ids.
+    """
+    if locksan_enabled():
+        from .analysis import locksan
+        return locksan.LockProxy(threading.Lock(), name)
+    return threading.Lock()
+
+
+def package_rlock(name):
+    """A ``threading.RLock``; reentrant re-acquisition is tracked but
+    adds no order edges."""
+    if locksan_enabled():
+        from .analysis import locksan
+        return locksan.LockProxy(threading.RLock(), name, reentrant=True)
+    return threading.RLock()
+
+
+def package_condition(name, lock=None):
+    """A ``threading.Condition`` whose underlying lock is package-created
+    (an RLock proxy by default, matching ``threading.Condition()``)."""
+    if lock is None:
+        lock = package_rlock(name)
+    return threading.Condition(lock)

@@ -35,6 +35,7 @@ _FAST_MODULES = {
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
     "test_serving", "test_pallas_kernels", "test_comm_overlap",
     "test_program_cache", "test_autotune", "test_reqtrace",
+    "test_concurrency",
 }
 
 
@@ -94,3 +95,39 @@ def pytest_collection_modifyitems(config, items):
                 and item.originalname not in _SLOW_WITHIN_FAST \
                 and item.name not in _SLOW_WITHIN_FAST:
             item.add_marker(pytest.mark.fast)
+
+
+# -- thread hygiene ---------------------------------------------------------
+# Every package thread is spawned through mxnet_tpu.threads.spawn with a
+# structured `mxnet_tpu/<subsystem>/<role>` name, so "did close() really
+# stop everything?" is one enumerate() away.  The threaded-subsystem
+# modules must leave zero package threads behind after each test — a
+# leaked dispatch/feeder thread in one test is a use-after-close crash
+# (or a deadlock) in a later one.
+_LEAK_CHECK_MODULES = {
+    "test_serving", "test_serving_fleet", "test_io_pipeline",
+    "test_concurrency",
+}
+
+
+@pytest.fixture(autouse=True)
+def _no_package_thread_leaks(request):
+    yield
+    if request.module.__name__ not in _LEAK_CHECK_MODULES:
+        return
+    import time
+
+    from mxnet_tpu import threads as _threads
+
+    # closed subsystems join their threads, but a worker parked on a
+    # poll interval (0.05 s) may need a beat to observe the stop flag
+    deadline = time.monotonic() + 5.0
+    while _threads.live_package_threads() \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked = _threads.live_package_threads()
+    assert not leaked, (
+        "package threads leaked past the test: %s — close()/stop() the "
+        "owning subsystem (threads spawned via mxnet_tpu.threads.spawn "
+        "must be joined by their owner's shutdown path)"
+        % sorted(t.name for t in leaked))

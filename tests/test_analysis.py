@@ -35,7 +35,7 @@ def _rules_of(findings):
 
 def test_rule_registry_complete():
     assert set(RULES) == {"GL001", "GL002", "GL003", "GL004", "GL005",
-                          "GL006"}
+                          "GL006", "GL007", "GL008", "GL009", "GL010"}
 
 
 def test_gl001_host_sync_fires_in_hot_path():

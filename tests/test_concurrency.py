@@ -1,0 +1,573 @@
+"""graftsan — concurrency static analysis (GL007-GL010) + locksan runtime.
+
+Static half: each rule catches its seeded defect AND stays silent on the
+package's sanctioned patterns (consistent lock order, Condition.wait on
+the held lock, flag-setting or thread-handoff signal handlers, daemon or
+joined threads).  Runtime half: under MXNET_TPU_LOCKSAN=1 the
+`mxnet_tpu.threads` factories hand out tracking proxies that catch a
+staged ABBA inversion and held-across-dispatch live, produce zero false
+positives on a clean serving run, and the `=0` kill switch installs no
+proxy at all (plain threading primitives, bitwise-identical outputs).
+"""
+import json
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, threads
+from mxnet_tpu.analysis import (analyze_paths, analyze_source,
+                                load_baseline, new_findings)
+from mxnet_tpu.analysis import locksan
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+rng = np.random.RandomState(7)
+
+FEAT = 6
+
+
+def _an(src, rules=None, path="seed.py"):
+    return analyze_source(textwrap.dedent(src), path, rules=rules)
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# GL007: lock-order cycles
+# ---------------------------------------------------------------------------
+
+ABBA = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+        def two(self):
+            with self.b:
+                with self.a:
+                    pass
+"""
+
+
+def test_gl007_fires_on_seeded_abba():
+    findings = _an(ABBA, rules=["GL007"])
+    assert _ids(findings) == ["GL007", "GL007"]  # both edges of the cycle
+    assert "cycle" in findings[0].message
+
+
+def test_gl007_silent_on_consistent_order():
+    findings = _an("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+            def two(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """, rules=["GL007"])
+    assert findings == [], [repr(f) for f in findings]
+
+
+def test_gl007_interprocedural_cycle():
+    """Holding A while *calling* a function that takes B still orders
+    A before B — the cycle closes through the call graph."""
+    findings = _an("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+            def take_b(self):
+                with self.b:
+                    pass
+            def one(self):
+                with self.a:
+                    self.take_b()
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """, rules=["GL007"])
+    assert "GL007" in _ids(findings)
+
+
+def test_gl007_reentrant_same_lock_silent():
+    findings = _an("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.RLock()
+            def one(self):
+                with self.a:
+                    with self.a:
+                        pass
+    """, rules=["GL007"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL008: lock held across blocking calls
+# ---------------------------------------------------------------------------
+
+def test_gl008_fires_on_held_across_blocking():
+    findings = _an("""
+        import threading, time
+
+        class W:
+            def __init__(self):
+                self.lock = threading.Lock()
+            def bad(self, fut, q, t):
+                with self.lock:
+                    fut.result()
+                    q.get()
+                    time.sleep(1)
+                    t.join(5)
+    """, rules=["GL008"])
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "Future.result()" in msgs and "queue get()" in msgs
+    assert "time.sleep()" in msgs and ".join()" in msgs
+
+
+def test_gl008_string_join_and_dict_get_silent():
+    findings = _an("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self.lock = threading.Lock()
+            def fine(self, d):
+                with self.lock:
+                    x = ",".join(["a", "b"])
+                    sep = "-"
+                    y = sep.join([x])
+                    return d.get("key", None)
+    """, rules=["GL008"])
+    assert findings == [], [repr(f) for f in findings]
+
+
+def test_gl008_condition_wait_on_held_lock_exempt():
+    """cond.wait() RELEASES the held cond — the package's standard
+    pattern (ReorderBuffer, AdmissionController, Replica) stays clean,
+    but waiting while holding a DIFFERENT lock is flagged."""
+    findings = _an("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.cond = threading.Condition()
+            def ok(self):
+                with self.cond:
+                    self.cond.wait(1)
+            def bad(self):
+                with self.lock:
+                    with self.cond:
+                        self.cond.wait(1)
+    """, rules=["GL008"])
+    assert len(findings) == 1
+    assert "W.lock" in findings[0].message
+
+
+def test_gl008_depth1_through_call():
+    findings = _an("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self.lock = threading.Lock()
+            def slow(self, fut):
+                return fut.result()
+            def bad(self, fut):
+                with self.lock:
+                    return self.slow(fut)
+    """, rules=["GL008"])
+    assert len(findings) == 1
+    assert "blocks on Future.result()" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# GL009: signal-handler safety
+# ---------------------------------------------------------------------------
+
+def test_gl009_fires_on_lock_logging_flight_in_handler_reach():
+    findings = _an("""
+        import logging, signal, threading
+        from mxnet_tpu.observability import flight_recorder as _flight
+        log = logging.getLogger(__name__)
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def install(self):
+                def _handler(signum, frame):
+                    self._work()
+                signal.signal(signal.SIGTERM, _handler)
+            def _work(self):
+                with self._lock:
+                    log.warning("preempted")
+                _flight.note_elastic({"kind": "x"})
+    """, rules=["GL009"])
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "acquires lock" in msgs
+    assert "calls logging" in msgs
+    assert "flight recorder" in msgs
+
+
+def test_gl009_flag_setting_handler_silent():
+    """The elastic Checkpointer pattern: the handler only sets attrs."""
+    findings = _an("""
+        import signal
+
+        class Clean:
+            def install(self):
+                def _handler(signum, frame):
+                    self._flag = True
+                    self._signum = signum
+                signal.signal(signal.SIGTERM, _handler)
+    """, rules=["GL009"])
+    assert findings == [], [repr(f) for f in findings]
+
+
+def test_gl009_thread_handoff_silent():
+    """The serving drain pattern: the handler spawns a thread; the
+    thread body may lock and log freely — it runs on its own stack."""
+    findings = _an("""
+        import logging, signal, threading
+        log = logging.getLogger(__name__)
+
+        class Spawner:
+            def install(self):
+                def _drain(signum):
+                    log.warning("draining after signal %s", signum)
+                def _handler(signum, frame):
+                    threading.Thread(target=_drain, args=(signum,),
+                                     daemon=True).start()
+                signal.signal(signal.SIGTERM, _handler)
+    """, rules=["GL009"])
+    assert findings == [], [repr(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# GL010: thread lifecycle
+# ---------------------------------------------------------------------------
+
+def test_gl010_fires_on_unjoined_nondaemon():
+    findings = _an("""
+        import threading
+
+        class T:
+            def start_bad(self):
+                threading.Thread(target=self.run).start()
+    """, rules=["GL010"])
+    assert _ids(findings) == ["GL010"]
+
+
+def test_gl010_daemon_joined_and_loop_joined_silent():
+    findings = _an("""
+        import threading
+
+        class T:
+            def start_daemon(self):
+                threading.Thread(target=self.run, daemon=True).start()
+            def start_joined(self):
+                self._t = threading.Thread(target=self.run)
+                self._t.start()
+            def close(self):
+                self._t.join(5)
+            def pool(self):
+                ts = [threading.Thread(target=self.run)
+                      for _ in range(3)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+    """, rules=["GL010"])
+    assert findings == [], [repr(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# machinery: suppression, rule filter, package self-check, CLI
+# ---------------------------------------------------------------------------
+
+def test_suppression_comment_silences_concurrency_finding():
+    findings = _an("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self.lock = threading.Lock()
+            def bad(self, fut):
+                with self.lock:
+                    # the future completes within one dispatch: bounded
+                    # graftlint: disable=GL008
+                    fut.result()
+    """, rules=["GL008"])
+    assert findings == []
+
+
+def test_rules_filter_scopes_the_pass():
+    findings = _an(ABBA, rules=["GL010"])
+    assert findings == []
+
+
+def test_package_self_analysis_no_new_findings():
+    """The package itself is concurrency-clean modulo the committed
+    baseline — new lock-order/blocking/signal/thread hazards fail CI."""
+    findings = analyze_paths([os.path.join(ROOT, "mxnet_tpu")], root=ROOT)
+    baseline = load_baseline(
+        os.path.join(ROOT, ".graftlint-baseline.json"))
+    fresh = new_findings(findings, baseline)
+    assert fresh == [], (
+        "new concurrency findings (fix, suppress with justification, or "
+        "re-baseline via `python tools/graftcheck.py --update-baseline "
+        "mxnet_tpu`):\n%s" % "\n".join(repr(f) for f in fresh))
+
+
+def test_graftcheck_cli_concurrency(tmp_path, capsys, monkeypatch):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graftcheck", os.path.join(ROOT, "tools", "graftcheck.py"))
+    gc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gc)
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """))
+    monkeypatch.chdir(tmp_path)
+
+    # without --concurrency the per-file pass sees nothing
+    assert gc.main([str(bad), "--json"]) == 0
+    capsys.readouterr()
+
+    assert gc.main([str(bad), "--concurrency", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["findings"]} == {"GL007"}
+
+    # --update-baseline includes the concurrency pass; rerun is clean
+    assert gc.main([str(bad), "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert gc.main([str(bad), "--concurrency",
+                    "--baseline", ".graftlint-baseline.json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# threads helper
+# ---------------------------------------------------------------------------
+
+def test_spawn_structured_names_and_registry():
+    import time
+    done = threading.Event()
+
+    t = threads.spawn(done.wait, "testsub", "probe")
+    try:
+        assert t.name == "mxnet_tpu/testsub/probe"
+        assert t.daemon
+        assert t in threads.live_package_threads()
+    finally:
+        done.set()
+        t.join(5)
+    deadline = time.monotonic() + 5
+    while threads.live_package_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threads.live_package_threads() == []
+
+
+def test_kill_switch_installs_no_proxy(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_LOCKSAN", raising=False)
+    lock = threads.package_lock("plain")
+    assert type(lock) is type(threading.Lock())
+    monkeypatch.setenv("MXNET_TPU_LOCKSAN", "0")
+    lock = threads.package_lock("plain")
+    assert type(lock) is type(threading.Lock())
+    cond = threads.package_condition("plain-cond")
+    assert not isinstance(cond._lock, locksan.LockProxy)
+
+
+# ---------------------------------------------------------------------------
+# locksan runtime
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _locksan_on(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LOCKSAN", "1")
+    monkeypatch.delenv("MXNET_TPU_LOCKSAN_RULES", raising=False)
+    locksan.reset()
+    yield
+    locksan.reset()
+
+
+def test_locksan_detects_staged_abba(_locksan_on):
+    a = threads.package_lock("test.A")
+    b = threads.package_lock("test.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    threads.spawn(order_ab, "testsub", "ab").join(5)
+    threads.spawn(order_ba, "testsub", "ba").join(5)
+    v = locksan.violations()
+    assert len(v) == 1
+    assert v[0]["rule"] == "GL007"
+    assert v[0]["kind"] == "lock-order-inversion"
+    assert set(v[0]["locks"]) == {"test.A", "test.B"}
+    # per-thread acquisition stacks are recorded at the violation
+    assert any("order_ba" in fr for fr in v[0]["this_thread"]["stack"])
+
+
+def test_locksan_clean_ordering_no_false_positive(_locksan_on):
+    a = threads.package_lock("clean.A")
+    b = threads.package_lock("clean.B")
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    ts = [threads.spawn(worker, "testsub", "w%d" % i) for i in range(4)]
+    for t in ts:
+        t.join(10)
+    assert locksan.violations() == []
+
+
+def test_locksan_condition_wait_notify_under_proxy(_locksan_on):
+    cond = threads.package_condition("test.cond")
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(5)
+
+    t = threads.spawn(waiter, "testsub", "waiter")
+    with cond:
+        ready.append(1)
+        cond.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    assert locksan.violations() == []
+
+
+def test_locksan_raise_escalation(_locksan_on, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LOCKSAN_RULES", "GL007")
+    a = threads.package_lock("esc.A")
+    b = threads.package_lock("esc.B")
+    with a:
+        with b:
+            pass
+    caught = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except locksan.LockSanError as e:
+            caught.append(e)
+
+    threads.spawn(inverted, "testsub", "inv").join(5)
+    assert len(caught) == 1
+    # the proxy released the just-acquired lock before raising
+    assert not a.locked() and not b.locked()
+
+
+def test_locksan_dispatch_clear_hook(_locksan_on):
+    lock = threads.package_lock("disp.lock")
+    locksan.check_dispatch_clear("test.site")  # nothing held: clean
+    assert locksan.violations() == []
+    with lock:
+        locksan.check_dispatch_clear("test.site")
+    v = locksan.violations()
+    assert len(v) == 1
+    assert v[0]["rule"] == "GL008"
+    assert v[0]["kind"] == "held-across-dispatch"
+    assert v[0]["locks"] == ["disp.lock"]
+
+
+def _mlp_parts(nh=8, classes=3):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=nh,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, FEAT))
+    args = {n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    return sym, args
+
+
+def _serve_once(sym, args, x):
+    server = serving.Server(max_batch_size=4, batch_window_ms=1.0)
+    server.add_model("mlp", sym, args, input_shapes={"data": (FEAT,)})
+    try:
+        server.warmup()
+        outs = [server.submit("mlp", {"data": x[i:i + 1]}, timeout=30)
+                for i in range(len(x))]
+        return np.concatenate([o[0] for o in outs], axis=0)
+    finally:
+        server.close()
+
+
+def test_locksan_clean_serving_run_and_bitwise_kill_switch(monkeypatch):
+    """A real serving run under LOCKSAN=1: zero violations (the fleet's
+    lock discipline is sanitizer-clean), and the =0 kill switch path
+    produces bitwise-identical outputs with plain locks."""
+    sym, args = _mlp_parts()
+    x = rng.normal(0, 1, (6, FEAT)).astype(np.float32)
+
+    monkeypatch.setenv("MXNET_TPU_LOCKSAN", "1")
+    locksan.reset()
+    try:
+        sanitized = _serve_once(sym, args, x)
+        assert locksan.violations() == [], locksan.violations()
+    finally:
+        locksan.reset()
+
+    monkeypatch.setenv("MXNET_TPU_LOCKSAN", "0")
+    plain = _serve_once(sym, args, x)
+    assert plain.dtype == sanitized.dtype
+    assert np.array_equal(plain, sanitized)

@@ -23,7 +23,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mxnet_tpu.analysis import (RULES, lint_paths, load_baseline,
-                                save_baseline, new_findings, verify_json)
+                                save_baseline, new_findings, verify_json,
+                                analyze_paths)
 
 
 def parse_shape_args(pairs):
@@ -46,6 +47,11 @@ def main(argv=None):
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite --baseline (default "
                     ".graftlint-baseline.json) from the current findings")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="also run the package-wide concurrency pass "
+                    "(GL007-GL010: lock-order cycles, locks held across "
+                    "blocking calls, signal-handler safety, thread "
+                    "lifecycle); findings share the lint baseline")
     ap.add_argument("--rules", help="comma-separated rule ids to run "
                     "(default: all)")
     ap.add_argument("--list-rules", action="store_true")
@@ -77,6 +83,12 @@ def main(argv=None):
 
     findings = lint_paths(args.paths, root=os.getcwd(), rules=rules) \
         if args.paths else []
+    # --update-baseline always includes the concurrency pass: the
+    # baseline file is shared, and rewriting it from a lint-only run
+    # would silently drop every baselined GL007-GL010 key
+    if args.paths and (args.concurrency or args.update_baseline):
+        findings.extend(analyze_paths(args.paths, root=os.getcwd(),
+                                      rules=rules))
 
     if args.update_baseline:
         if args.rules:
