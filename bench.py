@@ -1150,6 +1150,177 @@ def slo_smoke():
     }))
 
 
+def decode_smoke():
+    """Paged-KV continuous-decode CI mode (`make bench-smoke`,
+    `bench.py --decode-smoke`): open-loop autoregressive traffic
+    against the paged-KV transformer decoder (serving/decode.py over
+    serving/kv_cache.py) proving the decode contracts:
+
+    1. **zero steady-state retraces** — `warmup()` pre-traces the one
+       fixed-shape decode-step program plus the COW clone; the churn
+       afterwards (streams joining/leaving mid-flight, prefill mixed
+       with decode, page allocation/recycling, copy-on-write) must
+       leave the executor-cache retrace counters FLAT;
+    2. **batching is invisible** — every served stream's (token ids,
+       logits) is bitwise-equal to decoding it ALONE on a fresh
+       decoder over the same weights;
+    3. **the prefix cache pays** — a shared-prompt phase (one popular
+       prompt head resubmitted with different continuations) must
+       reuse cached pages (hit ratio asserted) and COW-clone when a
+       fully cached prompt diverges;
+    4. the page pool is observable end to end: `memprof.report()`
+       carries the pool row, `traceview --serving` renders the
+       page-pool section from the telemetry dump;
+    5. a tokens/s + decode-MFU row rides alongside the LSTM row
+       (FLOPs estimated matmul-style at 2 * params per token).
+    """
+    import os
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor_cache, serving
+    from mxnet_tpu.gluon.model_zoo import transformer_lm
+    from mxnet_tpu.observability import memprof, telemetry
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    rng = np.random.RandomState(7)
+    telemetry.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    VOCAB, EMBED, HEADS, LAYERS, SEQ, SLOTS = 96, 64, 4, 2, 80, 4
+    lm = transformer_lm(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                        num_layers=LAYERS, seq_len=SEQ)
+    lm.initialize()
+    # one forward materializes the deferred Dense shapes
+    _ = lm(mx.nd.array(np.zeros((1, SEQ), np.float32)))
+    params = lm.decode_param_arrays()
+    n_params = sum(int(np.asarray(v).size) for v in params.values())
+
+    dec = serving.PagedTransformerDecoder(params, lm.config,
+                                          slot_count=SLOTS, name="bench")
+    report = dec.warmup()  # raises if the verify iteration retraces
+    assert report["traces"] >= 1, report
+
+    # 1) open-loop churn: staggered submits so streams join and leave
+    # mid-flight with prefill interleaved into steady decode
+    prompts = [rng.randint(0, VOCAB, size=int(rng.randint(3, 40)))
+               for _ in range(10)]
+    gen_lens = [int(rng.randint(4, 16)) for _ in prompts]
+    t0 = time.perf_counter()
+    with executor_cache.watch_traces() as watch:
+        streams = []
+        for p, g in zip(prompts, gen_lens):
+            streams.append(dec.submit(p, max_new_tokens=g))
+            dec.step()
+            dec.step()
+        dec.drain()
+    elapsed = time.perf_counter() - t0
+    assert watch.total() == 0, (
+        "decode retraces after warmup: %s" % watch.delta())
+    served = [s.wait(60).outputs() for s in streams]
+    generated = sum(len(toks) for toks, _ in served)
+    # every appended token (prefill + decode) runs one full step row
+    tokens_appended = sum(len(p) + len(toks)
+                          for p, (toks, _) in zip(prompts, served))
+
+    # 2) bitwise oracle: each stream alone on a fresh-pool decoder
+    solo = serving.PagedTransformerDecoder(params, lm.config,
+                                           slot_count=SLOTS, name="solo")
+    solo.warmup()
+    for p, g, (toks, logits) in zip(prompts, gen_lens, served):
+        ref = solo.submit(p, max_new_tokens=g)
+        solo.drain()
+        ref_toks, ref_logits = ref.outputs()
+        assert ref_toks == toks, "served tokens != solo decode"
+        assert np.array_equal(ref_logits, logits), (
+            "served logits not bitwise-equal to solo decode")
+
+    # 3) shared-prompt phase: one popular 2-page head, resubmitted with
+    # continuations of 0 (fully cached -> COW on divergence), 3 and 9
+    # extra tokens
+    def _count(name):
+        snap = telemetry.snapshot().get(name)
+        return snap["value"] if snap else 0
+
+    shared = rng.randint(0, VOCAB, size=2 * dec.page_size)
+    lookups0 = _count("serving.decode.prefix_lookups")
+    hits0 = _count("serving.decode.prefix_hits")
+    cow0 = dec.pool.stats()["cow_clones"]
+    with executor_cache.watch_traces() as watch2:
+        seed_stream = dec.submit(shared, max_new_tokens=6)
+        dec.drain()  # fills + registers the shared head's pages
+        tails = [rng.randint(0, VOCAB, size=k) for k in (0, 3, 9)]
+        phase = [dec.submit(np.concatenate([shared, t]).astype(np.int64),
+                            max_new_tokens=6) for t in tails]
+        dec.drain()
+    assert watch2.total() == 0, (
+        "shared-prompt phase retraced: %s" % watch2.delta())
+    hits = _count("serving.decode.prefix_hits") - hits0
+    lookups = _count("serving.decode.prefix_lookups") - lookups0
+    hit_ratio = hits / float(lookups or 1)
+    assert hits >= 4 and hit_ratio >= 0.5, (
+        "prefix cache did not pay: %d hits / %d lookups"
+        % (hits, lookups))
+    cow_clones = dec.pool.stats()["cow_clones"] - cow0
+    assert cow_clones >= 1, "fully-cached prompt did not COW-clone"
+    # the prefix-reusing streams still match solo decode bitwise
+    for t, stream in zip(tails, phase):
+        ref = solo.submit(np.concatenate([shared, t]).astype(np.int64),
+                          max_new_tokens=6)
+        solo.drain()
+        ref_toks, ref_logits = ref.outputs()
+        toks, logits = stream.outputs()
+        assert ref_toks == toks and np.array_equal(ref_logits, logits), (
+            "prefix-cached stream not bitwise-equal to solo decode")
+    assert seed_stream.outputs()[0] == phase[0].outputs()[0]
+
+    # 4) the pool is observable: memprof row + traceview page-pool rows
+    pools = {p["name"]: p for p in memprof.report().get("pools", [])}
+    assert "bench.kv" in pools, pools
+    assert pools["bench.kv"]["pages_used"] >= 2, pools["bench.kv"]
+    telem_path = "/tmp/mxnet_tpu_decode_smoke_telemetry.json"
+    with open(telem_path, "w") as f:
+        f.write(telemetry.to_json_lines())
+    traceview = _load_traceview()
+    kind, payload = traceview.load_any(telem_path)
+    rendered = traceview.summarize_serving(kind, payload)
+    assert "continuous decode / page pool" in rendered, rendered[:400]
+    tstats = traceview.serving_from_telemetry(payload)
+    assert tstats["decode"] is not None
+    assert tstats["decode"]["kv_pages_total"] == dec.pool.num_pages
+    assert (tstats["decode"]["prefix_hits"] or 0) >= hits
+
+    dec.close()
+    solo.close()
+
+    # 5) the tokens/s + MFU row (CPU numbers are a correctness check of
+    # the bench itself, not a measurement)
+    kind_dev = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind_dev)
+    tok_s = tokens_appended / elapsed if elapsed else 0.0
+    flops_s = tok_s * 2.0 * n_params
+    print(json.dumps({
+        "metric": "bench_decode_smoke",
+        "decode_tokens_s": round(tok_s, 1),
+        "decode_generated_tokens": generated,
+        "decode_tokens_appended": tokens_appended,
+        "decode_tflops": round(flops_s / 1e12, 4),
+        "decode_mfu": (round(flops_s / 1e12 / peak, 4)
+                       if peak else None),
+        "model": {"vocab": VOCAB, "embed": EMBED, "heads": HEADS,
+                  "layers": LAYERS, "params": n_params},
+        "slot_count": SLOTS,
+        "page_size": dec.page_size,
+        "prefix_hit_ratio": round(hit_ratio, 3),
+        "cow_clones": cow_clones,
+        "steady_state_retraces": 0,
+        "bitwise_vs_solo": True,
+        "device_kind": kind_dev,
+        "telemetry": telem_path,
+    }))
+
+
 def reqtrace_fleet_worker():
     """Subprocess half of ``--reqtrace-smoke``'s fleet-merge proof: a
     SECOND serving process that inherits the parent's env-propagated
@@ -2901,6 +3072,8 @@ if __name__ == "__main__":
         serve_smoke()
     elif "--slo-smoke" in sys.argv:
         slo_smoke()
+    elif "--decode-smoke" in sys.argv:
+        decode_smoke()
     elif "--reqtrace-smoke" in sys.argv:
         reqtrace_smoke()
     elif "--reqtrace-worker" in sys.argv:

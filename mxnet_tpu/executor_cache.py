@@ -313,8 +313,11 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False,
                  static_argnums=(3,))
 
     # the sentinel layout is derived from the program's static structure
-    # (output count, grad-name order), never from traced values
-    health_layout = _health.HealthLayout(len(prog.entries), grad_names) \
+    # (output count, grad-name order, attention-node names), never from
+    # traced values
+    health_layout = _health.HealthLayout(
+        len(prog.entries), grad_names,
+        tap_names=_health.attention_tap_names(prog.order)) \
         if health else None
 
     def _fwd_bwd_impl(arg_vals, aux_vals, keys, head_grads):
@@ -329,7 +332,23 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False,
             return outs, [new_aux[n] for n in aux_names]
 
         gvals = [arg_map[n] for n in grad_names]
-        (outs, new_aux), vjp_fn = jax.vjp(f, gvals)
+        if health:
+            # attention ops note_tap their max|logit| bound while the
+            # forward traces; the frame collects them in topo order —
+            # the order the layout's tap slots were named in.  The taps
+            # ride out of the vjp as has_aux values (returning the
+            # frame's tracers directly would leak them out of the
+            # linearization trace)
+            def f_tapped(gvals):
+                with _health.collect_taps() as frame:
+                    result = f(gvals)
+                return result, list(frame)
+
+            (outs, new_aux), vjp_fn, taps = jax.vjp(
+                f_tapped, gvals, has_aux=True)
+        else:
+            taps = None
+            (outs, new_aux), vjp_fn = jax.vjp(f, gvals)
         heads = list(head_grads) if head_grads \
             else [jnp.ones_like(o) for o in outs]
         zeros_aux = [jnp.zeros_like(a) for a in new_aux]
@@ -339,7 +358,7 @@ def _build_entry(symbol, known_shapes, grad_names, platform, health=False,
             # values this program already holds; the fused dispatch
             # returns one small vector alongside its usual results
             hvec = _health.pack_summary(health_layout, outs, gvals,
-                                        list(grads))
+                                        list(grads), taps=taps)
             return outs, new_aux, grads, hvec
         return outs, new_aux, grads
 

@@ -1,3 +1,5 @@
 """Gluon model zoo (parity: python/mxnet/gluon/model_zoo/__init__.py)."""
 from . import model_store  # noqa: F401
 from . import vision  # noqa: F401
+from . import transformer  # noqa: F401
+from .transformer import TransformerBlock, TransformerLM, transformer_lm  # noqa: F401

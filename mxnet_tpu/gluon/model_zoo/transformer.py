@@ -1,0 +1,150 @@
+"""Decoder-only transformer blocks for the model zoo.
+
+Parity target: the reference's gluon transformer stack (gluon-nlp
+TransformerEncoderCell lineage), restructured around this framework's
+native ``multi_head_attention`` graph op so the whole attention block
+lowers through the Pallas flash-attention kernel when
+``MXNET_TPU_PALLAS_ATTN`` selects it (ops/pallas_kernels.py).
+
+Architecture: pre-LN residual blocks (LN -> MHA -> +x, LN -> FFN -> +x),
+learned absolute positions, GELU FFN, weight-untied output head — the
+standard small-LM shape, trainable through ``Module``'s fused step like
+any other hybridizable zoo model.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from ..nn import Dense, Embedding, LayerNorm
+
+
+class TransformerBlock(HybridBlock):
+    """One pre-LN decoder block: causal MHA + GELU FFN, both residual.
+
+    The attention projections are parameters of this block (not Dense
+    children) because the fused ``multi_head_attention`` op carries them
+    as direct inputs — one graph node per block attends, which is what
+    the kernel flag swaps wholesale.
+    """
+
+    def __init__(self, embed_dim, num_heads, ffn_dim=None, causal=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim %d not divisible by num_heads %d"
+                             % (embed_dim, num_heads))
+        self._embed_dim = embed_dim
+        self._num_heads = num_heads
+        self._ffn_dim = ffn_dim or 4 * embed_dim
+        self._causal = causal
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=embed_dim, prefix="ln1_")
+            self.ln2 = LayerNorm(in_channels=embed_dim, prefix="ln2_")
+            for side in ("query", "key", "value", "out"):
+                setattr(self, "%s_weight" % side, self.params.get(
+                    "%s_weight" % side, shape=(embed_dim, embed_dim),
+                    allow_deferred_init=True))
+                setattr(self, "%s_bias" % side, self.params.get(
+                    "%s_bias" % side, shape=(embed_dim,), init="zeros",
+                    allow_deferred_init=True))
+            self.ffn1 = Dense(self._ffn_dim, flatten=False, prefix="ffn1_")
+            self.ffn2 = Dense(embed_dim, flatten=False, prefix="ffn2_")
+
+    def hybrid_forward(self, F, x, query_weight, query_bias, key_weight,
+                       key_bias, value_weight, value_bias, out_weight,
+                       out_bias):
+        h = self.ln1(x)
+        attn = F.multi_head_attention(
+            h, h, h, query_weight, query_bias, key_weight, key_bias,
+            value_weight, value_bias, out_weight, out_bias,
+            num_heads=self._num_heads, causal=self._causal, name="attn")
+        x = x + attn
+        f = self.ffn2(F.LeakyReLU(self.ffn1(self.ln2(x)),
+                                  act_type="gelu", name="gelu"))
+        return x + f
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only LM: token embedding + learned positions, N pre-LN
+    blocks, final LayerNorm, untied vocab head.
+
+    ``seq_len`` is a constructor argument (the learned position table's
+    size) — symbols carry no shapes at build time, so the table cannot
+    be sized from the input; inputs must be exactly ``seq_len`` tokens
+    (shorter/longer is a bind-time shape error).
+    """
+
+    def __init__(self, vocab_size, embed_dim=128, num_heads=4,
+                 num_layers=2, seq_len=128, ffn_dim=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cfg = dict(vocab_size=vocab_size, embed_dim=embed_dim,
+                         num_heads=num_heads, num_layers=num_layers,
+                         seq_len=seq_len, ffn_dim=ffn_dim or 4 * embed_dim)
+        with self.name_scope():
+            self.embed = Embedding(vocab_size, embed_dim, prefix="embed_")
+            self.pos = self.params.get(
+                "pos", shape=(seq_len, embed_dim), init="zeros",
+                allow_deferred_init=True)
+            self.blocks = []
+            for i in range(num_layers):
+                blk = TransformerBlock(embed_dim, num_heads,
+                                       ffn_dim=self._cfg["ffn_dim"],
+                                       prefix="l%d_" % i)
+                setattr(self, "_block%d" % i, blk)  # registers the child
+                self.blocks.append(blk)
+            self.lnf = LayerNorm(in_channels=embed_dim, prefix="lnf_")
+            self.head = Dense(vocab_size, flatten=False, prefix="head_")
+
+    def hybrid_forward(self, F, tokens, pos):
+        # tokens: [batch, seq] int ids -> logits [batch, seq, vocab]
+        h = self.embed(tokens)
+        h = F.broadcast_add(h, F.expand_dims(pos, axis=0))
+        for blk in self.blocks:
+            h = blk(h)
+        return self.head(self.lnf(h))
+
+    @property
+    def config(self):
+        return dict(self._cfg)
+
+    def decode_param_arrays(self):
+        """Canonical numpy param dict for the paged-KV serving decoder
+        (serving/decode.py PagedTransformerDecoder): keys
+        ``embed``/``pos``, per-layer ``l{i}.{ln1_g,ln1_b,wq,bq,wk,bk,wv,
+        bv,wo,bo,ln2_g,ln2_b,w1,b1,w2,b2}``, and ``lnf_g/lnf_b/head_w/
+        head_b`` — decoupled from gluon name prefixes so a decoder can
+        also be fed from a Module's arg_dict."""
+        def arr(p):
+            return p.data().asnumpy().astype(np.float32)
+
+        out = {"embed": arr(self.embed.weight), "pos": arr(self.pos)}
+        for i, blk in enumerate(self.blocks):
+            pre = "l%d." % i
+            out[pre + "ln1_g"] = arr(blk.ln1.gamma)
+            out[pre + "ln1_b"] = arr(blk.ln1.beta)
+            out[pre + "wq"] = arr(blk.query_weight)
+            out[pre + "bq"] = arr(blk.query_bias)
+            out[pre + "wk"] = arr(blk.key_weight)
+            out[pre + "bk"] = arr(blk.key_bias)
+            out[pre + "wv"] = arr(blk.value_weight)
+            out[pre + "bv"] = arr(blk.value_bias)
+            out[pre + "wo"] = arr(blk.out_weight)
+            out[pre + "bo"] = arr(blk.out_bias)
+            out[pre + "ln2_g"] = arr(blk.ln2.gamma)
+            out[pre + "ln2_b"] = arr(blk.ln2.beta)
+            out[pre + "w1"] = arr(blk.ffn1.weight)
+            out[pre + "b1"] = arr(blk.ffn1.bias)
+            out[pre + "w2"] = arr(blk.ffn2.weight)
+            out[pre + "b2"] = arr(blk.ffn2.bias)
+        out["lnf_g"] = arr(self.lnf.gamma)
+        out["lnf_b"] = arr(self.lnf.beta)
+        out["head_w"] = arr(self.head.weight)
+        out["head_b"] = arr(self.head.bias)
+        return out
+
+
+def transformer_lm(vocab_size, **kwargs):
+    """Zoo-style constructor."""
+    return TransformerLM(vocab_size, **kwargs)

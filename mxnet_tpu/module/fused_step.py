@@ -252,8 +252,9 @@ class FusedTrainStep:
         # retraced once) whenever the mode changes.
         self._health_on = _health.enabled()
         self.health_layout = _health.HealthLayout(
-            len(prog.entries), self.param_names) if self._health_on \
-            else None
+            len(prog.entries), self.param_names,
+            tap_names=_health.attention_tap_names(prog.order)) \
+            if self._health_on else None
         self.last_health = None
 
         # memprof label: the fused step is THE training program — its
@@ -328,7 +329,21 @@ class FusedTrainStep:
                                                       True)
                     return outs, [new_aux[n] for n in aux_names]
 
-                (outs, new_aux), vjp_fn = jax.vjp(f, pvals)
+                if health_on:
+                    # attention-logit taps ride out of the vjp as
+                    # has_aux values (frame tracers must not leak out of
+                    # the linearization trace); topo order matches the
+                    # layout's tap slots
+                    def f_tapped(pv):
+                        with _health.collect_taps() as frame:
+                            result = f(pv)
+                        return result, list(frame)
+
+                    (outs, new_aux), vjp_fn, taps = jax.vjp(
+                        f_tapped, pvals, has_aux=True)
+                else:
+                    taps = None
+                    (outs, new_aux), vjp_fn = jax.vjp(f, pvals)
                 heads = [jnp.ones_like(o) for o in outs]
                 zeros_aux = [jnp.zeros_like(a) for a in new_aux]
                 (grads,) = vjp_fn((heads, zeros_aux))
@@ -373,6 +388,9 @@ class FusedTrainStep:
                                [P("dp")] * n_res),
                     **UNCHECKED)(other_vals, pvals, residuals)
                 new_aux = []
+                # taps are not collectible through shard_map (the body
+                # runs per shard); the slots hold -1
+                taps = None
 
             opt_keys = jax.random.split(opt_key, n_params) if needs_rng \
                 else [None] * n_params
@@ -403,7 +421,8 @@ class FusedTrainStep:
                     jnp.sqrt(par_sq), jnp.float32(1e-12))
                 hvec = _health.pack_summary(health_layout, outs, masters,
                                             list(grads),
-                                            update_ratio=ratio)
+                                            update_ratio=ratio,
+                                            taps=taps)
                 return (outs, new_masters, new_states, new_aux, new_exec,
                         new_residuals, hvec)
             return (outs, new_masters, new_states, new_aux, new_exec,

@@ -32,9 +32,11 @@ See docs/observability.md §health for the layout and rule semantics.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import math
 import os
+import threading
 from collections import OrderedDict, deque
 
 from ..base import MXNetError
@@ -52,6 +54,9 @@ MASK_OUTPUTS = 24
 # at most this many per-param-group max|g| slots (contiguous groups over
 # the ordered grad-name list; the layout records which names each covers)
 MAX_GRAD_GROUPS = 8
+
+# at most this many per-attention-node max|logit| tap slots
+MAX_TAPS = 8
 
 RULES = ("nonfinite", "grad_spike", "loss_plateau", "loss_explosion")
 ACTIONS = ("off", "warn", "dump", "raise")
@@ -97,6 +102,59 @@ def rule_actions(spec=None):
     return actions
 
 
+# -- attention-logit taps ----------------------------------------------------
+#
+# Ops that want a scalar on the health vector (today: the attention ops'
+# per-node max|logit| bound, the ROADMAP's MoE-router-logit note
+# generalized) call ``note_tap(value)`` while their forward traces.  The
+# executor opens a thread-local frame (``collect_taps``) around the
+# traced body; taps land in the frame in EXECUTION order, which is the
+# graph's topo order — the same order ``attention_tap_names`` derives
+# the slot names from statically, BEFORE tracing, so the layout never
+# mutates at trace time.  Without an open frame ``note_tap`` is a no-op
+# (health off: the traced program is bit-for-bit the pre-sentinel one).
+
+_tap_tls = threading.local()
+
+
+def note_tap(value):
+    """Record one traced tap scalar into the innermost open frame (a
+    no-op when no frame is open — i.e. whenever health is off or the
+    caller is not the executor's traced body)."""
+    frames = getattr(_tap_tls, "frames", None)
+    if frames:
+        frames[-1].append(value)
+
+
+@contextlib.contextmanager
+def collect_taps():
+    """Open a tap frame around a traced body; yields the list the
+    body's ``note_tap`` calls append to (traced scalars, topo order)."""
+    frames = getattr(_tap_tls, "frames", None)
+    if frames is None:
+        frames = _tap_tls.frames = []
+    frame = []
+    frames.append(frame)
+    try:
+        yield frame
+    finally:
+        frames.pop()
+
+
+def attention_tap_names(order):
+    """Static pre-trace scan of a program's topo node order for the
+    attention ops that will ``note_tap`` — returns their node names in
+    execution order (capped at :data:`MAX_TAPS`, matching the frame)."""
+    names = []
+    for node in order:
+        if getattr(node, "is_var", False):
+            continue
+        if getattr(node, "op_name", None) in (
+                "multi_head_attention", "scaled_dot_product_attention"):
+            names.append(node.name)
+    return tuple(names[:MAX_TAPS])
+
+
 class TrainingDivergedError(MXNetError):
     """A health rule with action ``raise`` fired.  Carries the first bad
     step (``.step``), the rule (``.rule``) and the flight-dump path
@@ -117,12 +175,15 @@ class HealthLayout:
     (global l2), ``param_norm`` (l2 over grad-taking params),
     ``update_ratio`` (|Δw|/|w|; exact on the fused-step path, −1 when
     the program did not compute it) — followed by one ``max_abs_grad/…``
-    slot per contiguous param group."""
+    slot per contiguous param group, then one ``max_abs_attn_logit/…``
+    slot per attention tap (``tap_names``, −1 when the program path
+    could not collect them)."""
 
     HEAD = ("finite_mask", "out_mean", "grad_norm", "param_norm",
             "update_ratio")
 
-    def __init__(self, n_outputs, grad_names, max_groups=MAX_GRAD_GROUPS):
+    def __init__(self, n_outputs, grad_names, max_groups=MAX_GRAD_GROUPS,
+                 tap_names=()):
         self.n_outputs = max(0, min(int(n_outputs), MASK_OUTPUTS))
         self.full_mask = float((1 << self.n_outputs) - 1)
         grad_names = list(grad_names or ())
@@ -135,8 +196,12 @@ class HealthLayout:
             label = names[0] if len(names) == 1 \
                 else "%s[+%d]" % (names[0], len(names) - 1)
             self.groups.append((label, start, stop))
-        self.slots = list(self.HEAD) + ["max_abs_grad/%s" % label
-                                        for label, _, _ in self.groups]
+        self.tap_names = list(tap_names or ())[:MAX_TAPS]
+        self.slots = (list(self.HEAD)
+                      + ["max_abs_grad/%s" % label
+                         for label, _, _ in self.groups]
+                      + ["max_abs_attn_logit/%s" % name
+                         for name in self.tap_names])
 
     @property
     def width(self):
@@ -158,10 +223,12 @@ class HealthLayout:
         return {"slots": list(self.slots),
                 "n_outputs": self.n_outputs,
                 "groups": [{"label": label, "start": start, "stop": stop}
-                           for label, start, stop in self.groups]}
+                           for label, start, stop in self.groups],
+                "taps": list(self.tap_names)}
 
 
-def pack_summary(layout, outputs, param_vals, grad_vals, update_ratio=None):
+def pack_summary(layout, outputs, param_vals, grad_vals, update_ratio=None,
+                 taps=None):
     """The in-program reduction: one float32 vector matching ``layout``.
 
     Pure jnp over values the surrounding program already computed — safe
@@ -170,7 +237,10 @@ def pack_summary(layout, outputs, param_vals, grad_vals, update_ratio=None):
     layout's grad names; ``update_ratio`` is a traced scalar when the
     caller (the fused train step) knows the applied update, else the
     slot holds −1 and the host estimates it from the optimizer's step
-    scale."""
+    scale.  ``taps``: traced attention-logit scalars in the layout's
+    ``tap_names`` order (a ``collect_taps`` frame); a path that could
+    not collect them (e.g. the shard_map comm step) passes None and the
+    slots hold −1."""
     import jax.numpy as jnp
 
     bits = jnp.float32(0.0)
@@ -192,10 +262,14 @@ def pack_summary(layout, outputs, param_vals, grad_vals, update_ratio=None):
         jnp.max(jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32)))
                            for g in grad_vals[start:stop]]))
         for _, start, stop in layout.groups]
+    tap_list = list(taps) if taps is not None else []
+    tap_vals = [jnp.asarray(tap_list[i], jnp.float32)
+                if i < len(tap_list) else jnp.float32(-1.0)
+                for i in range(len(layout.tap_names))]
     return jnp.stack([bits, jnp.asarray(out_mean, jnp.float32),
                       jnp.asarray(grad_norm, jnp.float32),
                       jnp.asarray(param_norm, jnp.float32), ratio]
-                     + group_max)
+                     + group_max + tap_vals)
 
 
 def combine(vectors, layout):

@@ -448,6 +448,53 @@ def live_array_census(limit=30):
             "array_count": count, "total_bytes": total}
 
 
+# -- device-resident block pools ----------------------------------------------
+# One buffer, many logical owners: a block pool (serving/continuous.py
+# KVBlockPool) allocates one device array and hands out PAGES of it, so
+# the live-array census sees a single opaque tensor.  Pools register
+# here with a page-granular usage callback; the report carries one row
+# per pool (reserved bytes, pages used, bytes used) — the per-page
+# footprint accounting the census cannot provide.
+
+_pools = {}
+
+
+def register_pool(name, page_bytes, total_pages, used_fn):
+    """Account a device-resident block pool page-by-page.  ``used_fn``
+    () -> pages currently held (active + cached); it must not raise and
+    should hold no locks the report path could contend on.  Re-registering
+    a name replaces the entry (pool rebuilds)."""
+    with _lock:
+        _pools[str(name)] = {"page_bytes": int(page_bytes),
+                             "total_pages": int(total_pages),
+                             "used_fn": used_fn}
+
+
+def unregister_pool(name):
+    with _lock:
+        _pools.pop(str(name), None)
+
+
+def pool_records():
+    """One row per registered pool: the page-granular footprint."""
+    with _lock:
+        items = list(_pools.items())
+    out = []
+    for name, p in items:
+        try:
+            used = int(p["used_fn"]())
+        except Exception:
+            used = None
+        row = {"name": name, "page_bytes": p["page_bytes"],
+               "total_pages": p["total_pages"],
+               "bytes_reserved": p["page_bytes"] * p["total_pages"],
+               "pages_used": used,
+               "bytes_used": None if used is None
+               else used * p["page_bytes"]}
+        out.append(row)
+    return out
+
+
 def device_memory():
     """Per-device allocator stats where the backend reports them
     (``Device.memory_stats`` — TPU; None fields on CPU)."""
@@ -481,6 +528,7 @@ def report():
             "compile": compile_summary(),
             "disk": disk,
             "census": live_array_census(),
+            "pools": pool_records(),
             "device_memory": device_memory()}
 
 

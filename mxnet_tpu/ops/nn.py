@@ -52,6 +52,9 @@ def _leaky_relu(x, act_type="leaky", slope=0.25, lower_bound=0.125,
     if act_type == "selu":
         alpha, scale = 1.6732632423543772, 1.0507009873554805
         return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":  # exact erf form (transformer FFN activation)
+        inv_sqrt2 = jnp.asarray(0.7071067811865476, x.dtype)
+        return 0.5 * x * (1.0 + jax.lax.erf(x * inv_sqrt2))
     raise MXNetError("unknown LeakyReLU act_type %s" % act_type)
 
 

@@ -42,18 +42,25 @@ def _compiler_params_cls(pltpu):
         "(use_pallas=False)" % jax.__version__)
 
 
-def _reference_attention(q, k, v, causal, scale):
-    """[B, S, H, D] exact attention — the fallback + test oracle."""
+def _reference_attention(q, k, v, causal, scale, kv_lens=None):
+    """[B, S, H, D] exact attention — the fallback + test oracle.
+
+    ``kv_lens``: optional (B,) per-sequence valid KV length (the padding
+    mask); keys at positions >= the length never receive weight."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    n_q, n_k = q.shape[1], k.shape[1]
     if causal:
-        n_q, n_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((n_q, n_k), bool))
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    if kv_lens is not None:
+        cols = jnp.arange(n_k)
+        valid = cols[None, :] < kv_lens.astype(jnp.int32)[:, None]  # [B, Sk]
+        s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+def _flash_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *rest,
                   causal, scale, block_q, block_k, n_kv_blocks,
                   emit_lse):
     if emit_lse:
@@ -63,7 +70,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         m_ref, l_ref, acc_ref = rest
     """One (q-block, kv-block) grid step.  Grid = (BH, n_q, n_kv) with the
     kv dimension innermost; m/l/acc scratch persists across kv steps of the
-    same q block (standard flash-attention accumulation)."""
+    same q block (standard flash-attention accumulation).  ``len_ref``
+    carries this row's valid KV length (lane-broadcast f32): the padding
+    mask, and the bound that makes block-padded sequences exact."""
     from jax.experimental import pallas as pl
 
     kv_idx = pl.program_id(2)
@@ -75,9 +84,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: kv blocks strictly above the diagonal contribute nothing
-    needed = (kv_idx * block_k <= q_idx * block_q + (block_q - 1)) \
-        if causal else (kv_idx == kv_idx)
+    kv_len = len_ref[0, 0].astype(jnp.int32)
+    # skip kv blocks entirely past the valid length; under causal, also
+    # blocks strictly above the diagonal — neither contributes weight
+    needed = kv_idx * block_k < kv_len
+    if causal:
+        needed &= kv_idx * block_k <= q_idx * block_q + (block_q - 1)
 
     @pl.when(needed)
     def _compute():
@@ -92,12 +104,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                                 preferred_element_type=jnp.float32) \
             * jnp.float32(scale)
 
+        cols = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
         if causal:
             rows = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = kv_idx * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(_NEG_INF))
+            valid &= rows >= cols
+        s = jnp.where(valid, s, jnp.float32(_NEG_INF))
 
         # m/l scratch is lane-tiled [block_q, 128] (TPU min tile); the
         # running stats live broadcast across lanes and are read back via
@@ -131,66 +145,93 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+def _round_up(n, m):
+    return ((n + m - 1) // m) * m
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, use_pallas=None, interpret=None):
+                    block_k=128, use_pallas=None, interpret=None,
+                    kv_lens=None):
     """Blocked flash attention.  q/k/v: [batch, seq, heads, head_dim].
 
-    use_pallas=None auto-selects: the Pallas kernel on TPU backends when
-    the sequence tiles evenly, the XLA reference otherwise.
+    ``kv_lens``: optional (batch,) valid KV lengths — the padding mask.
+    Sequences that do not tile evenly are block-padded internally and
+    bounded by the same per-row length the padding mask uses, so any
+    seq length is exact.  use_pallas=None auto-selects: the Pallas
+    kernel on TPU backends for lane-tiled head dims, the XLA reference
+    otherwise.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if use_pallas is None:
-        bq, bk = min(block_q, sq), min(block_k, sk)
         use_pallas = (jax.default_backend() in ("tpu", "axon")
                       and d % 128 == 0        # lane-tiled head dim
-                      and bq % 8 == 0 and bk % 8 == 0  # sublane-tiled blocks
-                      and sq % bq == 0 and sk % bk == 0)
+                      and jnp.issubdtype(q.dtype, jnp.floating))
     if not use_pallas:
-        return _reference_attention(q, k, v, causal, scale)
+        return _reference_attention(q, k, v, causal, scale, kv_lens)
 
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    # block sizes: sublane-tiled (multiple of 8), never beyond the padded
+    # sequence; short/odd sequences round up to the next tile
+    bq = min(block_q, _round_up(sq, 8))
+    bk = min(block_k, _round_up(sk, 8))
+    sq_p, sk_p = _round_up(sq, bq), _round_up(sk, bk)
 
-    # layout: fold heads into batch, [BH, S, D]
+    # layout: fold heads into batch, [BH, S, D]; pad to block multiples
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    if sq_p != sq:
+        qf = jnp.pad(qf, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        kf = jnp.pad(kf, ((0, 0), (0, sk_p - sk), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, sk_p - sk), (0, 0)))
+    # per-row valid KV length, lane-broadcast f32 [BH, 128] (the TPU min
+    # tile; f32 so the custom_vjp can hand back an ordinary zero
+    # cotangent).  Block padding and the user's padding mask are the
+    # same bound to the kernel.
+    if kv_lens is None:
+        lens = jnp.full((b,), sk, jnp.float32)
+    else:
+        lens = jnp.clip(kv_lens.astype(jnp.float32), 0, sk)
+    lens = jnp.broadcast_to(lens[:, None, None],
+                            (b, h, 128)).reshape(b * h, 128)
 
     # dispatch through a jitted-callable cache: tracing a pallas_call is
     # hundreds of ms of host work, so eager per-call tracing would swamp
     # the kernel (measured 680 ms/call untraced vs 0.02 ms cached)
-    out = _flash_vjp_wrapped(qf, kf, vf,
-                             (b, h, sq, sk, d, str(jnp.dtype(q.dtype)),
-                              causal, float(scale), block_q, block_k,
+    out = _flash_vjp_wrapped(qf, kf, vf, lens,
+                             (b, h, sq_p, sk_p, d, str(jnp.dtype(q.dtype)),
+                              causal, float(scale), bq, bk,
                               interpret))
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, sq_p, d)[:, :, :sq]
+    return out.transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_vjp_wrapped(qf, kf, vf, meta):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash_vjp_wrapped(qf, kf, vf, lens, meta):
     """Differentiable flash attention over [BH, S, D] operands: forward is
     the Pallas kernel, backward is the standard flash backward computed
     blockwise over q tiles from the saved row log-sum-exp (memory
     O(block*S), no S^2 materialization — matching the kernel's point).
     The undifferentiated primal skips the lse output entirely."""
-    out, _ = _flash_jitted(*meta, with_lse=False)(qf, kf, vf)
+    out, _ = _flash_jitted(*meta, with_lse=False)(qf, kf, vf, lens)
     return out
 
 
-def _flash_vjp_fwd(qf, kf, vf, meta):
-    out, lse = _flash_jitted(*meta, with_lse=True)(qf, kf, vf)
-    return out, (qf, kf, vf, out, lse[:, :, 0])
+def _flash_vjp_fwd(qf, kf, vf, lens, meta):
+    out, lse = _flash_jitted(*meta, with_lse=True)(qf, kf, vf, lens)
+    return out, (qf, kf, vf, lens, out, lse[:, :, 0])
 
 
 def _flash_vjp_bwd(meta, res, d_out):
     b, h, sq, sk, d, dtype, causal, scale, block_q, block_k, interpret = meta
-    qf, kf, vf, out, lse = res
+    qf, kf, vf, lens, out, lse = res
     fn = _flash_bwd_jitted(sq, sk, causal, scale, min(block_q, sq))
-    dq, dk, dv = fn(qf, kf, vf, out, lse, d_out)
-    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype))
+    dq, dk, dv = fn(qf, kf, vf, lens[:, 0], out, lse, d_out)
+    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype),
+            jnp.zeros_like(lens))
 
 
 _flash_vjp_wrapped.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -200,11 +241,12 @@ _flash_vjp_wrapped.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def _flash_bwd_jitted(sq, sk, causal, scale, block_q):
     n_q = sq // block_q
 
-    def bwd(qf, kf, vf, out, lse, d_out):
+    def bwd(qf, kf, vf, lens, out, lse, d_out):
         # D_i = rowsum(dO_i * O_i), in f32: it enters ds by cancellation
         # against dp, so bf16 rounding here would amplify
         D = jnp.sum(d_out.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                 # [BH, Sq]
+        kv_len = lens.astype(jnp.int32)                      # [BH]
 
         def one_q_block(i):
             s = i * block_q
@@ -214,13 +256,19 @@ def _flash_bwd_jitted(sq, sk, causal, scale, block_q):
             Db = jax.lax.dynamic_slice_in_dim(D, s, block_q, 1)
             sij = jnp.einsum("bqd,bkd->bqk", qb, kf,
                              preferred_element_type=jnp.float32) * scale
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, sk), 1)
+            valid = cols[None] < kv_len[:, None, None]       # [BH, bq, Sk]
             if causal:
                 rows = s + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, sk), 0)
-                cols = jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, sk), 1)
-                sij = jnp.where(rows >= cols, sij, _NEG_INF)
-            p = jnp.exp(sij - lseb[..., None])               # [BH, bq, Sk]
+                valid &= (rows >= cols)[None]
+            sij = jnp.where(valid, sij, _NEG_INF)
+            # explicit re-mask: a row with NO valid key has lse == m ==
+            # _NEG_INF and exp(s - lse) would resurrect every masked
+            # column as weight 1
+            p = jnp.where(valid, jnp.exp(sij - lseb[..., None]),
+                          0.0)                               # [BH, bq, Sk]
             dp = jnp.einsum("bqd,bkd->bqk", dob, vf,
                             preferred_element_type=jnp.float32)
             ds = p * (dp - Db[..., None])
@@ -263,18 +311,18 @@ def _flash_jitted(b, h, sq, sk, d, dtype, causal, scale, block_q, block_k,
         _flash_kernel, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, n_kv_blocks=n_kv, emit_lse=with_lse)
 
-    def run(qf, kf, vf):
+    def run(qf, kf, vf, lens):
         # the framework enables jax x64 globally (float64 NDArray API
         # parity); Mosaic rejects 64-bit types, so trace under 32-bit rules
         with _enable_x64(False):
-            return _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q,
+            return _call_flash(kernel, qf, kf, vf, lens, b, h, sq, d, n_q,
                                n_kv, block_q, block_k,
                                jnp.dtype(dtype), interpret, with_lse)
 
     return jax.jit(run)
 
 
-def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
+def _call_flash(kernel, qf, kf, vf, lens, b, h, sq, d, n_q, n_kv, block_q,
                 block_k, dtype, interpret, with_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -294,6 +342,7 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 128), lambda bh, qi, ki: (bh, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -304,8 +353,9 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
         ],
         compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        name="flash_attn_fwd",
         **({"interpret": interpret} if interpret is not None else {}),
-    )(qf, kf, vf)
+    )(qf, kf, vf, lens)
     return res if with_lse else (res[0], None)
 
 
@@ -321,6 +371,7 @@ def _call_flash(kernel, qf, kf, vf, b, h, sq, d, n_q, n_kv, block_q,
 _KERNEL_ENV = {
     "pool": "MXNET_TPU_PALLAS_POOL",
     "bn": "MXNET_TPU_PALLAS_BN",
+    "attn": "MXNET_TPU_PALLAS_ATTN",
 }
 
 
@@ -351,6 +402,30 @@ def kernel_signature():
     the executor-cache key component that makes kernel flags obey the
     health-sentinel retrace contract."""
     return tuple((k, kernel_mode(k)) for k in sorted(_KERNEL_ENV))
+
+
+def attention(q, k, v, causal=False, scale=None, kv_lens=None):
+    """Trace-time attention dispatch for the ``attn`` kernel family.
+
+    q/k/v: [batch, seq, heads, head_dim].  Resolves
+    ``kernel_mode('attn')`` at TRACE time (the executor cache keys on the
+    same resolution): ``off`` returns the plain XLA reference — no
+    custom_vjp, so the off-path program is bit-identical to one that
+    never knew the kernel — while ``pallas``/``interpret`` route through
+    the flash kernel when the shape is eligible (lane-tiled head dim,
+    floating dtype) and fall back to the reference otherwise.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    mode = kernel_mode("attn")
+    eligible = (q.shape[-1] % 128 == 0
+                and jnp.issubdtype(q.dtype, jnp.floating))
+    if mode == "off" or not eligible:
+        return _reference_attention(q, k, v, causal, float(scale), kv_lens)
+    return flash_attention(q, k, v, causal=causal, scale=float(scale),
+                           use_pallas=True,
+                           interpret=(mode == "interpret") or None,
+                           kv_lens=kv_lens)
 
 
 # ---------------------------------------------------------------------------

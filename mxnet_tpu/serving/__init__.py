@@ -25,8 +25,14 @@ Pieces (each its own module, composable without :class:`Server`):
   least-loaded dispatch, per-replica health with quarantine-and-drain
   (``router.py``, docs/serving.md §fleet);
 - :class:`ContinuousBatcher` — slot-based continuous batching for
-  stateful/recurrent decode: fixed slot count, per-slot state carried
-  on device, streams join/leave without retracing (``continuous.py``);
+  stateful/recurrent decode: fixed slot count, per-slot state (a
+  pytree of carries) carried on device, streams join/leave without
+  retracing (``continuous.py``);
+- :class:`KVBlockPool` / :class:`PagedTransformerDecoder` — the
+  paged-KV tier for autoregressive transformer decode: device-resident
+  page pool with slot -> page-table indirection, prefix-cache reuse
+  with copy-on-write, memprof-accounted footprint (``kv_cache.py``,
+  ``decode.py``, docs/serving.md §paged-KV);
 - typed rejections (``errors.py``), instrument names (``metrics.py``).
 
 See docs/serving.md for the architecture and the bucket/warmup/
@@ -38,11 +44,14 @@ from __future__ import annotations
 from .admission import (AdmissionController, Request, default_deadline_ms,
                         default_queue_depth)
 from .batcher import DynamicBatcher
-from .continuous import (ContinuousBatcher, DecodeStream,
+from .continuous import (ContinuousBatcher, DecodeStream, SlotScheduler,
                          default_slot_count)
+from .decode import PagedDecodeStream, PagedTransformerDecoder
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,
                      NoHealthyReplica, Overloaded, RequestTooLarge,
                      ServerClosed, ServingError)
+from .kv_cache import (KVBlockPool, default_page_tokens,
+                       default_pool_pages, page_chain_hash)
 from .registry import ModelRegistry, ServedModel, bucket_for, bucket_sizes
 from .router import FleetServer, Replica, ReplicaGroup, Router, \
     default_replicas
@@ -51,9 +60,11 @@ from .server import Server
 __all__ = [
     "AdmissionController", "BadRequest", "ContinuousBatcher",
     "DeadlineExceeded", "DecodeStream", "DynamicBatcher", "FleetServer",
-    "ModelNotFound", "ModelRegistry", "NoHealthyReplica", "Overloaded",
+    "KVBlockPool", "ModelNotFound", "ModelRegistry", "NoHealthyReplica",
+    "Overloaded", "PagedDecodeStream", "PagedTransformerDecoder",
     "Replica", "ReplicaGroup", "Request", "RequestTooLarge", "Router",
-    "ServedModel", "Server", "ServerClosed", "ServingError", "bucket_for",
-    "bucket_sizes", "default_deadline_ms", "default_queue_depth",
-    "default_replicas", "default_slot_count",
+    "ServedModel", "Server", "ServerClosed", "ServingError",
+    "SlotScheduler", "bucket_for", "bucket_sizes", "default_deadline_ms",
+    "default_page_tokens", "default_pool_pages", "default_queue_depth",
+    "default_replicas", "default_slot_count", "page_chain_hash",
 ]

@@ -53,7 +53,6 @@ Usage::
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 import numpy as np
@@ -78,6 +77,184 @@ def default_slot_count():
     except ValueError:
         return DEFAULT_SLOT_COUNT
     return max(1, n)
+
+
+# -- pytree carry ------------------------------------------------------------
+#
+# The per-slot carry is a PYTREE (arbitrarily nested dict/list/tuple of
+# row-major device arrays), not a fixed (S, H) NDArray: the LSTM step
+# carries {state_h, state_c}, a transformer step can carry whatever
+# structure its cell returns, and the paged-KV tier
+# (serving/decode.py) shares the same slot/occupancy machinery below.
+# Only the STRUCTURE is assumed — every leaf is (slot_count,)+anything.
+
+
+def tree_map(fn, tree, *rest):
+    """Map ``fn`` over matching leaves of pytrees (dict/list/tuple
+    nesting; anything else is a leaf).  Structures must match."""
+    if isinstance(tree, dict):
+        return {k: tree_map(fn, v, *(r[k] for r in rest))
+                for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        out = [tree_map(fn, v, *(r[i] for r in rest))
+               for i, v in enumerate(tree)]
+        return type(tree)(out)
+    return fn(tree, *rest)
+
+
+def tree_leaves(tree):
+    """Leaves of a pytree in deterministic (sorted-key) order."""
+    if isinstance(tree, dict):
+        return [leaf for k in sorted(tree)
+                for leaf in tree_leaves(tree[k])]
+    if isinstance(tree, (list, tuple)):
+        return [leaf for v in tree for leaf in tree_leaves(v)]
+    return [tree]
+
+
+def select_carry(mask_nd, carried, zeros):
+    """Row-wise occupancy select over a carry pytree: each leaf row is
+    the carried value where the slot's mask is 1, exact zeros where it
+    is 0.  A SELECT, not a multiply — a departed stream's Inf/NaN can
+    never bleed into the slot's next occupant (``0 * Inf`` would be
+    NaN; the select just drops the row).  ``carried is None`` (before
+    the first iteration) selects the zero tree wholesale."""
+    if carried is None:
+        return zeros
+    return tree_map(lambda c, z: _ndops.where(mask_nd, c, z),
+                    carried, zeros)
+
+
+class SlotScheduler:
+    """Shared slot/occupancy machinery for iteration-level decode.
+
+    Both continuous tiers — :class:`ContinuousBatcher` (fixed pytree
+    carry, this module) and the paged-KV
+    :class:`~mxnet_tpu.serving.decode.PagedTransformerDecoder` — run
+    the same scheduling loop: a FIFO of waiting streams, a fixed array
+    of slots, admission into free slots with a ``queue`` reqtrace
+    segment, and a drain/close lifecycle.  Subclasses implement
+    :meth:`step` plus the small hooks below; the occupancy mask itself
+    is subclass state (an f32 select mask here, the ``active`` row mask
+    of the paged step program there) driven from the shared
+    ``_slots``."""
+
+    def _init_slots(self, slot_count, name):
+        self.name = str(name)
+        self.slot_count = int(slot_count) if slot_count \
+            else default_slot_count()
+        if self.slot_count < 1:
+            raise MXNetError("slot_count must be >= 1")
+        self._lock = _threads.package_lock(
+            "%s._lock" % type(self).__name__)
+        self._slots = [None] * self.slot_count
+        self._waiting = []
+        self._closed = False
+        self.iterations = 0
+
+    # hooks ---------------------------------------------------------------
+    def _on_admit_locked(self, slot, stream):
+        """Per-join bookkeeping under the lock (e.g. mask reset)."""
+
+    def _queue_seg_args(self, stream):
+        """Extra args for the stream's ``queue`` reqtrace segment."""
+        return {}
+
+    def _on_reject_locked(self, stream):
+        """Undo submit-side acquisitions when a closed scheduler
+        refuses the stream (e.g. release retained prefix pages)."""
+
+    def _on_close_locked(self, doomed):
+        """Bookkeeping under the lock while closing (mask reset, page
+        release)."""
+
+    def _close_error(self, stream):
+        return MXNetError("%s closed with the stream unfinished"
+                          % type(self).__name__)
+
+    def step(self):
+        raise NotImplementedError
+
+    # shared machinery ----------------------------------------------------
+    def _enqueue(self, stream):
+        """Closed-check and append under ONE lock acquisition: a submit
+        racing close() must either be refused here or be drained (and
+        failed) by close — never appended after the drain, where
+        nothing would ever finish it."""
+        with self._lock:
+            if self._closed:
+                exc = MXNetError("%s is closed" % type(self).__name__)
+                # the refusal is a typed rejection like any other:
+                # close the minted context so it tail-captures instead
+                # of leaking an unfinished trace
+                self._on_reject_locked(stream)
+                _reqtrace.finish_rejected(stream.ctx, exc)
+                raise exc
+            self._waiting.append(stream)
+
+    def _admit_locked(self):
+        """Seat waiting streams in free slots; returns #joins."""
+        joins = 0
+        now = time.monotonic()
+        for slot in range(self.slot_count):
+            if self._slots[slot] is not None or not self._waiting:
+                continue
+            stream = self._waiting.pop(0)
+            stream.slot = slot
+            self._slots[slot] = stream
+            self._on_admit_locked(slot, stream)
+            joins += 1
+            if stream.ctx is not None:
+                # slot wait: submit -> seated (the stream analog of the
+                # request batcher's admission-queue hop)
+                stream.ctx.seg("queue", stream.ctx.t0_mono, now,
+                               slot=slot, **self._queue_seg_args(stream))
+        return joins
+
+    def active_streams(self):
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def pending(self):
+        """Streams not yet finished (active + waiting)."""
+        with self._lock:
+            return (sum(1 for s in self._slots if s is not None)
+                    + len(self._waiting))
+
+    def drain(self, max_iterations=None):
+        """Run :meth:`step` until every submitted stream finished.
+        Returns the number of iterations run."""
+        n = 0
+        while self.pending():
+            if max_iterations is not None and n >= max_iterations:
+                raise MXNetError(
+                    "drain exceeded max_iterations=%d with %d stream(s) "
+                    "unfinished" % (max_iterations, self.pending()))
+            self.step()
+            n += 1
+        return n
+
+    def close(self):
+        """Refuse new streams and fail the unfinished ones (the bounded
+        analog of a serving drain deadline)."""
+        with self._lock:
+            self._closed = True
+            doomed = [s for s in self._slots if s is not None]
+            doomed += self._waiting
+            self._slots = [None] * self.slot_count
+            self._waiting = []
+            self._on_close_locked(doomed)
+        for stream in doomed:
+            exc = self._close_error(stream)
+            stream._finish(exc)
+            _reqtrace.finish_rejected(stream.ctx, exc)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class DecodeStream:
@@ -142,9 +319,11 @@ class DecodeStream:
         return len(self._collected)
 
 
-class ContinuousBatcher:
+class ContinuousBatcher(SlotScheduler):
     """Slot-based iteration-level scheduler over one bound step
-    program (module docstring has the model)."""
+    program (module docstring has the model).  Scheduling machinery
+    (slots, admission, drain/close) comes from :class:`SlotScheduler`;
+    this class owns the bound executor and the pytree carry."""
 
     def __init__(self, symbol, arg_params, input_shapes, state_shapes,
                  state_pairs, slot_count=None, aux_params=None, ctx=None,
@@ -157,11 +336,7 @@ class ContinuousBatcher:
         (default: every output NOT claimed as a state by
         ``state_pairs``).  ``name`` labels this batcher's streams in
         request traces (``traceview --requests``)."""
-        self.name = str(name)
-        self.slot_count = int(slot_count) if slot_count \
-            else default_slot_count()
-        if self.slot_count < 1:
-            raise MXNetError("slot_count must be >= 1")
+        self._init_slots(slot_count, name)
         self.input_shapes = {k: tuple(int(d) for d in v)
                              for k, v in input_shapes.items()}
         self.state_shapes = {k: tuple(int(d) for d in v)
@@ -200,14 +375,12 @@ class ContinuousBatcher:
             collect_outputs = [i for i in range(n_outs)
                                if i not in state_outs]
         self.collect_outputs = [int(i) for i in collect_outputs]
-        # per-slot scheduling state (host side, _lock-guarded)
-        self._lock = _threads.package_lock("ContinuousBatcher._lock")
-        self._slots = [None] * self.slot_count  # DecodeStream or None
-        self._waiting = []                      # FIFO of DecodeStream
-        # carried device state: state input name -> NDArray of the
-        # previous iteration's corresponding output (None before the
-        # first iteration = feed zeros)
-        self._carry = {name: None for name, _ in self.state_pairs}
+        # carried device state: a PYTREE of the previous iteration's
+        # state outputs ({state name: row array} here; None before the
+        # first iteration = feed the zero tree).  All manipulation goes
+        # through the pytree helpers above, so the machinery holds for
+        # any carry structure a step cell returns.
+        self._carry = None
         # occupancy mask (slot_count,) f32: 1 = carry this slot's
         # state into the next iteration, 0 = start the slot from
         # exact zeros (row-wise `where` select)
@@ -219,8 +392,6 @@ class ContinuousBatcher:
             k: nd_array(np.zeros((self.slot_count,) + v,
                                  dtype=np.float32))
             for k, v in self.state_shapes.items()}
-        self.iterations = 0
-        self._closed = False
 
     # -- scheduling -----------------------------------------------------------
 
@@ -257,52 +428,14 @@ class ContinuousBatcher:
             arrays[name] = arr
         stream = DecodeStream(arrays, length, eos_fn=eos_fn)
         stream.ctx = _reqtrace.mint(self.name, rows=1, kind="stream")
-        with self._lock:
-            # closed-check and append under ONE lock acquisition:
-            # a submit racing close() must either be refused here or
-            # be drained (and failed) by close — never appended after
-            # the drain, where nothing would ever finish it
-            if self._closed:
-                exc = MXNetError("ContinuousBatcher is closed")
-                # the refusal is a typed rejection like any other:
-                # close the minted context so it tail-captures instead
-                # of leaking an unfinished trace
-                _reqtrace.finish_rejected(stream.ctx, exc)
-                raise exc
-            self._waiting.append(stream)
+        self._enqueue(stream)
         return stream
 
-    def _admit_locked(self):
-        """Seat waiting streams in free slots; returns #joins.  A
-        joined slot's mask entry goes to 0 for the NEXT iteration:
-        whatever the program computed there before is dropped by the
-        carry select, so the stream starts from exact-zero state."""
-        joins = 0
-        now = time.monotonic()
-        for slot in range(self.slot_count):
-            if self._slots[slot] is not None or not self._waiting:
-                continue
-            stream = self._waiting.pop(0)
-            stream.slot = slot
-            self._slots[slot] = stream
-            self._mask[slot] = 0.0
-            joins += 1
-            if stream.ctx is not None:
-                # slot wait: submit -> seated (the stream analog of the
-                # request batcher's admission-queue hop)
-                stream.ctx.seg("queue", stream.ctx.t0_mono, now,
-                               slot=slot)
-        return joins
-
-    def active_streams(self):
-        with self._lock:
-            return sum(1 for s in self._slots if s is not None)
-
-    def pending(self):
-        """Streams not yet finished (active + waiting)."""
-        with self._lock:
-            return (sum(1 for s in self._slots if s is not None)
-                    + len(self._waiting))
+    def _on_admit_locked(self, slot, stream):
+        # a joined slot's mask entry goes to 0 for the NEXT iteration:
+        # whatever the program computed there before is dropped by the
+        # carry select, so the stream starts from exact-zero state
+        self._mask[slot] = 0.0
 
     # -- the iteration --------------------------------------------------------
 
@@ -325,24 +458,19 @@ class ContinuousBatcher:
                     feeds[name][slot] = arr[stream.pos]
             mask_host = self._mask.copy()
         # device side, outside the lock: feed = data frames + gated
-        # carried state (the row-wise where-select is the join/leave
-        # reset — one cached elementwise program per state shape;
-        # a select, not a multiply, so a departed stream's Inf/NaN
-        # can never bleed into the slot's next occupant)
+        # carried state — the pytree occupancy select (one cached
+        # elementwise program per leaf shape) is the join/leave reset
         mask_nd = nd_array(mask_host)
-        for name, _ in self.state_pairs:
-            carried = self._carry[name]
-            feeds[name] = self._zero_states[name] if carried is None \
-                else _ndops.where(mask_nd, carried,
-                                  self._zero_states[name])
+        feeds.update(select_carry(mask_nd, self._carry,
+                                  self._zero_states))
         t_i0 = time.monotonic()
         with tracing.span("serving:decode_step", category="serving",
                           pid="serving",
                           args={"active": len(active), "joins": joins}):
             _locksan.check_dispatch_clear("continuous.step")
             outs = self._exe.forward(is_train=False, **feeds)
-            for name, idx in self.state_pairs:
-                self._carry[name] = outs[idx]
+            self._carry = {name: outs[idx]
+                           for name, idx in self.state_pairs}
             host = [outs[i].asnumpy() for i in self.collect_outputs]
         t_i1 = time.monotonic()
         for slot, stream in active:
@@ -438,7 +566,7 @@ class ContinuousBatcher:
         # warmup ran the real program with junk-free zero feeds; reset
         # the carry so the first real iteration is indistinguishable
         # from a fresh batcher (mask already all-zero: no slot active)
-        self._carry = {name: None for name, _ in self.state_pairs}
+        self._carry = None
         self.iterations = 0
         return {"traces": traces, "slot_count": self.slot_count}
 
@@ -454,32 +582,15 @@ class ContinuousBatcher:
             feeds[name] = _ndops.where(mask_nd, self._zero_states[name],
                                        self._zero_states[name])
         outs = self._exe.forward(is_train=False, **feeds)
-        for name, idx in self.state_pairs:
-            self._carry[name] = outs[idx]
+        self._carry = {name: outs[idx] for name, idx in self.state_pairs}
 
     # -- lifecycle ------------------------------------------------------------
 
-    def close(self):
-        """Refuse new streams and fail the unfinished ones (the bounded
-        analog of a serving drain deadline)."""
-        with self._lock:
-            self._closed = True
-            doomed = [s for s in self._slots if s is not None]
-            doomed += self._waiting
-            self._slots = [None] * self.slot_count
-            self._waiting = []
-            self._mask[:] = 0.0
-        for stream in doomed:
-            exc = MXNetError(
-                "ContinuousBatcher closed with the stream unfinished "
-                "(%d/%d steps decoded)" % (stream.steps_decoded,
-                                           stream.length))
-            stream._finish(exc)
-            _reqtrace.finish_rejected(stream.ctx, exc)
+    def _on_close_locked(self, doomed):
+        self._mask[:] = 0.0
 
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-        return False
+    def _close_error(self, stream):
+        return MXNetError(
+            "ContinuousBatcher closed with the stream unfinished "
+            "(%d/%d steps decoded)" % (stream.steps_decoded,
+                                       stream.length))

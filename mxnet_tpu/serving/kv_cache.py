@@ -1,0 +1,281 @@
+"""Paged KV-cache block pool (vLLM/PagedAttention-style) for
+autoregressive transformer decode.
+
+The PR 14 continuous batcher carries FIXED-SHAPE recurrent state per
+slot — right for LSTMs, wrong for transformers, whose per-stream state
+(the KV cache) GROWS each step and would force every slot to reserve
+worst-case context.  The paged tier replaces per-slot state with one
+device-resident block pool and a slot -> page-table indirection:
+
+- :class:`KVBlockPool` owns two arrays ``[layers, pages+1, page_size,
+  heads, head_dim]`` (page 0 is the trash page inactive slots write to)
+  plus host-side bookkeeping: a free list, per-page refcounts, the
+  prefix cache (chain-hash of prompt-head token pages -> page id), and
+  an LRU of refcount-0 cached pages reclaimed on demand.  Exhaustion
+  raises the typed :class:`~mxnet_tpu.serving.errors.Overloaded`.
+- **Prefix reuse + copy-on-write.**  A full prompt page is immutable
+  once written, so identical prompt heads can SHARE pages (refcounted,
+  retained per stream).  Registered/shared pages are never written in
+  place: before a stream appends into one, :meth:`ensure_private`
+  clones it into a freshly allocated private page (one fixed-shape
+  device copy, traced once) and swaps the stream's table entry — the
+  copy-on-write that keeps a cached page's bits frozen for future hits
+  while the divergent stream continues privately.
+- **Footprint accounting.**  The census in ``observability/memprof``
+  sees one opaque tensor per pool array; the pool registers a
+  page-granular usage callback (``memprof.register_pool``) so
+  ``memprof.report()`` and ``traceview --memory`` carry one row per
+  pool, and every occupancy transition updates the
+  ``serving.decode.kv_pages_in_use`` / ``kv_pages_high_water`` gauges.
+
+Config: ``MXNET_TPU_KV_POOL_PAGES`` (pool capacity in pages, default
+64) and ``MXNET_TPU_KV_PAGE_TOKENS`` (tokens per page, default 16) —
+see docs/env_vars.md.  The consumer is
+:class:`~mxnet_tpu.serving.decode.PagedTransformerDecoder`
+(docs/serving.md §paged-KV has the anatomy).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import weakref
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import threads as _threads
+from ..observability import memprof as _memprof
+from . import metrics
+from .errors import Overloaded
+
+ENV_POOL_PAGES = "MXNET_TPU_KV_POOL_PAGES"
+DEFAULT_POOL_PAGES = 64
+ENV_PAGE_TOKENS = "MXNET_TPU_KV_PAGE_TOKENS"
+DEFAULT_PAGE_TOKENS = 16
+
+
+def _env_int(env, default, lo=1):
+    try:
+        n = int(os.environ.get(env, str(default)))
+    except ValueError:
+        return default
+    return max(lo, n)
+
+
+def default_pool_pages():
+    return _env_int(ENV_POOL_PAGES, DEFAULT_POOL_PAGES)
+
+
+def default_page_tokens():
+    return _env_int(ENV_PAGE_TOKENS, DEFAULT_PAGE_TOKENS)
+
+
+def page_chain_hash(prev_hash, page_tokens):
+    """Chain hash over full token pages: page p's identity commits to
+    EVERY token before it (prev link) plus its own page_size tokens —
+    equal hashes mean equal full prefixes, so the cached K/V bits are
+    the ones this stream would have computed."""
+    return hash((prev_hash, tuple(int(t) for t in page_tokens)))
+
+
+@functools.lru_cache(maxsize=None)
+def _clone_program(shape, dtype):
+    """One fixed-shape jitted page copy per pool geometry: (k_pool,
+    v_pool, src, dst) -> pools with page ``dst`` = page ``src`` across
+    every layer.  Traced once (the decoder warmup pre-traces it), so a
+    mid-traffic COW adds zero retraces."""
+    import jax
+
+    def run(k_pool, v_pool, src, dst):
+        from .. import executor_cache
+        executor_cache.note_trace("fwd", label="serving:kv_cow")
+        return (k_pool.at[:, dst].set(k_pool[:, src]),
+                v_pool.at[:, dst].set(v_pool[:, src]))
+
+    return jax.jit(run)
+
+
+class KVBlockPool:
+    """Device-resident paged KV store + host allocator/prefix cache."""
+
+    def __init__(self, num_layers, num_heads, head_dim, num_pages=None,
+                 page_size=None, name="kv"):
+        import jax.numpy as jnp
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_pages = int(num_pages) if num_pages \
+            else default_pool_pages()
+        self.page_size = int(page_size) if page_size \
+            else default_page_tokens()
+        self.name = str(name)
+        shape = (self.num_layers, self.num_pages + 1, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = jnp.zeros(shape, jnp.float32)
+        self.v_pool = jnp.zeros(shape, jnp.float32)
+        self._lock = _threads.package_lock("KVBlockPool._lock")
+        self._free = list(range(1, self.num_pages + 1))
+        self._ref = {}               # page -> refcount (held pages only)
+        self._prefix = {}            # chain hash -> page
+        self._hash_of = {}           # page -> chain hash (registered)
+        self._reclaim = OrderedDict()  # refcount-0 registered pages, LRU
+        self._high_water = 0
+        self.cow_clones = 0
+        # k + v, all layers: the footprint one logical page costs
+        self.page_bytes = (2 * self.num_layers * self.page_size
+                           * self.num_heads * self.head_dim * 4)
+        ref = weakref.ref(self)
+        _memprof.register_pool(
+            self.name, self.page_bytes, self.num_pages,
+            lambda: (lambda p: p.pages_used() if p is not None else 0)(
+                ref()))
+        metrics.record_kv_pool(0, self.num_pages, high_water=0)
+
+    # -- accounting (host) -------------------------------------------------
+
+    def pages_used(self):
+        """Pages held: active (refcount > 0) + prefix-cached idle."""
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    def stats(self):
+        with self._lock:
+            return {"pages_total": self.num_pages,
+                    "pages_free": len(self._free),
+                    "pages_active": len(self._ref),
+                    "pages_cached_idle": len(self._reclaim),
+                    "pages_high_water": self._high_water,
+                    "prefix_entries": len(self._prefix),
+                    "cow_clones": self.cow_clones,
+                    "page_bytes": self.page_bytes}
+
+    def _note_occupancy_locked(self):
+        used = self.num_pages - len(self._free)
+        if used > self._high_water:
+            self._high_water = used
+        metrics.record_kv_pool(used, self.num_pages,
+                               high_water=self._high_water)
+
+    # -- allocation --------------------------------------------------------
+
+    def _alloc_locked(self):
+        if self._free:
+            page = self._free.pop()
+        elif self._reclaim:
+            page, _ = self._reclaim.popitem(last=False)
+            h = self._hash_of.pop(page, None)
+            if h is not None:
+                self._prefix.pop(h, None)
+            metrics.record_kv_eviction()
+        else:
+            raise Overloaded(
+                "KV block pool exhausted: %d pages all actively held "
+                "(raise %s or shed streams)"
+                % (self.num_pages, ENV_POOL_PAGES))
+        self._ref[page] = 1
+        self._note_occupancy_locked()
+        return page
+
+    def alloc(self):
+        """One free page (refcount 1).  Falls back to evicting the
+        least-recently-idle prefix-cached page; raises ``Overloaded``
+        when every page is actively held."""
+        with self._lock:
+            return self._alloc_locked()
+
+    def release(self, page):
+        """Drop one reference.  A refcount-0 page returns to the free
+        list — unless it is prefix-registered, in which case it parks in
+        the reclaimable LRU (a future identical prompt can still hit
+        it)."""
+        with self._lock:
+            n = self._ref.get(page)
+            if n is None:
+                return
+            if n > 1:
+                self._ref[page] = n - 1
+                return
+            del self._ref[page]
+            if page in self._hash_of:
+                self._reclaim[page] = True
+                self._reclaim.move_to_end(page)
+            else:
+                self._free.append(page)
+            self._note_occupancy_locked()
+
+    def refcount(self, page):
+        with self._lock:
+            return self._ref.get(page, 0)
+
+    # -- copy-on-write -----------------------------------------------------
+
+    def ensure_private(self, page):
+        """COW guard before a stream appends into ``page``: a page that
+        is shared (refcount > 1) or prefix-registered (immutable — its
+        bits back cache hits) is cloned into a freshly allocated private
+        page; the caller swaps its table entry to the returned id.  A
+        page this stream exclusively owns comes back unchanged.
+
+        Returns ``(page_id, cloned)``.  May raise ``Overloaded`` (no
+        page for the private copy) — the caller sheds that stream like
+        any other allocation failure, and still holds its original
+        reference to ``page``."""
+        with self._lock:
+            shared = self._ref.get(page, 0) > 1
+            if not shared and page not in self._hash_of:
+                return page, False
+            fresh = self._alloc_locked()   # may raise Overloaded
+            # hand back our reference to the original WITHOUT parking
+            # logic duplication: decrement inline (the page stays held
+            # by its co-owners, or parks via release below)
+        # device copy outside the pool lock: a fixed-shape program, no
+        # host readback (graftlint: the dispatch is clear of pool locks)
+        fn = _clone_program(tuple(self.k_pool.shape),
+                            str(self.k_pool.dtype))
+        self.k_pool, self.v_pool = fn(self.k_pool, self.v_pool,
+                                      np.int32(page), np.int32(fresh))
+        self.release(page)
+        with self._lock:
+            self.cow_clones += 1
+        metrics.record_kv_cow()
+        return fresh, True
+
+    def warm_cow(self):
+        """Pre-trace the COW clone program (trash page onto itself) so a
+        mid-traffic clone adds zero retraces — called by the decoder's
+        warmup alongside the step program."""
+        fn = _clone_program(tuple(self.k_pool.shape),
+                            str(self.k_pool.dtype))
+        self.k_pool, self.v_pool = fn(self.k_pool, self.v_pool,
+                                      np.int32(0), np.int32(0))
+
+    # -- prefix cache ------------------------------------------------------
+
+    def lookup_retain(self, chain_hash):
+        """Prefix probe: the page caching this chain hash, retained for
+        the caller (refcount + 1), or None."""
+        with self._lock:
+            page = self._prefix.get(chain_hash)
+            if page is None:
+                return None
+            if page in self._reclaim:
+                del self._reclaim[page]
+            self._ref[page] = self._ref.get(page, 0) + 1
+            self._note_occupancy_locked()
+            return page
+
+    def register_prefix(self, chain_hash, page):
+        """Offer a just-completed full page to the prefix cache.  First
+        writer wins: if the hash is already cached by another page, the
+        existing entry stays (both pages hold identical bits; the
+        duplicate simply frees normally at release)."""
+        with self._lock:
+            if chain_hash in self._prefix or page in self._hash_of:
+                return
+            if page not in self._ref:
+                return  # released before registration: don't resurrect
+            self._prefix[chain_hash] = page
+            self._hash_of[page] = chain_hash
+
+    def close(self):
+        _memprof.unregister_pool(self.name)

@@ -43,6 +43,25 @@ breakdown and SLO attainment table parse these):
 - ``serving.decode.active_slots``      histogram, occupancy per step
 - ``serving.decode.joins`` / ``serving.decode.leaves``  counters
 
+Paged-KV tier (docs/serving.md §paged-KV; ``serving/kv_cache.py`` +
+``serving/decode.py``; ``traceview --serving`` page-pool rows parse
+these):
+
+- ``serving.decode.kv_pages_in_use``     gauge, pages held
+  (active + prefix-cached idle)
+- ``serving.decode.kv_pages_total``      gauge, pool capacity in pages
+- ``serving.decode.kv_pages_high_water`` gauge, most pages ever held
+- ``serving.decode.kv_pages_per_stream`` histogram, pages a stream
+  held at finish (its context footprint in page units)
+- ``serving.decode.prefix_lookups``      counter, submit-time prefix
+  probes
+- ``serving.decode.prefix_hits``         counter, pages reused from
+  the prefix cache (prompt tokens NOT recomputed)
+- ``serving.decode.kv_evictions``        counter, cached pages evicted
+  to satisfy an allocation
+- ``serving.decode.kv_cow_clones``       counter, shared pages cloned
+  copy-on-write before a divergent append
+
 Trace events (category ``serving``): per-request ``serving:request``
 spans with a nested ``serving:queue`` phase, per-batch ``serving:batch``
 spans with a nested ``serving:dispatch`` phase, and
@@ -174,6 +193,51 @@ def record_decode_step(active_slots, joins, leaves):
     if leaves:
         telemetry.counter("serving.decode.leaves",
                           help="streams left at EOS").inc(leaves)
+
+
+def record_kv_pool(used_pages, total_pages, high_water=None):
+    """Block-pool occupancy after an alloc/release/evict transition
+    (gauges: the current truth, not a rate)."""
+    telemetry.gauge("serving.decode.kv_pages_in_use",
+                    help="KV pool pages held (active + prefix-cached)"
+                    ).set(int(used_pages))
+    telemetry.gauge("serving.decode.kv_pages_total",
+                    help="KV pool capacity in pages").set(int(total_pages))
+    if high_water is not None:
+        telemetry.gauge("serving.decode.kv_pages_high_water",
+                        help="most KV pool pages ever held").set(
+            int(high_water))
+
+
+def record_kv_stream_finished(pages_held):
+    """A paged stream finished: its context footprint in page units."""
+    telemetry.histogram("serving.decode.kv_pages_per_stream",
+                        help="pages a stream held at finish").observe(
+        int(pages_held))
+
+
+def record_kv_prefix(lookups=0, hit_pages=0):
+    """Prefix-cache outcome at submit: probes made and pages reused
+    (every reused page is page_size prompt tokens NOT recomputed)."""
+    if lookups:
+        telemetry.counter("serving.decode.prefix_lookups",
+                          help="prefix-cache probes at submit").inc(lookups)
+    if hit_pages:
+        telemetry.counter("serving.decode.prefix_hits",
+                          help="pages reused from the prefix cache").inc(
+            hit_pages)
+
+
+def record_kv_eviction(n=1):
+    """Refcount-0 cached pages evicted (LRU) to satisfy an alloc."""
+    telemetry.counter("serving.decode.kv_evictions",
+                      help="prefix-cached pages evicted for space").inc(n)
+
+
+def record_kv_cow(n=1):
+    """Shared pages cloned copy-on-write before a divergent append."""
+    telemetry.counter("serving.decode.kv_cow_clones",
+                      help="shared KV pages cloned copy-on-write").inc(n)
 
 
 def record_nonfinite_response(model, n_outputs):

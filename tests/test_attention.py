@@ -1,0 +1,335 @@
+"""Native attention subsystem tier (ISSUE 19; docs/kernels.md
+§flash-attention).
+
+Three contracts:
+
+1. **Kernel parity.** The Pallas flash-attention kernel runs through the
+   interpreter (the exact kernel code path the chip compiles) and must
+   match the XLA reference — forward AND grads, f32 and bf16, causal /
+   padding-mask / block-padded odd lengths.
+2. **The flag contract.** ``MXNET_TPU_PALLAS_ATTN`` rides
+   ``kernel_signature()`` into the executor-cache key: enabling costs
+   exactly one retrace of a real transformer fwd_bwd program, disabling
+   costs zero, and the off path is bitwise what it was before the round
+   trip.
+3. **The health tap.** With ``MXNET_TPU_HEALTH=1`` the packed summary
+   carries a ``max_abs_attn_logit/<node>`` slot per attention node — an
+   upper bound on the node's max |logit| (Cauchy-Schwarz, uniform across
+   kernel modes); absent taps pack -1.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache
+from mxnet_tpu.observability import health
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _qkv(b, s, h, d, dtype=jnp.float32, seed=0):
+    r = _rng(seed)
+    mk = lambda: jnp.asarray(r.normal(0, 1, (b, s, h, d)), dtype)
+    return mk(), mk(), mk()
+
+
+# ---------------------------------------------------------------------------
+# 1) Flash kernel (interpret mode) vs the XLA reference oracle
+# ---------------------------------------------------------------------------
+
+ATTN_CASES = [
+    # (dtype, causal, with_lens, seq)
+    (jnp.float32, False, False, 16),
+    (jnp.float32, True, False, 16),
+    (jnp.float32, False, True, 16),
+    (jnp.float32, True, True, 13),    # odd length: block padding + mask
+    (jnp.bfloat16, False, False, 16),
+    (jnp.bfloat16, True, True, 16),
+]
+ATTN_IDS = ["%s-%s%s-s%d" % (np.dtype(c[0]).name,
+                             "causal" if c[1] else "full",
+                             "-lens" if c[2] else "", c[3])
+            for c in ATTN_CASES]
+
+
+def _tols(dtype):
+    return {"rtol": 2e-2, "atol": 2e-2} if dtype == jnp.bfloat16 \
+        else {"rtol": 2e-5, "atol": 2e-5}
+
+
+@pytest.mark.parametrize("case", ATTN_CASES, ids=ATTN_IDS)
+def test_flash_forward_matches_reference(case):
+    dtype, causal, with_lens, seq = case
+    q, k, v = _qkv(2, seq, 2, 128, dtype, seed=1)
+    lens = jnp.asarray([seq, max(1, seq - 5)], jnp.int32) \
+        if with_lens else None
+    scale = 1.0 / 128 ** 0.5
+    want = pk._reference_attention(q, k, v, causal, scale, lens)
+    got = pk.flash_attention(q, k, v, causal=causal, use_pallas=True,
+                             interpret=True, kv_lens=lens)
+    assert got.dtype == q.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        **_tols(dtype))
+
+
+@pytest.mark.parametrize("case", ATTN_CASES, ids=ATTN_IDS)
+def test_flash_grads_match_reference(case):
+    dtype, causal, with_lens, seq = case
+    q, k, v = _qkv(2, seq, 2, 128, dtype, seed=2)
+    lens = jnp.asarray([seq, max(1, seq - 5)], jnp.int32) \
+        if with_lens else None
+    scale = 1.0 / 128 ** 0.5
+    w = jnp.asarray(_rng(3).normal(0, 1, q.shape), jnp.float32)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            o = fn(q_, k_, v_)
+            return jnp.sum(o.astype(jnp.float32) * w)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    want = loss(lambda q_, k_, v_: pk._reference_attention(
+        q_, k_, v_, causal, scale, lens))
+    got = loss(lambda q_, k_, v_: pk.flash_attention(
+        q_, k_, v_, causal=causal, use_pallas=True, interpret=True,
+        kv_lens=lens))
+    tol = {"rtol": 3e-2, "atol": 3e-2} if dtype == jnp.bfloat16 \
+        else {"rtol": 2e-4, "atol": 2e-4}
+    for g, r, name in zip(got, want, "qkv"):
+        assert g.dtype == r.dtype
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            err_msg="d%s diverged" % name, **tol)
+
+
+def test_attention_dispatch_falls_back_when_ineligible():
+    """head_dim that is not lane-tiled (not a multiple of 128) must take
+    the reference path bit-for-bit, whatever the flag says."""
+    q, k, v = _qkv(2, 8, 2, 32, seed=4)
+    want = pk._reference_attention(q, k, v, True, 1.0 / 32 ** 0.5, None)
+    saved = os.environ.get("MXNET_TPU_PALLAS_ATTN")
+    os.environ["MXNET_TPU_PALLAS_ATTN"] = "1"
+    try:
+        got = pk.attention(q, k, v, causal=True)
+    finally:
+        if saved is None:
+            os.environ.pop("MXNET_TPU_PALLAS_ATTN", None)
+        else:
+            os.environ["MXNET_TPU_PALLAS_ATTN"] = saved
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kernel_signature_carries_attn_family():
+    sig = dict(pk.kernel_signature())
+    assert "attn" in sig
+    assert sig["attn"] in ("off", "pallas", "interpret")
+
+
+# ---------------------------------------------------------------------------
+# 2) Graph ops: forward parity + the flag cache-key contract
+# ---------------------------------------------------------------------------
+
+def test_sdpa_op_forward_matches_reference():
+    r = _rng(5)
+    b, s, h, d = 2, 6, 2, 8
+    x = {n: r.normal(0, 1, (b, s, h, d)).astype(np.float32)
+         for n in ("query", "key", "value")}
+    lens = np.asarray([6, 3], np.float32)
+    sym = mx.sym.scaled_dot_product_attention(
+        mx.sym.Variable("query"), mx.sym.Variable("key"),
+        mx.sym.Variable("value"), mx.sym.Variable("kv_length"),
+        causal=True, use_lengths=True, name="sdpa")
+    exe = sym.simple_bind(mx.cpu(), grad_req="null",
+                          query=x["query"].shape, key=x["key"].shape,
+                          value=x["value"].shape, kv_length=lens.shape)
+    for n, arr in x.items():
+        exe.arg_dict[n][:] = mx.nd.array(arr)
+    exe.arg_dict["kv_length"][:] = mx.nd.array(lens)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    want = pk._reference_attention(
+        jnp.asarray(x["query"]), jnp.asarray(x["key"]),
+        jnp.asarray(x["value"]), True, 1.0 / d ** 0.5,
+        jnp.asarray(lens))
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_mha_op_forward_matches_manual_projection():
+    r = _rng(6)
+    b, s, e, heads = 2, 5, 8, 2
+    x = r.normal(0, 1, (b, s, e)).astype(np.float32)
+    ws = {n: r.normal(0, 0.5, (e, e)).astype(np.float32)
+          for n in ("query_weight", "key_weight", "value_weight",
+                    "out_weight")}
+    bs = {n: r.normal(0, 0.1, (e,)).astype(np.float32)
+          for n in ("query_bias", "key_bias", "value_bias", "out_bias")}
+    sym = mx.sym.multi_head_attention(
+        mx.sym.Variable("data"), mx.sym.Variable("data"),
+        mx.sym.Variable("data"), num_heads=heads, causal=True,
+        name="attn0")
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(b, s, e))
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    for n in ws:
+        exe.arg_dict["attn0_" + n][:] = mx.nd.array(ws[n])
+    for n in bs:
+        exe.arg_dict["attn0_" + n][:] = mx.nd.array(bs[n])
+    out = exe.forward(is_train=False)[0].asnumpy()
+    # manual oracle: x @ W^T + b per side, reference core, out proj
+    proj = {n: (x @ ws[n + "_weight"].T + bs[n + "_bias"])
+            .reshape(b, s, heads, e // heads)
+            for n in ("query", "key", "value")}
+    core = pk._reference_attention(
+        jnp.asarray(proj["query"]), jnp.asarray(proj["key"]),
+        jnp.asarray(proj["value"]), True, 1.0 / (e // heads) ** 0.5, None)
+    want = np.asarray(core).reshape(b, s, e) @ ws["out_weight"].T \
+        + bs["out_bias"]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    # auto-created parameter shapes follow the FC convention
+    shapes = dict(zip(sym.list_arguments(), sym.infer_shape(
+        data=(b, s, e))[0]))
+    assert shapes["attn0_query_weight"] == (e, e)
+    assert shapes["attn0_out_bias"] == (e,)
+
+
+@pytest.fixture
+def _attn_flag():
+    saved = os.environ.pop("MXNET_TPU_PALLAS_ATTN", None)
+    yield
+    if saved is None:
+        os.environ.pop("MXNET_TPU_PALLAS_ATTN", None)
+    else:
+        os.environ["MXNET_TPU_PALLAS_ATTN"] = saved
+
+
+def _transformer_net(embed=128, heads=1):
+    # head_dim = embed/heads = 128: lane-tiled, so the flag-on path
+    # really routes through the (interpret-mode) flash kernel
+    data = mx.sym.Variable("data")
+    attn = mx.sym.multi_head_attention(
+        data, data, data, num_heads=heads, causal=True, name="attn0")
+    net = mx.sym.Flatten(data + attn, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_attn_flag_keys_the_program_cache(_attn_flag):
+    """MXNET_TPU_PALLAS_ATTN obeys the kernel-flag contract through a
+    real transformer fwd_bwd: enable = one retrace, disable = zero, and
+    the off-path grads are bitwise untouched by the round trip."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    sym = _transformer_net()
+    shape = (2, 4, 128)
+
+    def run():
+        r = _rng(7)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind([("data", shape)], [("softmax_label", (shape[0],))])
+        mx.random.seed(0)
+        mod.init_params(mx.initializer.Xavier())
+        batch = DataBatch(
+            data=[mx.nd.array(r.normal(0, 1, shape).astype(np.float32))],
+            label=[mx.nd.array(r.randint(0, 3, (shape[0],))
+                               .astype(np.float32))],
+            provide_data=[DataDesc("data", shape)],
+            provide_label=[DataDesc("softmax_label", (shape[0],))])
+        with executor_cache.watch_traces() as w:
+            mod.forward_backward(batch)
+        exe = mod._exec_group.execs[0]
+        return w, {n: np.asarray(g._h.array)
+                   for n, g in exe.grad_dict.items()}
+
+    run()  # warm the off-path program
+    w_off, g_off = run()
+    assert w_off.total() == 0, w_off.delta()
+
+    os.environ["MXNET_TPU_PALLAS_ATTN"] = "1"
+    assert pk.kernel_mode("attn") in ("interpret", "pallas")
+    w_on, g_on = run()
+    assert w_on.total() == 1 \
+        and w_on.delta().get("traces_fwd_bwd") == 1, w_on.delta()
+    for n in g_off:
+        np.testing.assert_allclose(g_on[n], g_off[n], rtol=1e-3,
+                                   atol=1e-3, err_msg=n)
+
+    del os.environ["MXNET_TPU_PALLAS_ATTN"]
+    w_back, g_back = run()
+    assert w_back.total() == 0, w_back.delta()
+    assert all(np.array_equal(g_off[n], g_back[n]) for n in g_off), \
+        "off-path gradients changed after a kernel-flag round trip"
+
+
+# ---------------------------------------------------------------------------
+# 3) The health tap: max_abs_attn_logit slots
+# ---------------------------------------------------------------------------
+
+def test_attention_tap_names_scans_the_graph():
+    sym = _transformer_net()
+    from mxnet_tpu.executor import _Program
+    names = health.attention_tap_names(_Program(sym).order)
+    assert names == ("attn0",)
+
+
+def test_health_summary_carries_attention_logit_bound(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    sym = _transformer_net(embed=8, heads=2)
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", data=(2, 4, 8),
+                          softmax_label=(2,))
+    r = _rng(8)
+    x = r.normal(0, 1, (2, 4, 8)).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    exe.arg_dict["softmax_label"][:] = mx.nd.array(
+        r.randint(0, 3, (2,)).astype(np.float32))
+    for n, a in exe.arg_dict.items():
+        if n.startswith(("attn0_", "fc_")):  # simple_bind zero-inits
+            a[:] = mx.nd.array(
+                r.normal(0, 0.5, a.shape).astype(np.float32))
+    exe.forward_backward(is_train=True)
+    layout = exe.health_layout
+    assert layout.tap_names == ["attn0"]
+    assert layout.slots[-1] == "max_abs_attn_logit/attn0"
+    summary = layout.unpack(np.asarray(exe._last_health))
+    bound = summary["max_abs_attn_logit/attn0"]
+    assert np.isfinite(bound) and bound > 0
+    # it really bounds the logits: recompute them from the bound args
+    args = {n: a.asnumpy() for n, a in exe.arg_dict.items()}
+    d = 4  # head_dim = 8 / 2
+    proj = {n: (x @ args["attn0_%s_weight" % n].T
+                + args["attn0_%s_bias" % n]).reshape(2, 4, 2, d)
+            for n in ("query", "key")}
+    logits = np.einsum("bqhd,bkhd->bhqk", proj["query"],
+                       proj["key"]) / d ** 0.5
+    assert bound >= np.abs(logits).max() - 1e-5
+
+
+def test_pack_summary_fills_missing_taps_with_minus_one():
+    layout = health.HealthLayout(1, ["w"], tap_names=("attn0", "attn1"))
+    assert layout.slots[-2:] == ["max_abs_attn_logit/attn0",
+                                 "max_abs_attn_logit/attn1"]
+    outs = [jnp.asarray([1.0])]
+    params = [jnp.asarray([1.0])]
+    grads = [jnp.asarray([0.5])]
+    vec = np.asarray(health.pack_summary(layout, outs, params, grads,
+                                         taps=[jnp.float32(2.5)]))
+    summary = layout.unpack(vec)
+    assert summary["max_abs_attn_logit/attn0"] == 2.5
+    assert summary["max_abs_attn_logit/attn1"] == -1.0
+    vec_none = np.asarray(health.pack_summary(layout, outs, params,
+                                              grads, taps=None))
+    s2 = layout.unpack(vec_none)
+    assert s2["max_abs_attn_logit/attn0"] == -1.0
+
+
+def test_note_tap_is_noop_without_open_frame():
+    health.note_tap(jnp.float32(3.0))  # must not raise or leak
+    with health.collect_taps() as frame:
+        health.note_tap(jnp.float32(1.0))
+        health.note_tap(jnp.float32(2.0))
+    assert [float(t) for t in frame] == [1.0, 2.0]

@@ -1,0 +1,369 @@
+"""Paged-KV serving tier (ISSUE 19; docs/serving.md §paged-KV).
+
+Pool contracts (:class:`KVBlockPool`): alloc/release/recycle, the
+reclaimable-LRU eviction of idle prefix-cached pages, copy-on-write
+cloning of shared/registered pages, typed ``Overloaded`` exhaustion,
+memprof registration.
+
+Decoder contracts (:class:`PagedTransformerDecoder`): the slot ->
+page-table indirection is invisible — every served stream is bitwise
+what solo decode produces — joins/leaves/prefill/decode/COW add ZERO
+retraces after warmup, prefix hits skip prefill and a fully cached
+prompt diverges through a COW clone, a stream that cannot get a page
+sheds with ``Overloaded`` while co-batched streams proceed, and the
+scheduler close/reject paths fail streams with typed errors.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor_cache, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import transformer_lm
+from mxnet_tpu.observability import memprof
+from mxnet_tpu.serving import KVBlockPool, PagedTransformerDecoder
+from mxnet_tpu.serving.errors import Overloaded
+from mxnet_tpu.serving.kv_cache import page_chain_hash
+
+VOCAB, EMBED, HEADS, LAYERS, SEQ = 64, 32, 2, 1, 64
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    lm = transformer_lm(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                        num_layers=LAYERS, seq_len=SEQ)
+    lm.initialize()
+    # one forward materializes the deferred Dense shapes
+    _ = lm(mx.nd.array(np.zeros((1, SEQ), np.float32)))
+    return lm.decode_param_arrays(), lm.config
+
+
+def _pool(num_pages=4, page_size=8, name="t"):
+    return KVBlockPool(LAYERS, HEADS, EMBED // HEADS,
+                       num_pages=num_pages, page_size=page_size,
+                       name=name)
+
+
+def _decoder(lm_params, slot_count=3, num_pages=24, page_size=8,
+             name="pdec", **kw):
+    params, config = lm_params
+    pool = _pool(num_pages, page_size, name="%s.kv" % name)
+    return PagedTransformerDecoder(params, config,
+                                   slot_count=slot_count, pool=pool,
+                                   name=name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# KVBlockPool: allocation, recycling, eviction
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_release_recycle():
+    pool = _pool(num_pages=3, name="t.alloc")
+    try:
+        pages = [pool.alloc() for _ in range(3)]
+        assert sorted(pages) == [1, 2, 3]  # page 0 is the trash page
+        assert pool.pages_used() == 3
+        with pytest.raises(Overloaded):
+            pool.alloc()
+        pool.release(pages[0])
+        assert pool.pages_used() == 2
+        again = pool.alloc()
+        assert again == pages[0]  # unregistered pages recycle directly
+        st = pool.stats()
+        assert st["pages_total"] == 3 and st["pages_active"] == 3
+        assert st["pages_high_water"] == 3
+    finally:
+        pool.close()
+
+
+def test_pool_refcount_and_shared_release():
+    pool = _pool(num_pages=2, name="t.ref")
+    try:
+        page = pool.alloc()
+        h = page_chain_hash(0, range(pool.page_size))
+        pool.register_prefix(h, page)
+        assert pool.lookup_retain(h) == page
+        assert pool.refcount(page) == 2
+        pool.release(page)
+        assert pool.refcount(page) == 1     # still held by one stream
+        pool.release(page)
+        # refcount 0 but registered: parks in the reclaimable LRU, still
+        # hittable, still counted as used
+        assert pool.refcount(page) == 0
+        assert pool.pages_used() == 1
+        assert pool.stats()["pages_cached_idle"] == 1
+        assert pool.lookup_retain(h) == page
+    finally:
+        pool.close()
+
+
+def test_pool_lru_eviction_frees_idle_cached_pages():
+    pool = _pool(num_pages=2, name="t.lru")
+    try:
+        hashes = []
+        for i in range(2):
+            page = pool.alloc()
+            h = page_chain_hash(i, range(pool.page_size))
+            pool.register_prefix(h, page)
+            hashes.append(h)
+            pool.release(page)  # idle, parked in LRU order
+        assert pool.stats()["pages_cached_idle"] == 2
+        # the free list is empty: alloc evicts the LEAST recently idle
+        # cached page and drops its prefix entry
+        _ = pool.alloc()
+        assert pool.lookup_retain(hashes[0]) is None
+        assert pool.lookup_retain(hashes[1]) is not None
+    finally:
+        pool.close()
+
+
+def test_pool_exhaustion_is_typed_and_actionable():
+    pool = _pool(num_pages=1, name="t.full")
+    try:
+        pool.alloc()
+        with pytest.raises(Overloaded, match="MXNET_TPU_KV_POOL_PAGES"):
+            pool.alloc()
+    finally:
+        pool.close()
+
+
+def test_register_prefix_first_writer_wins_and_skips_released():
+    pool = _pool(num_pages=3, name="t.reg")
+    try:
+        h = page_chain_hash(0, range(pool.page_size))
+        a, b = pool.alloc(), pool.alloc()
+        pool.register_prefix(h, a)
+        pool.register_prefix(h, b)          # duplicate hash: a stays
+        assert pool.lookup_retain(h) == a
+        released = pool.alloc()
+        pool.release(released)
+        h2 = page_chain_hash(1, range(pool.page_size))
+        pool.register_prefix(h2, released)  # never resurrects a free page
+        assert pool.lookup_retain(h2) is None
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# KVBlockPool: copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_cow_clones_shared_and_registered_pages():
+    pool = _pool(num_pages=4, name="t.cow")
+    try:
+        pool.warm_cow()
+        # exclusively owned, unregistered: no clone
+        mine = pool.alloc()
+        assert pool.ensure_private(mine) == (mine, False)
+        # shared (refcount 2 via a prefix hit): clone + handback
+        h = page_chain_hash(0, range(pool.page_size))
+        pool.register_prefix(h, mine)
+        other = pool.lookup_retain(h)
+        assert other == mine and pool.refcount(mine) == 2
+        fresh, cloned = pool.ensure_private(mine)
+        assert cloned and fresh != mine
+        assert pool.refcount(mine) == 1     # our reference handed back
+        assert pool.refcount(fresh) == 1
+        # registered even at refcount 1: the cached bits stay frozen
+        fresh2, cloned2 = pool.ensure_private(mine)
+        assert cloned2 and fresh2 not in (mine, fresh)
+        assert pool.stats()["cow_clones"] == 2
+        # the original parked in the LRU, still backing future hits
+        assert pool.lookup_retain(h) == mine
+    finally:
+        pool.close()
+
+
+def test_cow_preserves_page_bits():
+    import jax.numpy as jnp
+    pool = _pool(num_pages=2, page_size=4, name="t.bits")
+    try:
+        page = pool.alloc()
+        stamp = np.arange(
+            LAYERS * pool.page_size * HEADS * (EMBED // HEADS),
+            dtype=np.float32).reshape(LAYERS, pool.page_size, HEADS, -1)
+        pool.k_pool = pool.k_pool.at[:, page].set(jnp.asarray(stamp))
+        pool.v_pool = pool.v_pool.at[:, page].set(jnp.asarray(2 * stamp))
+        pool.register_prefix(page_chain_hash(0, [1, 2, 3, 4]), page)
+        fresh, cloned = pool.ensure_private(page)
+        assert cloned
+        assert np.array_equal(np.asarray(pool.k_pool[:, fresh]), stamp)
+        assert np.array_equal(np.asarray(pool.v_pool[:, fresh]),
+                              2 * stamp)
+    finally:
+        pool.close()
+
+
+def test_memprof_carries_pool_row():
+    pool = _pool(num_pages=4, name="t.memprof")
+    try:
+        pool.alloc()
+        rows = {p["name"]: p for p in memprof.report()["pools"]}
+        assert "t.memprof" in rows
+        row = rows["t.memprof"]
+        assert row["total_pages"] == 4 and row["pages_used"] == 1
+        assert row["page_bytes"] == pool.page_bytes
+    finally:
+        pool.close()
+    assert "t.memprof" not in {
+        p["name"] for p in memprof.report().get("pools", [])}
+
+
+# ---------------------------------------------------------------------------
+# PagedTransformerDecoder: the serving contracts
+# ---------------------------------------------------------------------------
+
+def _decode_solo(lm_params, prompt, max_new_tokens, name):
+    dec = _decoder(lm_params, slot_count=1, name=name)
+    try:
+        dec.warmup(verify=False)
+        stream = dec.submit(prompt, max_new_tokens=max_new_tokens)
+        dec.drain(max_iterations=500)
+        return stream.outputs()
+    finally:
+        dec.close()
+
+
+def test_batched_decode_bitwise_equals_solo(lm_params):
+    r = _rng(1)
+    prompts = [r.randint(0, VOCAB, size=n) for n in (3, 11, 20)]
+    dec = _decoder(lm_params, slot_count=3, name="pdec.bw")
+    try:
+        dec.warmup()
+        streams = [dec.submit(p, max_new_tokens=6) for p in prompts]
+        dec.drain(max_iterations=500)
+    finally:
+        dec.close()
+    for i, (p, s) in enumerate(zip(prompts, streams)):
+        toks, logits = s.outputs()
+        assert len(toks) == 6
+        ref_toks, ref_logits = _decode_solo(lm_params, p, 6,
+                                            "pdec.bw%d" % i)
+        assert toks == ref_toks
+        assert np.array_equal(logits, ref_logits), \
+            "co-batched stream %d not bitwise-equal to solo decode" % i
+
+
+def test_join_leave_steady_state_adds_zero_retraces(lm_params):
+    r = _rng(2)
+    dec = _decoder(lm_params, slot_count=2, name="pdec.zr")
+    try:
+        dec.warmup()  # verify=True: raises if the 2nd iteration traces
+        with executor_cache.watch_traces() as w:
+            first = dec.submit(r.randint(0, VOCAB, size=9),
+                               max_new_tokens=4)
+            dec.step()
+            dec.step()
+            # join mid-flight, then leave, then drain: every transition
+            # runs the same fixed-shape program
+            dec.submit(r.randint(0, VOCAB, size=17), max_new_tokens=5)
+            dec.drain(max_iterations=500)
+        assert w.total() == 0, w.delta()
+        assert first.done
+    finally:
+        dec.close()
+
+
+def test_prefix_hit_skips_prefill_and_cow_diverges(lm_params):
+    r = _rng(3)
+    dec = _decoder(lm_params, slot_count=2, page_size=8, name="pdec.pfx")
+    try:
+        dec.warmup()
+        shared = r.randint(0, VOCAB, size=2 * dec.page_size)
+        seed = dec.submit(shared, max_new_tokens=4)
+        dec.drain(max_iterations=500)
+        base_clones = dec.pool.stats()["cow_clones"]
+
+        # exact page multiple, fully cached: prefill is skipped down to
+        # the backed-off last token, whose K/V rewrite COW-clones the
+        # shared tail page
+        with executor_cache.watch_traces() as w:
+            again = dec.submit(shared, max_new_tokens=4)
+            iters = dec.drain(max_iterations=500)
+        assert w.total() == 0, w.delta()
+        assert again.prefix_pages == 2
+        # 4 iterations, not 4 + prefill: the backed-off last prompt
+        # token's forward IS the one that emits the first generated token
+        assert iters == 4
+        assert dec.pool.stats()["cow_clones"] == base_clones + 1
+        assert again.outputs()[0] == seed.outputs()[0]
+        assert np.array_equal(again.outputs()[1], seed.outputs()[1])
+
+        # shares one page then diverges: partial hit, no COW needed
+        forked = np.concatenate([shared[:dec.page_size],
+                                 r.randint(0, VOCAB, size=3)])
+        s2 = dec.submit(forked, max_new_tokens=4)
+        dec.drain(max_iterations=500)
+        assert s2.prefix_pages == 1
+        toks, logits = s2.outputs()
+    finally:
+        dec.close()
+    ref_toks, ref_logits = _decode_solo(lm_params, forked, 4, "pdec.pfx2")
+    assert toks == ref_toks and np.array_equal(logits, ref_logits), \
+        "prefix-cached stream not bitwise-equal to solo decode"
+
+
+def test_pool_exhaustion_sheds_the_stream_not_the_decoder(lm_params):
+    r = _rng(4)
+    # 2 pages of 8 tokens: two 7-token prompts each fit one page, but
+    # only one stream can grow into a second page
+    dec = _decoder(lm_params, slot_count=2, num_pages=2, page_size=8,
+                   name="pdec.shed")
+    try:
+        dec.warmup()
+        a = dec.submit(r.randint(0, VOCAB, size=7), max_new_tokens=8)
+        b = dec.submit(r.randint(0, VOCAB, size=7), max_new_tokens=8)
+        dec.drain(max_iterations=500)
+        shed, survived = (a, b) if a.error is not None else (b, a)
+        with pytest.raises(Overloaded):
+            shed.wait(1)
+        toks, _ = survived.outputs()
+        assert len(toks) == 8
+        # the decoder survives: the shed stream's pages were released,
+        # so a fresh small request still completes
+        c = dec.submit(r.randint(0, VOCAB, size=3), max_new_tokens=2)
+        dec.drain(max_iterations=500)
+        assert len(c.outputs()[0]) == 2
+    finally:
+        dec.close()
+
+
+def test_close_fails_unfinished_and_refuses_new(lm_params):
+    r = _rng(5)
+    dec = _decoder(lm_params, slot_count=2, name="pdec.close")
+    dec.warmup()
+    held = dec.submit(r.randint(0, VOCAB, size=5), max_new_tokens=30)
+    dec.step()
+    dec.close()
+    with pytest.raises(MXNetError, match="closed with the stream"):
+        held.wait(1)
+    assert dec.pool.pages_used() == 0  # close released the held pages
+    with pytest.raises(MXNetError, match="closed"):
+        dec.submit(r.randint(0, VOCAB, size=3))
+
+
+def test_submit_validates_prompt_and_context(lm_params):
+    dec = _decoder(lm_params, slot_count=1, name="pdec.val")
+    try:
+        with pytest.raises(MXNetError, match="at least one token"):
+            dec.submit(np.zeros((0,), np.int64))
+        with pytest.raises(MXNetError, match="exceeds max context"):
+            dec.submit(np.zeros((SEQ,), np.int64), max_new_tokens=8)
+    finally:
+        dec.close()
+
+
+def test_decoder_rejects_mismatched_pool_geometry(lm_params):
+    params, config = lm_params
+    wrong = KVBlockPool(LAYERS + 1, HEADS, EMBED // HEADS,
+                        num_pages=2, name="t.geom")
+    try:
+        with pytest.raises(MXNetError, match="geometry"):
+            PagedTransformerDecoder(params, config, slot_count=1,
+                                    pool=wrong, name="pdec.geom")
+    finally:
+        wrong.close()

@@ -128,6 +128,10 @@ TESTED_ELSEWHERE = {
     "signum_update": "tests/test_optimizer.py",
     "nag_mom_update": "tests/test_optimizer.py",
     "sgld_update": "tests/test_optimizer.py",
+    "scaled_dot_product_attention":
+        "tests/test_attention.py (vs exact-softmax reference, fwd+grad)",
+    "multi_head_attention":
+        "tests/test_attention.py (vs manual-projection oracle + flag contract)",
 }
 
 # ---------------------------------------------------------------------------

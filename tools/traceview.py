@@ -805,6 +805,7 @@ def serving_from_trace(events):
     batch_rows = {}
     rejects = {}
     replicas = {}
+    decode_iters, decode_joins, decode_active = 0, 0, []
     for e in events:
         ph, name = e.get("ph"), e.get("name", "")
         if ph == "X" and e.get("cat") == "serving":
@@ -814,6 +815,11 @@ def serving_from_trace(events):
                 requests.append(ms)
             elif name == "serving:queue":
                 queue.append(ms)
+            elif name == "serving:paged_decode_step":
+                decode_iters += 1
+                decode_joins += int(args.get("joins") or 0)
+                if args.get("active") is not None:
+                    decode_active.append(int(args["active"]))
             elif name == "serving:dispatch":
                 dispatch.append(ms)
                 if args.get("replica") is not None:
@@ -844,6 +850,21 @@ def serving_from_trace(events):
             "rows": rep["rows"],
             "p50": _percentile(ms, 0.50), "p95": _percentile(ms, 0.95),
             "p99": _percentile(ms, 0.99)})
+    decode = None
+    if decode_iters:
+        # pool gauges live in telemetry only; the trace form carries
+        # the per-iteration spans
+        sorted_active = sorted(decode_active)
+        decode = {
+            "iterations": decode_iters, "joins": decode_joins,
+            "leaves": None,
+            "active_p50": _percentile(sorted_active, 0.50),
+            "kv_pages_in_use": None, "kv_pages_total": None,
+            "kv_pages_high_water": None,
+            "prefix_lookups": None, "prefix_hits": None,
+            "kv_evictions": None, "kv_cow_clones": None,
+            "pages_per_stream_p50": None,
+        }
     return {
         "source": "trace (exact)",
         "requests": len(requests),
@@ -856,6 +877,7 @@ def serving_from_trace(events):
         "batch_rows": batch_rows,
         "rejects": rejects,
         "replicas": replica_rows,
+        "decode": decode,
         "slo": [],  # declared targets live in telemetry gauges only
     }
 
@@ -924,6 +946,31 @@ def serving_from_telemetry(metrics):
             "p50": _hist_quantile(mlat, 0.50),
             "p95": _hist_quantile(mlat, 0.95), "p99": p99,
             "met": bool(served) and target is not None and p99 <= target})
+    # continuous-decode / paged-KV page-pool rows (serving.decode.*)
+    def _val(name):
+        snap = metrics.get(name)
+        return snap.get("value") if isinstance(snap, dict) else None
+
+    decode = None
+    if any(name.startswith("serving.decode.") for name in metrics):
+        decode = {
+            "iterations": int(_val("serving.decode.iterations") or 0),
+            "joins": int(_val("serving.decode.joins") or 0),
+            "leaves": int(_val("serving.decode.leaves") or 0),
+            "active_p50": _hist_quantile(
+                metrics.get("serving.decode.active_slots", {}), 0.50),
+            "kv_pages_in_use": _val("serving.decode.kv_pages_in_use"),
+            "kv_pages_total": _val("serving.decode.kv_pages_total"),
+            "kv_pages_high_water":
+                _val("serving.decode.kv_pages_high_water"),
+            "prefix_lookups": _val("serving.decode.prefix_lookups"),
+            "prefix_hits": _val("serving.decode.prefix_hits"),
+            "kv_evictions": _val("serving.decode.kv_evictions"),
+            "kv_cow_clones": _val("serving.decode.kv_cow_clones"),
+            "pages_per_stream_p50": _hist_quantile(
+                metrics.get("serving.decode.kv_pages_per_stream", {}),
+                0.50),
+        }
     return {
         "source": "telemetry (interpolated histogram estimates)",
         "requests": lat.get("count", 0),
@@ -936,6 +983,7 @@ def serving_from_telemetry(metrics):
         "batch_rows": batch_rows,
         "rejects": rejects,
         "replicas": replica_rows,
+        "decode": decode,
         "slo": slo_rows,
     }
 
@@ -979,6 +1027,37 @@ def summarize_serving(kind, payload):
             lines.append("%-8d %10d %10d %10.3f %10.3f %10.3f"
                          % (rep["replica"], rep["dispatches"], rep["rows"],
                             rep["p50"], rep["p95"], rep["p99"]))
+    lines.append("")
+    lines.append("== serving: continuous decode / page pool ==")
+    dec = stats.get("decode")
+    if not dec:
+        lines.append("(no continuous-decode traffic recorded)")
+    else:
+        def _num(v, fmt="%d"):
+            return (fmt % v) if v is not None else "n/a"
+        lines.append("iterations: %s   joins: %s   leaves: %s   "
+                     "active p50: %.1f"
+                     % (_num(dec["iterations"]), _num(dec["joins"]),
+                        _num(dec["leaves"]), dec["active_p50"] or 0.0))
+        if dec["kv_pages_total"] is not None:
+            lines.append("kv pages: %s in use / %s total "
+                         "(high-water %s, per-stream p50 %.1f)"
+                         % (_num(dec["kv_pages_in_use"]),
+                            _num(dec["kv_pages_total"]),
+                            _num(dec["kv_pages_high_water"]),
+                            dec["pages_per_stream_p50"] or 0.0))
+            lookups = dec["prefix_lookups"] or 0
+            hits = dec["prefix_hits"] or 0
+            lines.append("prefix cache: %d hit page(s) / %d lookup(s)"
+                         " (ratio %.2f)   evictions: %s   "
+                         "cow clones: %s"
+                         % (hits, lookups,
+                            (hits / lookups) if lookups else 0.0,
+                            _num(dec["kv_evictions"]),
+                            _num(dec["kv_cow_clones"])))
+        else:
+            lines.append("(page-pool gauges live in telemetry — pass a "
+                         "telemetry dump for the kv/prefix rows)")
     lines.append("")
     lines.append("== serving: SLO attainment ==")
     if not stats.get("slo"):
@@ -1122,6 +1201,12 @@ def _waterfall_lines(record, width=30, max_segments=16):
         elif name == "decode_step":
             note = "slot=%s active=%s" % (s.get("slot", "?"),
                                           s.get("active", "?"))
+            if s.get("pages") is not None:
+                # paged-KV decode: the stream's table size, its reused
+                # prefix pages, and the pool occupancy at dispatch
+                note += " pages=%s prefix=%s pool=%s" % (
+                    s.get("pages"), s.get("prefix_pages", "?"),
+                    s.get("pool_in_use", "?"))
         elif name == "reject":
             note = str(s.get("reason", ""))
         lines.append("  %-11s %9.3f +%9.3fms |%-*s| %s"
