@@ -1083,18 +1083,11 @@ def slo_smoke():
 
     snap = telemetry.snapshot()
     mlat2 = snap.get("serving.request_latency_ms.mlp", {})
-    # overload-phase p99 estimated over the POST-phase-1 observations:
-    # subtract phase 1's bucket counts (same fixed geometry)
-    phase2 = dict(mlat2)
-    if lat_before.get("buckets") and phase2.get("buckets"):
-        phase2 = dict(phase2)
-        phase2["count"] = phase2["count"] - lat_before.get("count", 0)
-        phase2["buckets"] = [a - b for a, b in
-                             zip(phase2["buckets"], lat_before["buckets"])]
-        phase2["min"] = mlat2.get("min")
-        phase2["max"] = mlat2.get("max")
-    p99_2x = quantile_from_snapshot(phase2, 0.99) \
-        if phase2.get("count") else 0.0
+    # overload-phase p99 estimated over the POST-phase-1 observations
+    # only: the shared delta estimator subtracts phase 1's bucket counts
+    from mxnet_tpu.observability.telemetry import quantile_between
+    p99_2x = quantile_between(lat_before, mlat2, 0.99) \
+        if mlat2.get("count") else 0.0
     assert p99_2x <= slo_ms, (
         "served-request p99 %.1f ms blew the SLO %.1f ms under 2x "
         "overload — shedding failed to bound tail latency"
@@ -1147,6 +1140,209 @@ def slo_smoke():
         "replica_dispatches": {str(s["replica"]): s["dispatches"]
                                for s in stats},
         "telemetry": telem_path,
+    }))
+
+
+def alert_smoke():
+    """Fleet health-plane CI mode (`make bench-smoke`, `bench.py
+    --alert-smoke`): the time-series sampler + SLO burn-rate alerting
+    over the same 2-replica overload recipe as `--slo-smoke`, proving
+    the health plane's contracts:
+
+    1. **off by default, bitwise off**: with `MXNET_TPU_TS_INTERVAL_S`
+       unset nothing is spawned or sampled, and a fixed deterministic
+       request replay produces byte-identical responses (and identical
+       executor-cache trace counters) to the same replay with sampling
+       ON — observability must not perturb the observed;
+    2. **zero added retraces with sampling on**: the sampler ticking
+       through replay + overload leaves the retrace counters flat;
+    3. **the fast-burn rule provably trips and resolves**: a 2x+burst
+       open-loop overload drives typed sheds, the multi-window burn
+       rule (declared via `MXNET_TPU_ALERT_RULES` inline JSON — the env
+       parse path) records a `firing` transition in the flight-recorder
+       `alerts` ring with the window burn values that tripped it, and
+       calm 1x traffic afterwards records the `resolved` transition;
+    4. **the dashboards render**: `traceview --alerts` (flight dump)
+       and `traceview --dash` (shipped series dir) both exit 0, the
+       dash showing the shed-rate spike and p99-vs-SLO rows;
+    5. teardown is leak-clean: `stop_sampler()` joins the thread
+       (`threads.live_package_threads()` empty).
+    """
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+    from mxnet_tpu import executor_cache, serving, threads
+    from mxnet_tpu.observability import (alerts, flight_recorder,
+                                         telemetry, timeseries)
+
+    os.environ["MXNET_TPU_EXEC_CACHE"] = "1"
+    os.environ["MXNET_TPU_TELEMETRY"] = "1"
+    os.environ.pop("MXNET_TPU_TS_INTERVAL_S", None)
+    os.environ.pop("MXNET_TPU_TS_RING", None)
+    os.environ.pop("MXNET_TPU_ALERT_RULES", None)
+    os.environ.pop("MXNET_TPU_REQTRACE_CTX", None)
+    os.environ.pop("MXNET_TPU_SERVING_DEFAULT_DEADLINE_MS", None)
+
+    telemetry.reset()
+    timeseries.reset()
+    alerts.reset()
+    flight_recorder.reset()
+    executor_cache.clear()
+    executor_cache.reset_stats()
+
+    setup = _fleet_slo_setup()
+    fleet, rate_1x, slo_ms = (setup["fleet"], setup["rate_1x"],
+                              setup["slo_ms"])
+    rng, feat = setup["rng"], setup["feat"]
+
+    # fixed request sequence for the bitwise legs: sequential submits
+    # (each awaited) pin every request to its own padded bucket, so the
+    # byte stream is a pure function of the inputs
+    replay_rng = np.random.RandomState(7)
+    replay_reqs = [(rows, replay_rng.rand(rows, feat).astype(np.float32))
+                   for rows in [1, 2, 4, 8] * 6]
+
+    def replay_digest():
+        h = hashlib.sha256()
+        for _, payload in replay_reqs:
+            fut = fleet.submit_async("mlp", {"data": payload})
+            outs = fut.result(timeout=60)
+            h.update(np.ascontiguousarray(
+                np.asarray(outs[0]), dtype=np.float32).tobytes())
+        return h.hexdigest()
+
+    # -- leg 1: env unset — nothing sampled, bitwise baseline ---------------
+    timeseries.ensure_sampler()  # must no-op
+    assert timeseries.current_sampler() is None, \
+        "sampler started with MXNET_TPU_TS_INTERVAL_S unset"
+    with executor_cache.watch_traces() as watch_off:
+        sha_off = replay_digest()
+    traces_off = watch_off.total()
+    assert traces_off == 0, (
+        "retraces in the warmed replay: %s" % watch_off.delta())
+    assert len(timeseries.get_timeseries()) == 0, \
+        "samples recorded with sampling off"
+
+    # -- leg 2: sampling + an env-declared fast burn rule -------------------
+    ship_dir = tempfile.mkdtemp(prefix="mxnet_tpu_alert_smoke_")
+    os.environ["MXNET_TPU_TS_INTERVAL_S"] = "0.25"
+    # tight windows so a ~4 s overload trips and ~6 s of calm resolves;
+    # inline JSON exercises the MXNET_TPU_ALERT_RULES parse path
+    os.environ["MXNET_TPU_ALERT_RULES"] = json.dumps([{
+        "kind": "burn_rate", "name": "fast_burn.mlp", "model": "mlp",
+        "objective": 0.95, "fast_s": 2.0, "slow_s": 8.0, "burn": 2.0}])
+    alerts.reset()  # re-read the rules env
+    sampler = timeseries.start_sampler(ship_dir=ship_dir)
+    assert sampler is not None and sampler.alive
+
+    with executor_cache.watch_traces() as watch_on:
+        sha_on = replay_digest()
+
+        # overload: same 2x + 50x-burst shape as --slo-smoke, so the
+        # bounded queue provably sheds and the error budget burns
+        def payload_for(rows):
+            return rng.rand(rows, feat).astype(np.float32)
+
+        traffic = OpenLoopTraffic(
+            rate_1x, duration_s=4.0, max_rows=8, seed=2,
+            phases=[(1.0, 2.0), (1.0, 50.0), (2.0, 3.0)])
+        served, sheds, others = _collect_fleet_results(
+            traffic.run(lambda p, r: fleet.submit_async(
+                "mlp", {"data": p}), payload_for))
+        assert not others, others[:3]
+        assert sheds, "overload shed nothing — no error budget burned"
+        for exc in sheds:
+            assert isinstance(exc, serving.Overloaded), type(exc)
+
+        # calm 1x traffic, then wait for the fast window to cool
+        calm = OpenLoopTraffic(rate_1x, duration_s=3.0, max_rows=8,
+                               seed=3)
+        _collect_fleet_results(
+            calm.run(lambda p, r: fleet.submit_async(
+                "mlp", {"data": p}), payload_for))
+        engine = alerts.get_engine()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            hist = engine.history()
+            if any(r["state"] == "resolved"
+                   and r["rule"] == "fast_burn.mlp" for r in hist):
+                break
+            time.sleep(0.25)
+    traces_on = watch_on.total()
+
+    assert sha_on == sha_off, (
+        "sampling perturbed the served bytes: %s != %s"
+        % (sha_on[:16], sha_off[:16]))
+    assert traces_on == traces_off == 0, (
+        "sampling added retraces: %s" % watch_on.delta())
+
+    hist = engine.history()
+    fired = [r for r in hist if r["state"] == "firing"
+             and r["rule"] == "fast_burn.mlp"]
+    resolved = [r for r in hist if r["state"] == "resolved"
+                and r["rule"] == "fast_burn.mlp"]
+    assert fired, (
+        "overload never tripped the fast burn rule; history: %s" % hist)
+    assert resolved, (
+        "calm traffic never resolved the rule; history: %s" % hist)
+    fire_fast = fired[0]["windows"]["fast"]
+    assert fire_fast["burn"] >= 2.0 and fire_fast["rejected"] > 0, \
+        fired[0]
+    assert len(timeseries.get_timeseries()) >= 8, \
+        "sampler barely ticked"
+    n_samples = len(timeseries.get_timeseries())
+
+    # every transition also rode the flight-recorder alerts ring
+    n_flight_alerts = flight_recorder.get_recorder().alerts_recorded()
+    assert n_flight_alerts >= 2, (
+        "flight alerts ring holds %d record(s), want the firing + "
+        "resolved pair" % n_flight_alerts)
+
+    # leak-clean teardown BEFORE rendering (flushes the series file)
+    fleet.close(drain=True, timeout=30)
+    timeseries.stop_sampler()
+    assert not sampler.alive
+    leaked = threads.live_package_threads()
+    assert not leaked, "health plane leaked threads: %s" % leaked
+
+    # -- render: traceview --alerts (flight dump) + --dash (series dir) -----
+    dump_path = os.path.join(ship_dir, "flight.json")
+    flight_recorder.get_recorder().dump(dump_path)
+    traceview = _load_traceview()
+    with open(dump_path) as f:
+        dumped_alerts = traceview.alert_records(json.load(f))
+    assert any(r["state"] == "firing" for r in dumped_alerts), \
+        dumped_alerts
+    assert any(r["state"] == "resolved" for r in dumped_alerts), \
+        dumped_alerts
+    rc_alerts = traceview.main(["--alerts", dump_path])
+    assert rc_alerts == 0, "traceview --alerts exited %d" % rc_alerts
+    rc_dash = traceview.main(["--dash", ship_dir])
+    assert rc_dash == 0, "traceview --dash exited %d" % rc_dash
+    dash_stats = traceview.dash_stats(traceview.dash_sources(ship_dir))
+    assert dash_stats["shed_total"] >= len(sheds) * 0.5, dash_stats
+    assert any(m["model"] == "mlp" and m["slo_ms"]
+               for m in dash_stats["models"]), dash_stats["models"]
+
+    os.environ.pop("MXNET_TPU_TS_INTERVAL_S", None)
+    os.environ.pop("MXNET_TPU_ALERT_RULES", None)
+    shutil.rmtree(ship_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "bench_alert_smoke",
+        "slo_ms": round(slo_ms, 1),
+        "rate_1x_rps": round(rate_1x, 1),
+        "bitwise_off_vs_on": sha_off == sha_on,
+        "retraces_off": traces_off, "retraces_on": traces_on,
+        "samples": n_samples,
+        "overload": {"offered": len(traffic.schedule),
+                     "served": len(served), "shed": len(sheds)},
+        "fired": {"rule": fired[0]["rule"],
+                  "fast_burn": fire_fast["burn"],
+                  "shed_in_window": fire_fast["rejected"]},
+        "resolved": resolved[0]["windows"]["fast"]["burn"],
+        "flight_alert_records": n_flight_alerts,
     }))
 
 
@@ -3072,6 +3268,8 @@ if __name__ == "__main__":
         serve_smoke()
     elif "--slo-smoke" in sys.argv:
         slo_smoke()
+    elif "--alert-smoke" in sys.argv:
+        alert_smoke()
     elif "--decode-smoke" in sys.argv:
         decode_smoke()
     elif "--reqtrace-smoke" in sys.argv:

@@ -249,6 +249,12 @@ class Checkpointer:
         its health monitor when one already exists; a monitor created
         later registers the callback itself
         (``BaseModule._ensure_health_monitor``)."""
+        # an elastic training process is a fleet member too: with
+        # MXNET_TPU_TS_INTERVAL_S set it ships its series into the
+        # shared trace-root dir alongside the serving replicas (no-op
+        # when the env is unset)
+        from ..observability import timeseries as _timeseries
+        _timeseries.ensure_sampler()
         module._elastic_ckpt = self
         mon = getattr(module, "_health_mon", None)
         if mon is not None and self.note_anomaly not in mon.callbacks:

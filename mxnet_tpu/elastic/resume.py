@@ -242,6 +242,12 @@ def resume_fit(module, train_data, num_epoch, directory=None,
                     checkpointer=checkpointer, kvstore=kvstore,
                     optimizer=optimizer, optimizer_params=optimizer_params,
                     expect_warm=expect_warm, comm_measure=comm_measure)
+    # a resumed (often respawned) worker rejoins the fleet health
+    # plane: the inherited MXNET_TPU_REQTRACE_CTX root routes its
+    # shipped series into the same dir as the parent's (no-op when
+    # MXNET_TPU_TS_INTERVAL_S is unset)
+    from ..observability import timeseries as _timeseries
+    _timeseries.ensure_sampler()
     report.checkpointer.attach(module)
     it = _SkipFirstEpochIter(train_data, report.skip_batches) \
         if report.skip_batches else train_data

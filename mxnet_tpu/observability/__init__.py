@@ -41,6 +41,19 @@ Three layers, lowest first:
   counts) behind ``MXNET_TPU_AUTOTUNE=recommend|apply|0``, every
   decision a structured record riding the flight recorder
   (docs/autotune.md).
+- ``timeseries`` — the health plane's TREND layer: a bounded ring of
+  timestamped registry snapshots (``MXNET_TPU_TS_INTERVAL_S``; sampler
+  thread via ``threads.spawn``) with windowed signals — counter rates,
+  gauge min/mean/max, histogram delta quantiles
+  (docs/observability.md §health-plane).
+- ``alerts`` — declarative alert rules over those windows: threshold,
+  absence, and multi-window SLO burn-rate rules (auto-discovered per
+  served model, extended via ``MXNET_TPU_ALERT_RULES``); every
+  firing/resolve a flight-recorder ``alerts`` record +
+  ``health.alerts.*`` counters (``traceview --alerts``).
+- ``shipper`` — per-process JSON-lines series in a fleet-shared dir
+  keyed by the env-propagated reqtrace root, so replicas and elastic
+  children merge onto one ``traceview --dash`` timeline.
 
 Every callsite stays OUTSIDE jitted bodies: instrumentation must never
 change a traced program (the exec-cache trace counters prove it adds
@@ -56,12 +69,15 @@ from . import health
 from . import memprof
 from . import reqtrace
 from . import autotune
+from . import timeseries
+from . import alerts
+from . import shipper
 from .tracing import span, emit_instant
 from .telemetry import counter, gauge, histogram, snapshot
 from .health import HealthMonitor, TrainingDivergedError
 
 __all__ = ["tracing", "telemetry", "instrument", "flight_recorder",
-           "health", "memprof", "reqtrace", "autotune", "span",
-           "emit_instant",
+           "health", "memprof", "reqtrace", "autotune", "timeseries",
+           "alerts", "shipper", "span", "emit_instant",
            "counter", "gauge", "histogram", "snapshot", "HealthMonitor",
            "TrainingDivergedError"]

@@ -1,0 +1,382 @@
+"""Declarative alert rules over the time-series health plane.
+
+Three rule kinds, evaluated on every sampler tick against the
+``timeseries.TimeSeries`` ring:
+
+- **threshold** — a windowed signal field compared against a bound
+  (``serving.queue_depth`` mean > 12 over 30 s, say)
+- **absence** — a signal that stopped being produced (no new counter
+  increments / histogram observations across a window, or the
+  instrument missing outright): the heartbeat rule
+- **burn_rate** — multi-window SLO error-budget burn in the Google SRE
+  mold, built per served model from ``serving.request_latency_ms.<m>``
+  vs the declared ``serving.slo_ms.<m>`` gauge plus the typed
+  ``serving.rejected_total.*`` sheds.  Over a window::
+
+      error_ratio = (SLO-breaching served + sheds) / (served + sheds)
+      burn        = error_ratio / (1 - objective)
+
+  The rule fires when BOTH the fast and the slow window burn above the
+  threshold (the slow window guards against blips) and resolves when
+  the fast window alone drops back under (quick resolve — the standard
+  multi-window hysteresis).
+
+Burn-rate rules are auto-discovered from ``serving.slo_ms.<model>``
+gauges; ``MXNET_TPU_ALERT_RULES`` (inline JSON list or a file path)
+adds declarative rules on top.  Every firing/resolve transition is a
+structured record in the flight-recorder ``alerts`` ring plus
+``health.alerts.*`` counters/gauge and a tracing instant — the same
+surfacing triple the health sentinel uses for anomalies.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from .. import threads as _threads
+from . import flight_recorder as _flight
+from . import telemetry, tracing
+
+ENV_RULES = "MXNET_TPU_ALERT_RULES"
+
+DEFAULT_OBJECTIVE = 0.99   # 99% of requests served inside SLO
+DEFAULT_FAST_S = 60.0
+DEFAULT_SLOW_S = 300.0
+DEFAULT_BURN = 6.0         # x the sustainable budget spend rate
+
+TRANSITION_HISTORY = 256
+
+logger = logging.getLogger(__name__)
+
+_lock = _threads.package_lock("alerts._lock")
+_engine = None
+_warned = set()
+
+
+def _warn_once(key, msg, *args):
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg, *args)
+
+
+class Rule:
+    """One named alert rule.  ``evaluate`` returns ``(firing, info)``;
+    ``info`` carries the windows and values that justify the verdict —
+    it becomes the body of the firing/resolve record."""
+
+    kind = "rule"
+
+    def __init__(self, name):
+        self.name = name
+
+    def evaluate(self, series, now=None, prior=False):
+        raise NotImplementedError
+
+
+_OPS = {">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+        "<": lambda a, b: a < b, "<=": lambda a, b: a <= b}
+
+
+class ThresholdRule(Rule):
+    """Windowed signal field vs a bound.  ``field`` names a key of the
+    ``TimeSeries.window`` result (``rate_per_s``, ``delta``, ``mean``,
+    ``max``, ``last``, ...); on histogram signals a ``p<NN>`` field
+    (``p99``) evaluates the delta quantile."""
+
+    kind = "threshold"
+
+    def __init__(self, name, signal, field="rate_per_s", op=">",
+                 value=0.0, window_s=60.0):
+        super().__init__(name)
+        if op not in _OPS:
+            raise ValueError("unknown op %r (want one of %s)"
+                             % (op, sorted(_OPS)))
+        self.signal = signal
+        self.field = field
+        self.op = op
+        self.value = float(value)
+        self.window_s = float(window_s)
+
+    def _extract(self, w):
+        if (w.get("kind") == "histogram" and len(self.field) > 1
+                and self.field[0] == "p" and self.field[1:].isdigit()):
+            return telemetry.quantile_from_snapshot(
+                w["delta"], int(self.field[1:]) / 100.0)
+        v = w.get(self.field)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    def evaluate(self, series, now=None, prior=False):
+        info = {"signal": self.signal, "field": self.field, "op": self.op,
+                "threshold": self.value,
+                "windows": {"window": {"window_s": self.window_s,
+                                       "value": None}}}
+        w = series.window(self.signal, self.window_s, now=now)
+        if w is None:
+            return False, info
+        v = self._extract(w)
+        if v is None:
+            return False, info
+        info["windows"]["window"]["value"] = round(v, 6)
+        return _OPS[self.op](v, self.value), info
+
+
+class AbsenceRule(Rule):
+    """Fires when a signal stops: the instrument is missing from every
+    sample in the window, or (counter/histogram) it produced zero new
+    observations across >= 2 samples.  Needs at least two ring samples
+    in the window before it can fire — a cold start is not an outage."""
+
+    kind = "absence"
+
+    def __init__(self, name, signal, window_s=60.0):
+        super().__init__(name)
+        self.signal = signal
+        self.window_s = float(window_s)
+
+    def evaluate(self, series, now=None, prior=False):
+        samples = series.samples(self.window_s, now=now)
+        info = {"signal": self.signal,
+                "windows": {"window": {"window_s": self.window_s,
+                                       "samples": len(samples),
+                                       "value": None}}}
+        if len(samples) < 2:
+            return False, info
+        w = series.window(self.signal, self.window_s, now=now)
+        if w is None:
+            return True, info
+        if w["kind"] == "counter":
+            info["windows"]["window"]["value"] = w["delta"]
+            return (w["samples"] >= 2 and w["delta"] == 0
+                    and not w["resets"]), info
+        if w["kind"] == "histogram":
+            info["windows"]["window"]["value"] = w["count"]
+            return (w["samples"] >= 2 and w["count"] == 0
+                    and not w["resets"]), info
+        info["windows"]["window"]["value"] = w.get("last")
+        return False, info  # a present gauge is never "absent"
+
+
+class BurnRateRule(Rule):
+    """Multi-window SLO error-budget burn for one served model (see the
+    module docstring for the arithmetic and hysteresis)."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name, model, objective=DEFAULT_OBJECTIVE,
+                 fast_s=DEFAULT_FAST_S, slow_s=DEFAULT_SLOW_S,
+                 burn=DEFAULT_BURN):
+        super().__init__(name)
+        self.model = model
+        self.objective = float(objective)
+        self.budget = max(1e-9, 1.0 - self.objective)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.burn = float(burn)
+
+    def _window_burn(self, series, seconds, now):
+        lat = series.window("serving.request_latency_ms.%s" % self.model,
+                            seconds, now=now)
+        slo_w = series.window("serving.slo_ms.%s" % self.model,
+                              seconds, now=now)
+        slo_ms = slo_w["last"] if slo_w else None
+        served = lat["count"] if lat else 0
+        breaching = 0.0
+        if lat is not None and slo_ms and served:
+            breaching = telemetry.fraction_over(lat["delta"],
+                                                slo_ms) * served
+        rejected = 0.0
+        for cname in series.names("serving.rejected_total."):
+            cw = series.window(cname, seconds, now=now)
+            if cw is not None and cw["kind"] == "counter":
+                rejected += cw["delta"]
+        total = served + rejected
+        ratio = ((breaching + rejected) / total) if total > 0 else 0.0
+        return {"window_s": float(seconds),
+                "burn": round(ratio / self.budget, 4),
+                "error_ratio": round(ratio, 6),
+                "served": served, "rejected": rejected,
+                "breaching": round(breaching, 2), "slo_ms": slo_ms}
+
+    def evaluate(self, series, now=None, prior=False):
+        fast = self._window_burn(series, self.fast_s, now)
+        slow = self._window_burn(series, self.slow_s, now)
+        info = {"model": self.model, "objective": self.objective,
+                "burn_threshold": self.burn,
+                "windows": {"fast": fast, "slow": slow}}
+        if prior:  # already firing: resolve only when the fast window cools
+            firing = fast["burn"] >= self.burn
+        else:
+            firing = (fast["burn"] >= self.burn
+                      and slow["burn"] >= self.burn)
+        return firing, info
+
+
+class AlertEngine:
+    """Rule set + firing state.  ``evaluate()`` runs every rule against
+    the ring, records each firing/resolve transition in the flight
+    ``alerts`` ring + ``health.alerts.*`` counters + a tracing instant,
+    and keeps a bounded transition history for direct inspection.  With
+    ``auto_slo_burn`` (default) a :class:`BurnRateRule` is synthesized
+    for every model that declares a ``serving.slo_ms.<model>`` gauge."""
+
+    def __init__(self, rules=None, auto_slo_burn=True):
+        self._lock = _threads.package_lock("AlertEngine._lock")
+        self.rules = list(rules or ())
+        self.auto_slo_burn = auto_slo_burn
+        self._auto = {}     # model -> BurnRateRule
+        self._state = {}    # rule name -> {"firing", "since"}
+        self._history = []  # bounded transition records, oldest first
+
+    def _discover(self, series):
+        if not self.auto_slo_burn:
+            return
+        explicit = {r.model for r in self.rules
+                    if isinstance(r, BurnRateRule)}
+        for name in series.names("serving.slo_ms."):
+            model = name[len("serving.slo_ms."):]
+            if model and model not in self._auto \
+                    and model not in explicit:
+                self._auto[model] = BurnRateRule("slo_burn.%s" % model,
+                                                 model)
+
+    def all_rules(self):
+        with self._lock:
+            return self.rules + list(self._auto.values())
+
+    def firing(self):
+        """Names of the rules currently in the firing state."""
+        with self._lock:
+            return sorted(n for n, s in self._state.items()
+                          if s["firing"])
+
+    def history(self):
+        """Bounded copy of the firing/resolve transition records."""
+        with self._lock:
+            return list(self._history)
+
+    def evaluate(self, series, now=None):
+        """One evaluation pass; returns the transition records (possibly
+        empty).  Rule exceptions are contained per rule — alerting must
+        never take the sampled process down."""
+        t = float(now) if now is not None else time.time()
+        transitions = []
+        with self._lock:
+            self._discover(series)
+            rules = self.rules + list(self._auto.values())
+            for rule in rules:
+                st = self._state.setdefault(rule.name,
+                                            {"firing": False, "since": None})
+                try:
+                    firing, info = rule.evaluate(series, now=t,
+                                                 prior=st["firing"])
+                except Exception:
+                    logger.exception("alert rule %s failed", rule.name)
+                    continue
+                if bool(firing) == st["firing"]:
+                    continue
+                st["firing"] = bool(firing)
+                st["since"] = t
+                transitions.append(dict(
+                    info, rule=rule.name, kind=rule.kind,
+                    state="firing" if firing else "resolved",
+                    t=round(t, 6)))
+            self._history.extend(transitions)
+            del self._history[:-TRANSITION_HISTORY]
+            firing_now = sum(1 for s in self._state.values() if s["firing"])
+        # surfacing happens outside the engine lock (telemetry and the
+        # flight recorder take their own package locks)
+        for rec in transitions:
+            _flight.note_alert(dict(rec))
+            which = "fired" if rec["state"] == "firing" else "resolved"
+            telemetry.counter("health.alerts.%s_total" % which).inc()
+            telemetry.counter("health.alerts.%s_total.%s"
+                              % (which, rec["rule"])).inc()
+            tracing.emit_instant("alert_%s:%s" % (rec["state"], rec["rule"]),
+                                 category="health",
+                                 args={"kind": rec["kind"],
+                                       "windows": rec.get("windows")})
+        telemetry.gauge("health.alerts.firing").set(firing_now)
+        return transitions
+
+
+# -- declarative rule specs (MXNET_TPU_ALERT_RULES) --------------------------
+
+def rule_from_spec(spec):
+    """One rule from its JSON spec dict (schema: docs/observability.md
+    §health-plane).  Returns None (with a warn-once) on a malformed
+    spec — one bad rule must not discard the rest."""
+    try:
+        kind = spec.get("kind")
+        if kind == "threshold":
+            return ThresholdRule(
+                spec.get("name") or "threshold.%s" % spec["signal"],
+                spec["signal"], field=spec.get("field", "rate_per_s"),
+                op=spec.get("op", ">"), value=spec.get("value", 0.0),
+                window_s=spec.get("window_s", 60.0))
+        if kind == "absence":
+            return AbsenceRule(
+                spec.get("name") or "absence.%s" % spec["signal"],
+                spec["signal"], window_s=spec.get("window_s", 60.0))
+        if kind == "burn_rate":
+            return BurnRateRule(
+                spec.get("name") or "slo_burn.%s" % spec["model"],
+                spec["model"],
+                objective=spec.get("objective", DEFAULT_OBJECTIVE),
+                fast_s=spec.get("fast_s", DEFAULT_FAST_S),
+                slow_s=spec.get("slow_s", DEFAULT_SLOW_S),
+                burn=spec.get("burn", DEFAULT_BURN))
+        raise ValueError("unknown rule kind %r" % kind)
+    except (KeyError, TypeError, ValueError) as exc:
+        _warn_once("spec:%r" % (spec,),
+                   "%s: skipping malformed rule spec %r (%s)",
+                   ENV_RULES, spec, exc)
+        return None
+
+
+def rules_from_env():
+    """Rules declared via ``MXNET_TPU_ALERT_RULES``: an inline JSON
+    list, or a path to a file holding one.  Malformed input warns once
+    and contributes no rules (alerting degrades to the auto-discovered
+    SLO burn rules; it never raises into serving)."""
+    raw = os.environ.get(ENV_RULES, "").strip()
+    if not raw:
+        return []
+    text = raw
+    if not raw.startswith("["):
+        try:
+            with open(raw, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as exc:
+            _warn_once("path:" + raw, "%s: cannot read rules file %r (%s)",
+                       ENV_RULES, raw, exc)
+            return []
+    try:
+        doc = json.loads(text)
+        if not isinstance(doc, list):
+            raise ValueError("top-level JSON must be a list")
+    except ValueError as exc:
+        _warn_once("json:" + raw, "%s: malformed rules JSON (%s)",
+                   ENV_RULES, exc)
+        return []
+    return [r for r in (rule_from_spec(s) for s in doc) if r is not None]
+
+
+def get_engine():
+    """The process alert engine the sampler evaluates: env-declared
+    rules plus auto-discovered per-model SLO burn rules."""
+    global _engine
+    with _lock:
+        if _engine is None:
+            _engine = AlertEngine(rules=rules_from_env())
+        return _engine
+
+
+def reset():
+    """Tests / between bench passes: drop the engine (state, history,
+    auto-discovered rules) and re-arm the warn-once latches."""
+    global _engine
+    with _lock:
+        _engine = None
+        _warned.clear()

@@ -52,6 +52,7 @@ LOG_CAPACITY = 200
 EVENT_CAPACITY = 64
 DECISION_CAPACITY = 128
 ELASTIC_CAPACITY = 128
+ALERT_CAPACITY = 128
 
 # env fingerprint: every knob that could explain a divergence later
 _FINGERPRINT_PREFIXES = ("MXNET_TPU_", "JAX_", "XLA_", "DMLC_")
@@ -131,6 +132,7 @@ class FlightRecorder:
         self._logs = deque(maxlen=LOG_CAPACITY)
         self._decisions = deque(maxlen=DECISION_CAPACITY)
         self._elastic = deque(maxlen=ELASTIC_CAPACITY)
+        self._alerts = deque(maxlen=ALERT_CAPACITY)
         self._anomalies = []
         self._handler = None
         self._dumped_reasons = set()
@@ -222,6 +224,20 @@ class FlightRecorder:
                 if entry.get("kind") == "checkpoint":
                     return entry.get("step")
         return None
+
+    def note_alert(self, record):
+        """One alert-engine transition (firing/resolved, with the
+        windows and values that tripped the rule) — its own bounded
+        ring so ``tools/traceview.py --alerts`` can reconstruct the
+        firing history from any dump (``observability/alerts.py``)."""
+        entry = dict(record)
+        entry.setdefault("t", time.time())
+        with self._lock:
+            self._alerts.append(entry)
+
+    def alerts_recorded(self):
+        with self._lock:
+            return len(self._alerts)
 
     def note_anomaly(self, record):
         """A fired health anomaly (called by ``HealthMonitor``)."""
@@ -338,6 +354,7 @@ class FlightRecorder:
                 "logs": list(self._logs),
                 "tuning": list(self._decisions),
                 "elastic": list(self._elastic),
+                "alerts": list(self._alerts),
             }
         doc["telemetry"] = telemetry_snap
         doc["requests"] = requests_pinned
@@ -406,6 +423,10 @@ def note_exception(exc):
 
 def note_elastic(record):
     get_recorder().note_elastic(record)
+
+
+def note_alert(record):
+    get_recorder().note_alert(record)
 
 
 def dump(path=None, reason="on_demand", sections=None):

@@ -1,0 +1,104 @@
+"""Fleet series shipper: per-process JSON-lines time series in one
+shared directory keyed by the env-propagated trace root.
+
+Every sampling process — the parent, fleet replicas, elastic/chaos
+children — appends to its OWN ``series_<pid>.jsonl`` file inside a
+directory derived from the PR 15 reqtrace root: because
+``MXNET_TPU_REQTRACE_CTX`` (``<root>:<epoch0>``) is written back into
+the environment by the first ``trace_root()`` call, every subprocess
+inherits the same root and converges on the same directory with no
+coordination and no cross-process locks.  ``traceview --dash <dir>``
+merges the files onto one timeline using the shared wall-clock epoch
+(``rel = t - epoch0``), exactly how ``--fleet`` reconciles request
+dumps.
+
+File format (one JSON object per line):
+
+- ``{"kind": "header", "version": 1, "fleet": {root, epoch0, pid},
+  "prefixes": [...]}`` — first line, the correlation header
+- ``{"kind": "sample", "t", "rel", "gen", "series": {name: snap}}`` —
+  one per sampler tick, ``series`` filtered to the shipped prefixes
+- ``{"kind": "alert", ...transition record...}`` — every firing/resolve
+  the alert engine emitted on that tick
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from .. import threads as _threads
+from . import reqtrace, telemetry
+
+# shipped signal families: what the dashboard and the burn-rate rules
+# read.  Everything else stays local (the full registry is always
+# available via telemetry exports / flight dumps).
+SHIP_PREFIXES = ("serving.", "health.", "elastic.")
+
+
+def default_dir(root_id=None):
+    """The fleet-shared series directory: keyed by the reqtrace root so
+    every process inheriting ``MXNET_TPU_REQTRACE_CTX`` lands in the
+    same place.  Calling this establishes the root if none exists yet
+    (same contract as the reqtrace dump path)."""
+    if root_id is None:
+        root_id, _ = reqtrace.trace_root()
+    return os.path.join(tempfile.gettempdir(), "mxnet_tpu_ts_%s" % root_id)
+
+
+class SeriesShipper:
+    """Append-only JSON-lines writer for this process's series.  The
+    file (and the trace root it is keyed by) is created lazily on the
+    first ship, so constructing a shipper costs nothing until sampling
+    actually produces a line."""
+
+    def __init__(self, dirpath=None, prefixes=SHIP_PREFIXES):
+        self.dirpath = dirpath
+        self.prefixes = tuple(prefixes)
+        self.path = None
+        self._lock = _threads.package_lock("SeriesShipper._lock")
+        self._fh = None
+
+    def _ensure_open(self):
+        if self._fh is not None:
+            return
+        if self.dirpath is None:
+            self.dirpath = default_dir()
+        os.makedirs(self.dirpath, exist_ok=True)
+        fleet = reqtrace.fleet_header()
+        self.path = os.path.join(self.dirpath,
+                                 "series_%d.jsonl" % fleet["pid"])
+        # append mode: a stop/start cycle in one process extends its
+        # file rather than truncating history mid-incident
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._write({"kind": "header", "version": 1, "fleet": fleet,
+                     "prefixes": list(self.prefixes)})
+
+    def _write(self, obj):
+        self._fh.write(json.dumps(obj, sort_keys=True, default=str) + "\n")
+        self._fh.flush()
+
+    def _series(self, snapshot):
+        return {name: telemetry._json_safe(snap)
+                for name, snap in snapshot.items()
+                if name.startswith(self.prefixes)}
+
+    def ship(self, entry, transitions=()):
+        """Write one sampler tick: the sample line (filtered registry
+        series) plus one alert line per engine transition.  ``entry``
+        is the ``TimeSeries`` ring entry for the tick."""
+        with self._lock:
+            self._ensure_open()
+            epoch0 = reqtrace.fleet_header()["epoch0"]
+            self._write({"kind": "sample", "t": round(entry["t"], 6),
+                         "rel": round(entry["t"] - epoch0, 6),
+                         "gen": entry["gen"],
+                         "series": self._series(entry["snapshot"])})
+            for rec in transitions or ():
+                self._write(dict(rec, kind="alert"))
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

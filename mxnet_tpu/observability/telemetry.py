@@ -56,11 +56,12 @@ class Counter:
     millisecond totals accumulate here too)."""
 
     kind = "counter"
-    __slots__ = ("name", "help", "_lock", "_value")
+    __slots__ = ("name", "help", "gen", "_lock", "_value")
 
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
+        self.gen = _epoch
         self._lock = _threads.package_lock("Counter._lock")
         self._value = 0.0
 
@@ -75,7 +76,7 @@ class Counter:
         return self._value
 
     def _snapshot(self):
-        return {"type": self.kind, "value": self._value}
+        return {"type": self.kind, "value": self._value, "gen": self.gen}
 
 
 class Gauge:
@@ -83,11 +84,12 @@ class Gauge:
     at snapshot time — the device-memory gauge uses the latter."""
 
     kind = "gauge"
-    __slots__ = ("name", "help", "_value", "_fn")
+    __slots__ = ("name", "help", "gen", "_value", "_fn")
 
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
+        self.gen = _epoch
         self._value = 0.0
         self._fn = None
 
@@ -109,7 +111,7 @@ class Gauge:
         return self._value
 
     def _snapshot(self):
-        return {"type": self.kind, "value": self.value}
+        return {"type": self.kind, "value": self.value, "gen": self.gen}
 
 
 class Histogram:
@@ -117,12 +119,13 @@ class Histogram:
     plus sum/count/min/max.  ``observe`` is numpy-free and O(1)."""
 
     kind = "histogram"
-    __slots__ = ("name", "help", "_lock", "buckets", "sum", "count",
+    __slots__ = ("name", "help", "gen", "_lock", "buckets", "sum", "count",
                  "min", "max")
 
     def __init__(self, name, help=""):
         self.name = name
         self.help = help
+        self.gen = _epoch
         self._lock = _threads.package_lock("Histogram._lock")
         self.buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # +1 overflow
         self.sum = 0.0
@@ -172,7 +175,8 @@ class Histogram:
                     "sum": self.sum,
                     "min": self.min if self.count else None,
                     "max": self.max if self.count else None,
-                    "buckets": list(self.buckets)}
+                    "buckets": list(self.buckets),
+                    "gen": self.gen}
 
 
 class _Noop:
@@ -182,6 +186,7 @@ class _Noop:
 
     kind = "noop"
     name = "<disabled>"
+    gen = 0
     value = 0.0
     count = 0
     sum = 0.0
@@ -269,6 +274,123 @@ def quantile_from_snapshot(snap, q):
     return est
 
 
+# -- delta derivation --------------------------------------------------------
+#
+# Consumers that diff two snapshots of the same instrument (timeseries
+# windows, autotune controllers, traceview) share these helpers so a
+# ``reset()`` between the snapshots — detectable via the ``gen`` token
+# every snapshot carries — surfaces as an explicit reset marker instead
+# of negative rates/counts.
+
+def generation_changed(snap_a, snap_b):
+    """True when ``reset()`` ran between the two snapshots: the
+    instrument behind ``snap_b`` is a re-registered object whose totals
+    restarted from zero, so subtracting ``snap_a`` would go negative."""
+    return snap_a.get("gen") != snap_b.get("gen")
+
+
+def counter_delta(snap_a, snap_b):
+    """Increase of a counter/gauge value between two snapshots (older
+    first).  Returns ``(delta, reset)``: on a generation change — or a
+    bare value decrease, the same event seen through a generation-less
+    legacy snapshot — the total restarted, so the delta is ``snap_b``'s
+    whole value and ``reset`` is True.  ``snap_a`` may be falsy (no
+    baseline: the instrument registered mid-window), which is a plain
+    from-zero delta, not a reset."""
+    vb = float(snap_b.get("value", 0.0) or 0.0)
+    if not snap_a:
+        return vb, False
+    va = float(snap_a.get("value", 0.0) or 0.0)
+    if generation_changed(snap_a, snap_b) or vb < va:
+        return vb, True
+    return vb - va, False
+
+
+def delta_snapshot(snap_a, snap_b):
+    """Histogram snapshot of only the observations made between two
+    snapshots of the same instrument (older first): per-bucket count
+    differences, sum/count differences, bounds clamped to ``snap_b``'s
+    recorded min/max (loose but valid bounds for the delta
+    observations).  A generation change — or any negative difference,
+    its generation-less shadow — means the histogram restarted between
+    the snapshots: the delta is ``snap_b`` alone and the result carries
+    ``"reset": True``.  A falsy ``snap_a`` (no baseline) is a plain
+    from-zero delta."""
+    if not snap_a:
+        out = dict(snap_b)
+        out["reset"] = False
+        return out
+    ba = snap_a.get("buckets") or []
+    bb = snap_b.get("buckets") or []
+    ca = snap_a.get("count", 0) or 0
+    cb = snap_b.get("count", 0) or 0
+    reset = generation_changed(snap_a, snap_b)
+    diff = []
+    if not reset:
+        if cb < ca or len(ba) != len(bb):
+            reset = True
+        else:
+            diff = [y - x for x, y in zip(ba, bb)]
+            if any(d < 0 for d in diff):
+                reset = True
+    if reset:
+        out = dict(snap_b)
+        out["reset"] = True
+        return out
+    count = cb - ca
+    out = {"type": "histogram", "count": count,
+           "sum": ((snap_b.get("sum", 0.0) or 0.0)
+                   - (snap_a.get("sum", 0.0) or 0.0)),
+           "min": snap_b.get("min") if count else None,
+           "max": snap_b.get("max") if count else None,
+           "buckets": diff, "reset": False}
+    if "gen" in snap_b:
+        out["gen"] = snap_b["gen"]
+    return out
+
+
+def quantile_between(snap_a, snap_b, q):
+    """The documented delta form of :func:`quantile_from_snapshot`:
+    quantile estimate over only the observations made between two
+    snapshots of the same histogram, via :func:`delta_snapshot` bucket
+    differences.  Same interpolation contract as the cumulative form —
+    empty delta returns 0.0, a single-distinct-value delta returns that
+    value for every q, the overflow bucket interpolates toward the
+    recorded max.  A reset between the snapshots degrades gracefully to
+    the quantile of ``snap_b`` alone (flagged by ``delta_snapshot``)."""
+    return quantile_from_snapshot(delta_snapshot(snap_a, snap_b), q)
+
+
+def fraction_over(snap, threshold):
+    """Estimated fraction of a histogram snapshot's observations that
+    exceed ``threshold`` — the latency-breach side of an SLO error
+    budget, usually fed a :func:`delta_snapshot`.  Counts whole buckets
+    above the threshold and interpolates linearly inside the straddling
+    bucket (tightened to the recorded min/max), consistent with the
+    quantile estimator.  Empty histogram returns 0.0."""
+    count = snap.get("count", 0) or 0
+    if count <= 0:
+        return 0.0
+    threshold = float(threshold)
+    mn = _snap_bound(snap, "min")
+    mx = _snap_bound(snap, "max")
+    if mx is not None and mx <= threshold:
+        return 0.0
+    if mn is not None and mn > threshold:
+        return 1.0
+    over = 0.0
+    for lo, hi, n in iter_bucket_ranges(snap):
+        if mx is not None:
+            hi = min(hi, mx)
+        if mn is not None:
+            lo = max(lo, mn)
+        if threshold <= lo:
+            over += n
+        elif threshold < hi:
+            over += n * (hi - threshold) / (hi - lo)
+    return max(0.0, min(1.0, over / count))
+
+
 def _get(name, cls, help):
     if not enabled():
         return NOOP
@@ -300,7 +422,11 @@ def histogram(name, help=""):
 
 def reset():
     """Drop every registered metric (tests / between bench passes).
-    Bumps the registry epoch so cached handles re-resolve."""
+    Bumps the registry epoch so cached handles re-resolve; instruments
+    registered after the reset carry the new epoch as their ``gen``
+    snapshot token, which is how snapshot-diffing consumers
+    (:func:`counter_delta` / :func:`delta_snapshot`) tell a restart
+    from a decrease."""
     global _epoch
     with _lock:
         _metrics.clear()

@@ -1,0 +1,347 @@
+"""Time-series health plane: trended signals over the metrics registry.
+
+PR 3's registry answers "what is true *now*"; the control plane needs
+"what is true *over the last N seconds*" — queue depth, shed rate, and
+SLO attainment as trends an autoscaler or alert rule can act on.  This
+module keeps a bounded ring of timestamped registry snapshots and
+derives **windowed signals** from snapshot differences:
+
+- counter    -> increase + rate/s over the window
+- gauge      -> min / mean / max / last over the window
+- histogram  -> *delta* quantiles: the shared ``quantile_from_snapshot``
+  estimator applied to bucket differences (``telemetry.delta_snapshot``),
+  so "p99 over the last 30 s" ignores everything older
+
+A ``telemetry.reset()`` inside a window is detected via the generation
+token every snapshot carries and surfaces as a ``resets`` count with the
+straddling span excluded — never a negative rate.
+
+Sampling is pull-based and optional: ``MXNET_TPU_TS_INTERVAL_S`` (unset
+= off, the default) starts a daemon sampler thread via ``threads.spawn``
+on the first ``ensure_sampler()`` call (Server/FleetServer construction,
+elastic resume/attach).  Each tick appends one ring sample, evaluates
+the alert rules (``alerts.AlertEngine``), and ships a JSON line to the
+fleet-shared series dir (``shipper.SeriesShipper``) for ``traceview
+--dash``.  With the env unset nothing is spawned, nothing is sampled,
+and runs stay bitwise identical.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+from .. import threads as _threads
+from . import telemetry
+
+ENV_INTERVAL = "MXNET_TPU_TS_INTERVAL_S"
+ENV_RING = "MXNET_TPU_TS_RING"
+DEFAULT_RING = 512
+
+logger = logging.getLogger(__name__)
+
+_state_lock = _threads.package_lock("timeseries._state_lock")
+_series = None        # process-wide TimeSeries (lazily created)
+_sampler = None       # running _Sampler, if any
+_warned_interval = False
+
+
+def _ring_capacity():
+    raw = os.environ.get(ENV_RING, "").strip()
+    if not raw:
+        return DEFAULT_RING
+    try:
+        return max(2, int(raw))
+    except ValueError:
+        return DEFAULT_RING
+
+
+def interval_s():
+    """Configured sampling interval in seconds, or None (the default:
+    sampling off).  Malformed or non-positive values warn once and read
+    as off — a typo must not take serving down."""
+    global _warned_interval
+    raw = os.environ.get(ENV_INTERVAL, "").strip()
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+        if val <= 0:
+            raise ValueError(raw)
+        return val
+    except ValueError:
+        if not _warned_interval:
+            _warned_interval = True
+            logger.warning("%s=%r is not a positive float; time-series "
+                           "sampling stays off", ENV_INTERVAL, raw)
+        return None
+
+
+class TimeSeries:
+    """Bounded ring of ``{"t", "gen", "snapshot"}`` samples with
+    windowed-signal derivation (:meth:`window`).  Sampling and reading
+    are thread-safe; derivation works on plain snapshot dicts, so it
+    applies equally to live rings and parsed JSON-lines series."""
+
+    def __init__(self, capacity=None):
+        self.capacity = max(2, int(capacity if capacity is not None
+                                   else _ring_capacity()))
+        self._lock = _threads.package_lock("TimeSeries._lock")
+        self._ring = collections.deque(maxlen=self.capacity)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def sample(self, now=None):
+        """Append one timestamped registry snapshot (the sampler tick;
+        tests pass ``now`` for deterministic timelines)."""
+        snap = telemetry.snapshot()
+        entry = {"t": float(now) if now is not None else time.time(),
+                 "gen": telemetry.registry_epoch(),
+                 "snapshot": snap}
+        with self._lock:
+            self._ring.append(entry)
+        return entry
+
+    def samples(self, seconds=None, now=None):
+        """Ring entries, optionally restricted to the trailing
+        ``seconds`` (measured back from ``now`` or the newest sample)."""
+        with self._lock:
+            entries = list(self._ring)
+        if seconds is None or not entries:
+            return entries
+        t_end = float(now) if now is not None else entries[-1]["t"]
+        cutoff = t_end - float(seconds)
+        return [e for e in entries if e["t"] >= cutoff]
+
+    def names(self, prefix=""):
+        """Instrument names present in the newest sample (counters and
+        histograms persist in the registry, so the newest snapshot is
+        the union that matters for window derivation)."""
+        with self._lock:
+            last = self._ring[-1]["snapshot"] if self._ring else {}
+        return sorted(n for n in last if n.startswith(prefix))
+
+    def window(self, name, seconds, now=None):
+        """Derived signal for instrument ``name`` over the trailing
+        ``seconds``.  Returns None when the instrument never appears in
+        the window; otherwise a dict keyed by instrument kind:
+
+        - counter:   ``{"kind", "window_s", "samples", "delta",
+          "rate_per_s", "resets"}``
+        - gauge:     ``{"kind", "window_s", "samples", "min", "mean",
+          "max", "last", "resets"}``
+        - histogram: ``{"kind", "window_s", "samples", "count",
+          "rate_per_s", "mean", "delta", "resets"}`` — ``delta`` is the
+          merged :func:`telemetry.delta_snapshot` over the window, ready
+          for ``quantile_from_snapshot`` / ``fraction_over``
+
+        ``rate_per_s`` is None with fewer than two samples.  A
+        ``telemetry.reset()`` inside the window shows up as
+        ``resets > 0`` with the straddling spans excluded from the
+        delta/rate arithmetic — the reset marker the satellite contract
+        demands instead of negative rates."""
+        entries = self.samples(seconds, now=now)
+        seq = [(e["t"], e["snapshot"][name]) for e in entries
+               if name in e["snapshot"]]
+        if not seq:
+            return None
+        kind = seq[-1][1].get("type")
+        base = {"window_s": float(seconds), "samples": len(seq)}
+        if kind == "gauge":
+            vals = [float(s.get("value", 0.0) or 0.0) for _, s in seq]
+            resets = sum(1 for (_, a), (_, b) in zip(seq, seq[1:])
+                         if telemetry.generation_changed(a, b))
+            return dict(base, kind="gauge", min=min(vals),
+                        mean=sum(vals) / len(vals), max=max(vals),
+                        last=vals[-1], resets=resets)
+        if kind == "counter":
+            delta, span, resets = 0.0, 0.0, 0
+            for (ta, a), (tb, b) in zip(seq, seq[1:]):
+                d, reset = telemetry.counter_delta(a, b)
+                if reset:
+                    resets += 1
+                    continue
+                delta += d
+                span += max(0.0, tb - ta)
+            return dict(base, kind="counter", delta=delta,
+                        rate_per_s=(delta / span) if span > 0 else None,
+                        resets=resets)
+        if kind == "histogram":
+            merged, span, resets = None, 0.0, 0
+            for (ta, a), (tb, b) in zip(seq, seq[1:]):
+                d = telemetry.delta_snapshot(a, b)
+                if d.get("reset"):
+                    resets += 1
+                    continue
+                merged = _merge_delta(merged, d)
+                span += max(0.0, tb - ta)
+            if merged is None:
+                merged = {"type": "histogram", "count": 0, "sum": 0.0,
+                          "min": None, "max": None, "buckets": [],
+                          "reset": False}
+            count = merged.get("count", 0) or 0
+            return dict(base, kind="histogram", count=count,
+                        rate_per_s=(count / span) if span > 0 else None,
+                        mean=(merged["sum"] / count) if count else 0.0,
+                        delta=merged, resets=resets)
+        return None
+
+
+def _merge_delta(acc, d):
+    """Accumulate per-pair histogram deltas into one window delta (the
+    per-pair form lets a mid-window reset drop only its own span)."""
+    if acc is None:
+        return dict(d, buckets=list(d.get("buckets") or []))
+    bd = d.get("buckets") or []
+    ba = acc.get("buckets") or []
+    if len(bd) > len(ba):
+        ba = ba + [0] * (len(bd) - len(ba))
+    acc["buckets"] = [x + (bd[i] if i < len(bd) else 0)
+                      for i, x in enumerate(ba)]
+    acc["count"] = (acc.get("count", 0) or 0) + (d.get("count", 0) or 0)
+    acc["sum"] = (acc.get("sum", 0.0) or 0.0) + (d.get("sum", 0.0) or 0.0)
+    for key, pick in (("min", min), ("max", max)):
+        vals = [v for v in (acc.get(key), d.get(key))
+                if isinstance(v, (int, float))]
+        acc[key] = pick(vals) if vals else None
+    return acc
+
+
+# -- process singleton + sampler thread --------------------------------------
+
+def _series_locked():
+    global _series
+    if _series is None:
+        _series = TimeSeries()
+    return _series
+
+
+def get_timeseries():
+    """The process-wide ring every sampler tick and alert rule reads."""
+    with _state_lock:
+        return _series_locked()
+
+
+def window(name, seconds, now=None):
+    """Convenience: :meth:`TimeSeries.window` on the process ring."""
+    return get_timeseries().window(name, seconds, now=now)
+
+
+class _Sampler:
+    """The background sampling loop: snapshot -> ring -> alert rules ->
+    ship.  One per process, spawned through ``threads.spawn`` so the
+    leak fixture and locksan see it; ``stop()`` joins it."""
+
+    def __init__(self, series, interval, engine=None, shipper=None):
+        self.series = series
+        self.interval = float(interval)
+        self.engine = engine
+        self.shipper = shipper
+        self._stop = threading.Event()
+        self._thread = _threads.spawn(self._run, "timeseries", "sampler",
+                                      start=False)
+
+    def start(self):
+        self._thread.start()
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self.tick()
+
+    def tick(self, now=None):
+        """One sampling step (callable inline from tests)."""
+        entry = self.series.sample(now=now)
+        transitions = ()
+        if self.engine is not None:
+            try:
+                transitions = self.engine.evaluate(self.series,
+                                                   now=entry["t"])
+            except Exception:
+                logger.exception("alert evaluation failed")
+        if self.shipper is not None:
+            try:
+                self.shipper.ship(entry, transitions)
+            except Exception:
+                logger.exception("series shipping failed")
+        return transitions
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        self._thread.join(timeout)
+        if self.shipper is not None:
+            self.shipper.close()
+
+
+def ensure_sampler():
+    """Start the background sampler if ``MXNET_TPU_TS_INTERVAL_S`` asks
+    for one and none is running yet — the hook ``Server.__init__``,
+    elastic resume, and ``Checkpointer.attach`` call unconditionally.
+    With the env unset this is a no-op (nothing spawned, nothing
+    sampled: the off-path stays bitwise identical)."""
+    iv = interval_s()
+    if iv is None:
+        return None
+    return start_sampler(interval=iv)
+
+
+def start_sampler(interval=None, ship_dir=None, engine=None):
+    """Start the sampler thread (or return the one already running).
+    ``interval`` defaults to the env setting; ``ship_dir`` overrides the
+    trace-root-derived fleet series dir; ``engine`` overrides the
+    process alert engine.  Returns None when no interval is configured."""
+    global _sampler
+    iv = float(interval) if interval is not None else interval_s()
+    if not iv or iv <= 0:
+        return None
+    with _state_lock:
+        if _sampler is not None and _sampler.alive:
+            return _sampler
+        series = _series_locked()
+    # engine/shipper construction happens outside _state_lock: both may
+    # take their own package locks (alerts._lock, reqtrace._lock)
+    if engine is None:
+        from . import alerts as _alerts
+        engine = _alerts.get_engine()
+    from . import shipper as _shipper
+    ship = _shipper.SeriesShipper(ship_dir)
+    with _state_lock:
+        if _sampler is not None and _sampler.alive:
+            return _sampler
+        _sampler = _Sampler(series, iv, engine=engine, shipper=ship)
+        _sampler.start()
+        return _sampler
+
+
+def current_sampler():
+    with _state_lock:
+        return _sampler
+
+
+def stop_sampler(timeout=5.0):
+    """Stop and join the sampler thread and close its shipper — the
+    leak-fixture-clean teardown path.  No-op when none is running."""
+    global _sampler
+    with _state_lock:
+        s = _sampler
+        _sampler = None
+    if s is not None:
+        s.stop(timeout)
+    return s
+
+
+def reset():
+    """Tests / between bench passes: stop the sampler, drop the ring,
+    re-arm the warn-once latch."""
+    global _series, _warned_interval
+    stop_sampler()
+    with _state_lock:
+        _series = None
+        _warned_interval = False

@@ -36,6 +36,7 @@ breakdown and SLO attainment table parse these):
 - ``serving.replica.<i>.rows``         counter, real rows served by i
 - ``serving.replica.<i>.dispatch_ms``  histogram, executor wall per batch
 - ``serving.replica_quarantined``      counter, replicas quarantined
+- ``serving.replicas``                 gauge (live callback), fleet size
 - ``serving.request_latency_ms.<model>``  histogram, per-model latency
   (the SLO attainment input — the process-wide histogram mixes models)
 - ``serving.slo_ms.<model>``           gauge, declared p99 target
@@ -330,3 +331,15 @@ def register_queue_gauge(admission):
     with _queue_sources_lock:
         _queue_sources.append(weakref.ref(admission))
     _ensure_queue_gauge()
+
+
+def register_replica_gauge(group):
+    """Live fleet-size gauge (``serving.replicas``): the health plane
+    trends shed rate and queue depth against the replica count that
+    produced them.  Weakly referenced, same lifetime contract as the
+    queue gauge."""
+    ref = weakref.ref(group)
+    telemetry.gauge("serving.replicas",
+                    help="replicas behind the fleet admission queue"
+                    ).set_function(
+        lambda: len(ref()) if ref() is not None else 0)

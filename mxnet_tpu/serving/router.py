@@ -591,6 +591,10 @@ class FleetServer(Server):
                  http_host="127.0.0.1", http_port=0, auto_start=True):
         # group before super().__init__: _make_batcher needs it
         self.group = ReplicaGroup(n_replicas, ctxs=ctxs)
+        # fleet-size gauge for the health plane: the dashboard (and the
+        # coming autoscaler) trend shed rate and queue depth AGAINST
+        # the replica count that produced them
+        metrics.register_replica_gauge(self.group)
         super().__init__(registry=self.group.primary_registry,
                          max_batch_size=max_batch_size,
                          batch_window_ms=batch_window_ms,

@@ -48,6 +48,7 @@ from ..log import module_logger as _module_logger
 from ..observability import memprof as _memprof
 from ..observability import reqtrace as _reqtrace
 from ..observability import telemetry
+from ..observability import timeseries as _timeseries
 from . import metrics
 from .admission import AdmissionController, Request
 from .batcher import DynamicBatcher
@@ -103,6 +104,10 @@ class Server:
         # hook costs one None check per dispatched batch.
         self.batcher.cadence = _TunerCadence(self)
         metrics.register_queue_gauge(self.admission)
+        # health-plane sampler (MXNET_TPU_TS_INTERVAL_S): a serving
+        # process is exactly what the time-series ring + burn-rate
+        # alerts exist to watch.  Unset env = no-op, nothing spawned.
+        _timeseries.ensure_sampler()
         self._closed = False
         self._close_lock = _threads.package_lock("Server._close_lock")
         self._httpd = None

@@ -35,7 +35,7 @@ _FAST_MODULES = {
     "test_namespaces", "test_optimizer", "test_symbol", "test_elastic",
     "test_serving", "test_pallas_kernels", "test_comm_overlap",
     "test_program_cache", "test_autotune", "test_reqtrace",
-    "test_concurrency",
+    "test_concurrency", "test_timeseries",
 }
 
 
@@ -86,6 +86,8 @@ _SLOW_WITHIN_FAST = {
     "test_process_mode_matches_thread_mode",
     # three cachectl subprocesses, each a full framework import
     "test_cachectl_ls_verify_prune",
+    # two shipper subprocesses, each a full framework import
+    "test_fleet_shipper_merges_processes",
 }
 
 
@@ -106,7 +108,7 @@ def pytest_collection_modifyitems(config, items):
 # (or a deadlock) in a later one.
 _LEAK_CHECK_MODULES = {
     "test_serving", "test_serving_fleet", "test_io_pipeline",
-    "test_concurrency",
+    "test_concurrency", "test_timeseries",
 }
 
 

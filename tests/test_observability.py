@@ -58,7 +58,8 @@ def test_counter_gauge_histogram_snapshot():
     for v in (0.25, 1.0, 1.5, 900.0):
         h.observe(v)
     snap = telemetry.snapshot()
-    assert snap["t.hits"] == {"type": "counter", "value": 5.0}
+    assert snap["t.hits"] == {"type": "counter", "value": 5.0,
+                              "gen": telemetry.registry_epoch()}
     assert snap["t.depth"]["value"] == 3.5
     hs = snap["t.lat_ms"]
     assert hs["count"] == 4 and hs["min"] == 0.25 and hs["max"] == 900.0

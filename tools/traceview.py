@@ -7,6 +7,8 @@
     python tools/traceview.py --elastic /tmp/flight_dump.json
     python tools/traceview.py --requests /tmp/flight_or_reqtrace.json
     python tools/traceview.py --fleet /tmp/fleet_dump_dir/
+    python tools/traceview.py --dash /tmp/mxnet_tpu_ts_<root>/
+    python tools/traceview.py --alerts /tmp/flight_dump.json
 
 Three views over one trace:
 
@@ -46,7 +48,32 @@ subprocess workers sharing an env-propagated trace root
 (`MXNET_TPU_REQTRACE_CTX`) — onto one shared-epoch timeline: per-source
 table (pid, trace root, records, wall span), the merged request
 timeline, and the fleet-wide attribution table.  Exits 2 when no dump
-holds request records.
+holds request records.  Both `--requests` and `--fleet` accept
+`--since SECONDS` to keep only requests that started within the
+trailing window of the (fleet-wide) newest request start.
+
+`--dash <dir>` is the fleet health dashboard: it merges every
+`series_*.jsonl` file the timeseries sampler's shipper
+(`observability/shipper.py`) wrote into a shared directory — one file
+per process, parent and elastic/fleet children alike, all keyed to the
+same env-propagated trace root — and renders sparkline rows for the
+health-plane signals: fleet request rate and shed rate (per-source
+adjacent-sample counter deltas summed into shared time bins, reset
+spans skipped via the registry generation token), queue depth and
+replica count (gauges, per-source bin means summed), and per-model p99
+vs declared SLO (bucket-delta histograms merged across sources before
+the quantile — the delta form of the shared estimator).  The alert
+timeline (every `alert` line shipped) and the rules still firing
+close the report.  Exits 2 when no samples were shipped.
+
+`--alerts` renders the alert-engine firing history
+(`observability/alerts.py`): per-rule fired/resolved counts and each
+transition with the windows and values that tripped it (burn-rate
+windows show burn factor, error ratio, served/shed counts; threshold
+windows show the measured value vs the rule).  Accepts a flight dump
+(the `alerts` ring every dump carries), a bare JSON list of transition
+records, or an `{"alerts": [...]}` document.  Exits 2 when the input
+holds no transitions.
 
 `--flight` reads a flight-recorder dump
 (`observability/flight_recorder.py`): first-anomaly step, per-rule
@@ -1294,9 +1321,47 @@ def fleet_sources(dirpath):
     return sources
 
 
-def fleet_stats(sources):
+def _filter_doc_since(doc, cutoff):
+    """Shallow-copied dump with request records older than ``cutoff``
+    (epoch seconds) dropped."""
+    pinned, sampled = request_records(doc)
+    out = dict(doc)
+    out["requests"] = [r for r in pinned
+                       if _fnum(r.get("t0"), 0.0) >= cutoff]
+    out["requests_sampled"] = [r for r in sampled
+                               if _fnum(r.get("t0"), 0.0) >= cutoff]
+    return out
+
+
+def filter_since(doc, since):
+    """Scope one dump's request records to the trailing ``since``
+    seconds, measured back from the newest record — the `--since`
+    incident window an alert names.  No-op on dumps without
+    timestamped records."""
+    pinned, sampled = request_records(doc)
+    times = [t for t in (_fnum(r.get("t0")) for r in pinned + sampled)
+             if _isfinite(t)]
+    if not times:
+        return doc
+    return _filter_doc_since(doc, max(times) - float(since))
+
+
+def fleet_stats(sources, since=None):
     """The machine-readable `--fleet` summary: per-source facts and
-    the merged, epoch-ordered request timeline."""
+    the merged, epoch-ordered request timeline.  ``since`` scopes every
+    source to the trailing window measured back from the newest record
+    FLEET-WIDE (one shared cutoff, so the per-source tables stay
+    comparable)."""
+    if since is not None:
+        times = []
+        for _, doc in sources:
+            pinned, sampled = request_records(doc)
+            times += [_fnum(r.get("t0")) for r in pinned + sampled]
+        times = [t for t in times if _isfinite(t)]
+        if times:
+            cutoff = max(times) - float(since)
+            sources = [(fn, _filter_doc_since(doc, cutoff))
+                       for fn, doc in sources]
     rows, merged = [], []
     for fn, doc in sources:
         pinned, sampled = request_records(doc)
@@ -1378,6 +1443,421 @@ def summarize_fleet(stats, top=30):
             lines.append("%-14s p99 %.3f ms over %d request(s): %s"
                          % (m["model"][:14], m["p99_ms"],
                             m["requests"], shares))
+    return "\n".join(lines)
+
+
+# -- health-plane dashboard + alert history ----------------------------------
+
+def _hist_delta(snap_a, snap_b):
+    """Pinned copy of ``observability.telemetry.delta_snapshot`` (this
+    CLI stays import-free): the histogram of only the observations made
+    between two snapshots of the same instrument — per-bucket count
+    differences, bounds clamped to the newer snapshot's min/max.  A
+    generation change (``gen`` token) or any negative difference means
+    the registry was reset between the snapshots: the result is the
+    newer snapshot alone, flagged ``"reset": True``."""
+    if not snap_a:
+        out = dict(snap_b)
+        out["reset"] = False
+        return out
+    ba = snap_a.get("buckets") or []
+    bb = snap_b.get("buckets") or []
+    ca = snap_a.get("count", 0) or 0
+    cb = snap_b.get("count", 0) or 0
+    reset = snap_a.get("gen") != snap_b.get("gen")
+    diff = []
+    if not reset:
+        if cb < ca or len(ba) != len(bb):
+            reset = True
+        else:
+            diff = [y - x for x, y in zip(ba, bb)]
+            if any(d < 0 for d in diff):
+                reset = True
+    if reset:
+        out = dict(snap_b)
+        out["reset"] = True
+        return out
+    count = cb - ca
+    return {"count": count,
+            "sum": _fnum(snap_b.get("sum"), 0.0)
+            - _fnum(snap_a.get("sum"), 0.0),
+            "min": snap_b.get("min") if count else None,
+            "max": snap_b.get("max") if count else None,
+            "buckets": diff, "reset": False}
+
+
+def _hist_quantile_between(snap_a, snap_b, q):
+    """Pinned copy of ``telemetry.quantile_between``: the delta-form
+    quantile — only the observations made between the two snapshots."""
+    return _hist_quantile(_hist_delta(snap_a, snap_b), q)
+
+
+def _merge_hist(acc, d):
+    """Accumulate delta-histogram snapshots (the dash's per-bin merge
+    across sources — same arithmetic as the timeseries window merge)."""
+    if acc is None:
+        return dict(d, buckets=list(d.get("buckets") or []))
+    bd = d.get("buckets") or []
+    ba = acc.get("buckets") or []
+    if len(bd) > len(ba):
+        ba = ba + [0] * (len(bd) - len(ba))
+    acc["buckets"] = [x + (bd[i] if i < len(bd) else 0)
+                      for i, x in enumerate(ba)]
+    acc["count"] = (acc.get("count", 0) or 0) + (d.get("count", 0) or 0)
+    acc["sum"] = _fnum(acc.get("sum"), 0.0) + _fnum(d.get("sum"), 0.0)
+    for key, pick in (("min", min), ("max", max)):
+        vals = [v for v in (acc.get(key), d.get(key)) if v is not None]
+        acc[key] = pick(vals) if vals else None
+    return acc
+
+
+def dash_sources(dirpath):
+    """Every fleet-shipper series file (``series_*.jsonl``, written by
+    ``observability/shipper.py``) in ``dirpath`` as
+    ``{"source", "fleet", "samples", "alerts"}`` dicts.  Unparseable
+    lines are skipped — a series file may still be mid-write."""
+    import os as _os
+    sources = []
+    for fn in sorted(_os.listdir(dirpath)):
+        if not (fn.startswith("series_") and fn.endswith(".jsonl")):
+            continue
+        fleet, samples, alerts = {}, [], []
+        try:
+            with open(_os.path.join(dirpath, fn)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue
+                    kind = obj.get("kind")
+                    if kind == "header":
+                        fleet = obj.get("fleet") or fleet
+                    elif kind == "sample":
+                        samples.append(obj)
+                    elif kind == "alert":
+                        alerts.append(obj)
+        except OSError:
+            continue
+        if samples or alerts:
+            samples.sort(key=lambda s: _fnum(s.get("rel"), 0.0))
+            sources.append({"source": fn, "fleet": fleet,
+                            "samples": samples, "alerts": alerts})
+    return sources
+
+
+def dash_stats(sources, bins=48):
+    """The machine-readable `--dash` summary: fleet-merged binned
+    signal series (request rate, shed rate, queue depth, replicas,
+    per-model p99 vs SLO) plus the merged alert timeline.  Counter
+    rates are per-source adjacent-sample deltas summed into shared
+    time bins (reset spans skipped via the ``gen`` token); histogram
+    bins merge bucket deltas across sources before the quantile."""
+    all_samples = [s for src in sources for s in src["samples"]]
+    out = {"sources": [
+        {"source": src["source"],
+         "pid": (src["fleet"] or {}).get("pid"),
+         "root": (src["fleet"] or {}).get("root"),
+         "samples": len(src["samples"]), "alerts": len(src["alerts"])}
+        for src in sources]}
+    out["roots"] = sorted({r["root"] for r in out["sources"]
+                           if r["root"]})
+    epochs = [_fnum((src["fleet"] or {}).get("epoch0"))
+              for src in sources]
+    epochs = [e for e in epochs if _isfinite(e)]
+    out["epoch0"] = min(epochs) if epochs else None
+    merged_alerts = sorted((a for src in sources for a in src["alerts"]),
+                           key=lambda a: _fnum(a.get("t"), 0.0))
+    last_state = {}
+    for a in merged_alerts:
+        last_state[str(a.get("rule", "?"))] = a.get("state")
+    out["alerts"] = merged_alerts
+    out["firing"] = sorted(r for r, s in last_state.items()
+                           if s == "firing")
+    if not all_samples:
+        out.update({"bins": 0, "bin_s": 0.0, "rel0": 0.0, "rel1": 0.0,
+                    "req_rate": [], "req_total": 0.0, "shed_rate": [],
+                    "shed_total": 0.0, "queue_depth": [],
+                    "replicas": [], "models": []})
+        return out
+    rels = [_fnum(s.get("rel"), 0.0) for s in all_samples]
+    rel0, rel1 = min(rels), max(rels)
+    span = max(rel1 - rel0, 1e-9)
+    nbins = max(1, min(bins, len(all_samples)))
+    width = span / nbins
+
+    def bin_of(rel):
+        return min(nbins - 1, max(0, int((rel - rel0) / width)))
+
+    def pairs(src):
+        ss = src["samples"]
+        return zip(ss, ss[1:])
+
+    def counter_rate(match):
+        deltas = [0.0] * nbins
+        for src in sources:
+            for a, b in pairs(src):
+                sa = a.get("series") or {}
+                sb = b.get("series") or {}
+                mid = (_fnum(a.get("rel"), 0.0)
+                       + _fnum(b.get("rel"), 0.0)) / 2.0
+                i = bin_of(mid)
+                for name, snap in sb.items():
+                    if not match(name) \
+                            or (snap or {}).get("type") != "counter":
+                        continue
+                    vb = _fnum(snap.get("value"), 0.0)
+                    prev = sa.get(name)
+                    if prev is None:
+                        deltas[i] += vb
+                        continue
+                    va = _fnum(prev.get("value"), 0.0)
+                    if prev.get("gen") != snap.get("gen") or vb < va:
+                        continue  # reset span: no negative rates
+                    deltas[i] += vb - va
+        return [d / width for d in deltas], sum(deltas)
+
+    def gauge_series(match):
+        per = {}
+        for si, src in enumerate(sources):
+            for s in src["samples"]:
+                for name, snap in (s.get("series") or {}).items():
+                    if not match(name) \
+                            or (snap or {}).get("type") != "gauge":
+                        continue
+                    i = bin_of(_fnum(s.get("rel"), 0.0))
+                    per.setdefault((si, i), []).append(
+                        _fnum(snap.get("value"), 0.0))
+        series = [0.0] * nbins
+        for (si, i), vals in per.items():
+            series[i] += sum(vals) / len(vals)
+        return series
+
+    out.update({"bins": nbins, "bin_s": width, "rel0": rel0,
+                "rel1": rel1})
+    out["req_rate"], out["req_total"] = counter_rate(
+        lambda n: n == "serving.requests_total")
+    out["shed_rate"], out["shed_total"] = counter_rate(
+        lambda n: n.startswith("serving.rejected_total."))
+    out["queue_depth"] = gauge_series(
+        lambda n: n == "serving.queue_depth")
+    out["replicas"] = gauge_series(lambda n: n == "serving.replicas")
+
+    lat_prefix = "serving.request_latency_ms."
+    models = sorted({name[len(lat_prefix):]
+                     for s in all_samples
+                     for name in (s.get("series") or {})
+                     if name.startswith(lat_prefix)})
+    out["models"] = []
+    for model in models:
+        lname = lat_prefix + model
+        per_bin = [None] * nbins
+        overall = None
+        for src in sources:
+            for a, b in pairs(src):
+                sb = (b.get("series") or {}).get(lname)
+                if not sb:
+                    continue
+                d = _hist_delta((a.get("series") or {}).get(lname) or {},
+                                sb)
+                if d.get("reset") or (d.get("count") or 0) <= 0:
+                    continue
+                mid = (_fnum(a.get("rel"), 0.0)
+                       + _fnum(b.get("rel"), 0.0)) / 2.0
+                i = bin_of(mid)
+                per_bin[i] = _merge_hist(per_bin[i], d)
+                overall = _merge_hist(overall, d)
+        slo = None
+        for s in all_samples:  # newest declared SLO wins
+            snap = (s.get("series") or {}).get("serving.slo_ms." + model)
+            if snap is not None:
+                slo = _fnum(snap.get("value"), 0.0)
+        out["models"].append({
+            "model": model,
+            "p99_ms": [_hist_quantile(m, 0.99) if m else 0.0
+                       for m in per_bin],
+            "p99_overall": _hist_quantile(overall, 0.99)
+            if overall else 0.0,
+            "served": (overall or {}).get("count", 0),
+            "slo_ms": slo})
+    return out
+
+
+def _alert_detail(rec):
+    """The windows/values that tripped (or resolved) one rule, as one
+    compact line."""
+    parts = []
+    windows = rec.get("windows") or {}
+    for wname in sorted(windows):
+        w = windows[wname] or {}
+        if "burn" in w:
+            parts.append(
+                "%s[%gs] burn=%.2f err=%.1f%% served=%s shed=%s"
+                % (wname, _fnum(w.get("window_s"), 0.0),
+                   _fnum(w.get("burn"), 0.0),
+                   _fnum(w.get("error_ratio"), 0.0) * 100.0,
+                   w.get("served", "?"), w.get("rejected", "?")))
+        else:
+            parts.append("%s[%gs] value=%s"
+                         % (wname, _fnum(w.get("window_s"), 0.0),
+                            w.get("value")))
+    if rec.get("burn_threshold") is not None:
+        parts.append("burn_threshold=%g"
+                     % _fnum(rec["burn_threshold"], 0.0))
+        if rec.get("windows", {}).get("fast", {}).get("slo_ms") \
+                is not None:
+            parts.append("slo=%gms"
+                         % _fnum(rec["windows"]["fast"]["slo_ms"], 0.0))
+    elif rec.get("threshold") is not None:
+        parts.append("%s %s %s" % (rec.get("field", "value"),
+                                   rec.get("op", "?"),
+                                   rec.get("threshold")))
+    return "  ".join(parts)
+
+
+def alert_records(doc):
+    """Alert transition records from a flight dump (the ``alerts``
+    ring), a bare JSON list, or an ``{"alerts": [...]}`` document."""
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    if isinstance(doc, dict):
+        return [r for r in (doc.get("alerts") or [])
+                if isinstance(r, dict)]
+    return []
+
+
+def alerts_stats(records):
+    """The machine-readable `--alerts` summary: per-rule fire/resolve
+    counts and the rules still firing at the end of the record."""
+    by_rule = {}
+    for r in records:
+        st = by_rule.setdefault(str(r.get("rule", "?")),
+                                {"fired": 0, "resolved": 0, "last": None})
+        if r.get("state") == "firing":
+            st["fired"] += 1
+        elif r.get("state") == "resolved":
+            st["resolved"] += 1
+        st["last"] = r.get("state")
+    return {"records": len(records), "rules": by_rule,
+            "firing": sorted(rule for rule, st in by_rule.items()
+                             if st["last"] == "firing")}
+
+
+def summarize_alerts(records, top=20):
+    """The text report for `--alerts`: per-rule counts + the firing
+    history with the windows and values that tripped each rule."""
+    stats = alerts_stats(records)
+    lines = ["== alerts: %d transition(s), firing now: %s =="
+             % (stats["records"],
+                ", ".join(stats["firing"]) or "(none)")]
+    if not records:
+        lines.append("(no alert transitions recorded — no rules armed, "
+                     "or nothing fired)")
+        return "\n".join(lines)
+    lines.append("%-28s %6s %9s %-9s"
+                 % ("Rule", "Fired", "Resolved", "Last"))
+    for rule in sorted(stats["rules"]):
+        st = stats["rules"][rule]
+        lines.append("%-28s %6d %9d %-9s"
+                     % (rule[:28], st["fired"], st["resolved"],
+                        st["last"] or "?"))
+    lines.append("")
+    lines.append("== alerts: firing history (newest last) ==")
+    t0 = min(_fnum(r.get("t"), 0.0) for r in records)
+    if len(records) > top:
+        lines.append("... (%d earlier transition(s) elided)"
+                     % (len(records) - top))
+    for r in records[-top:]:
+        lines.append("%9.3fs %-9s %-28s [%s]"
+                     % (_fnum(r.get("t"), 0.0) - t0,
+                        str(r.get("state", "?")),
+                        str(r.get("rule", "?"))[:28],
+                        str(r.get("kind", "?"))))
+        detail = _alert_detail(r)
+        if detail:
+            lines.append("           %s" % detail)
+    return "\n".join(lines)
+
+
+def summarize_dash(stats, top_alerts=10):
+    """The text report for `--dash`: the fleet-merged sparkline
+    dashboard (req rate, shed rate, p99 vs SLO, queue depth, live
+    alerts)."""
+    lines = []
+    n_samples = sum(r["samples"] for r in stats["sources"])
+    lines.append("== fleet dash: %d source(s), %d sample(s) over "
+                 "%.1f s, root(s): %s =="
+                 % (len(stats["sources"]), n_samples,
+                    stats["rel1"] - stats["rel0"] if stats["bins"]
+                    else 0.0,
+                    ", ".join(stats["roots"]) or "(none)"))
+    lines.append("%-30s %-8s %-10s %8s %7s"
+                 % ("Source", "Pid", "Root", "Samples", "Alerts"))
+    for r in stats["sources"]:
+        lines.append("%-30s %-8s %-10s %8d %7d"
+                     % (r["source"][:30], r["pid"] or "?",
+                        (r["root"] or "?")[:10], r["samples"],
+                        r["alerts"]))
+    if not stats["bins"]:
+        lines.append("(no series samples shipped — is "
+                     "MXNET_TPU_TS_INTERVAL_S set?)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append("== signals (each bin = %.2f s) ==" % stats["bin_s"])
+    lines.append("req rate /s   %s  total %d  peak %.1f/s"
+                 % (_sparkline(stats["req_rate"]),
+                    stats["req_total"],
+                    max(stats["req_rate"]) if stats["req_rate"]
+                    else 0.0))
+    lines.append("shed rate /s  %s  total %d  peak %.1f/s"
+                 % (_sparkline(stats["shed_rate"]),
+                    stats["shed_total"],
+                    max(stats["shed_rate"]) if stats["shed_rate"]
+                    else 0.0))
+    lines.append("queue depth   %s  last %.1f  max %.1f"
+                 % (_sparkline(stats["queue_depth"]),
+                    stats["queue_depth"][-1] if stats["queue_depth"]
+                    else 0.0,
+                    max(stats["queue_depth"]) if stats["queue_depth"]
+                    else 0.0))
+    if any(stats["replicas"]):
+        lines.append("replicas      %s  last %.0f"
+                     % (_sparkline(stats["replicas"]),
+                        stats["replicas"][-1]))
+    lines.append("")
+    lines.append("== p99 vs SLO (windowed delta quantiles) ==")
+    if not stats["models"]:
+        lines.append("(no per-model latency series shipped)")
+    for m in stats["models"]:
+        verdict = "?"
+        if m["slo_ms"]:
+            verdict = ("OK (%.0f%% of slo)"
+                       if m["p99_overall"] <= m["slo_ms"]
+                       else "BREACH (%.0f%% of slo)") \
+                % (100.0 * m["p99_overall"] / m["slo_ms"])
+        lines.append("%-14s p99(ms) %s  overall %.2f ms  slo %s  %s"
+                     % (m["model"][:14], _sparkline(m["p99_ms"]),
+                        m["p99_overall"],
+                        ("%g ms" % m["slo_ms"]) if m["slo_ms"]
+                        else "(undeclared)", verdict))
+    lines.append("")
+    lines.append("== alerts (%d transition(s), firing now: %s) =="
+                 % (len(stats["alerts"]),
+                    ", ".join(stats["firing"]) or "(none)"))
+    epoch0 = stats["epoch0"] or 0.0
+    ats = [_fnum(a.get("t")) for a in stats["alerts"]]
+    ats = [t for t in ats if _isfinite(t)]
+    # anchor at run start when the clocks agree, else at the first alert
+    base = epoch0 if (ats and epoch0 and min(ats) >= epoch0) \
+        else (min(ats) if ats else 0.0)
+    for a in stats["alerts"][-top_alerts:]:
+        lines.append("%9.3fs %-9s %-28s %s"
+                     % (_fnum(a.get("t"), 0.0) - base,
+                        str(a.get("state", "?")),
+                        str(a.get("rule", "?"))[:28],
+                        _alert_detail(a)))
     return "\n".join(lines)
 
 
@@ -1514,6 +1994,24 @@ def main(argv=None):
                         "sharing an env-propagated trace root) onto "
                         "one shared-epoch timeline; exits 2 when no "
                         "dump holds request traces")
+    parser.add_argument("--dash", action="store_true",
+                        help="fleet health dashboard: merge every "
+                        "series_*.jsonl shipped by the timeseries "
+                        "sampler in a DIRECTORY into sparkline rows "
+                        "(req rate, shed rate, p99 vs SLO, queue "
+                        "depth, replicas) plus the live alert state; "
+                        "exits 2 when no samples were shipped")
+    parser.add_argument("--alerts", action="store_true",
+                        help="alert view: the firing/resolve history "
+                        "with the windows and values that tripped "
+                        "each rule, from a flight dump (the `alerts` "
+                        "ring) or a bare record-list JSON; exits 2 "
+                        "when no transitions are recorded")
+    parser.add_argument("--since", type=float, default=None,
+                        metavar="SECONDS",
+                        help="with --requests/--fleet: only requests "
+                        "that STARTED within the trailing SECONDS of "
+                        "the (fleet-wide) newest request start")
     parser.add_argument("--elastic", action="store_true",
                         help="elastic view: the checkpoint/resume "
                         "lineage (snapshots by trigger, rejected-at-"
@@ -1522,13 +2020,25 @@ def main(argv=None):
                         "a bare record-list JSON; exits 2 when no "
                         "elastic records are recorded")
     args = parser.parse_args(argv)
+    if args.dash:
+        stats = dash_stats(dash_sources(args.trace))
+        print(summarize_dash(stats))
+        return 0 if stats["bins"] else 2
+    if args.alerts:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        records = alert_records(doc)
+        print(summarize_alerts(records))
+        return 0 if records else 2
     if args.fleet:
-        stats = fleet_stats(fleet_sources(args.trace))
+        stats = fleet_stats(fleet_sources(args.trace), since=args.since)
         print(summarize_fleet(stats))
         return 0 if stats["merged"] else 2
     if args.requests:
         with open(args.trace) as f:
             doc = json.load(f)
+        if args.since is not None:
+            doc = filter_since(doc, args.since)
         print(summarize_requests(doc))
         pinned, sampled = request_records(doc)
         return 0 if (pinned or sampled) else 2
